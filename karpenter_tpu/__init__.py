@@ -1,0 +1,12 @@
+"""karpenter_tpu — a TPU-native cluster-autoscaling framework.
+
+A brand-new framework with the capabilities of Karpenter (reference snapshot ≈ v0.27 at
+/root/reference): it watches unschedulable pods, bin-packs them onto the cheapest
+feasible instance offerings, launches those nodes, and continuously deprovisions
+(consolidation, emptiness, expiration, drift, interruption). Unlike the reference's
+single-threaded greedy Go packer, the scheduling core runs on TPU: pods and offerings
+become demand/capacity tensors with boolean constraint masks, solved by a vmapped
+grouped-FFD + portfolio search under jit.
+"""
+
+__version__ = "0.1.0"

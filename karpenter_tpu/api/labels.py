@@ -1,0 +1,51 @@
+"""Well-known label keys.
+
+Mirrors the label surface the reference exposes on every instance type
+(``/root/reference/pkg/providers/instancetype/types.go:67-122``) plus the core
+karpenter.sh labels, renamed to this framework's domain where AWS-specific.
+"""
+
+# Kubernetes well-known
+ARCH = "kubernetes.io/arch"
+OS = "kubernetes.io/os"
+HOSTNAME = "kubernetes.io/hostname"
+INSTANCE_TYPE = "node.kubernetes.io/instance-type"
+ZONE = "topology.kubernetes.io/zone"
+REGION = "topology.kubernetes.io/region"
+
+# Framework domain (reference: karpenter.sh / karpenter.k8s.aws)
+GROUP = "karpenter.tpu"
+PROVISIONER_NAME = f"{GROUP}/provisioner-name"
+CAPACITY_TYPE = f"{GROUP}/capacity-type"  # reference: karpenter.sh/capacity-type
+MANAGED_BY = f"{GROUP}/managed-by"
+DO_NOT_EVICT_ANNOTATION = f"{GROUP}/do-not-evict"
+DO_NOT_CONSOLIDATE_ANNOTATION = f"{GROUP}/do-not-consolidate"
+VOLUNTARY_DISRUPTION_ANNOTATION = f"{GROUP}/voluntary-disruption"  # value: "drifted"
+EMPTINESS_TIMESTAMP_ANNOTATION = f"{GROUP}/emptiness-timestamp"
+TERMINATION_FINALIZER = f"{GROUP}/termination"
+
+# Instance-type detail labels (reference: karpenter.k8s.aws/instance-*,
+# types.go:67-122)
+INSTANCE_GROUP = f"instance.{GROUP}"
+INSTANCE_CATEGORY = f"{INSTANCE_GROUP}/instance-category"
+INSTANCE_FAMILY = f"{INSTANCE_GROUP}/instance-family"
+INSTANCE_GENERATION = f"{INSTANCE_GROUP}/instance-generation"
+INSTANCE_SIZE = f"{INSTANCE_GROUP}/instance-size"
+INSTANCE_CPU = f"{INSTANCE_GROUP}/instance-cpu"
+INSTANCE_MEMORY = f"{INSTANCE_GROUP}/instance-memory"  # MiB
+INSTANCE_NETWORK_BANDWIDTH = f"{INSTANCE_GROUP}/instance-network-bandwidth"  # Mbps
+INSTANCE_PODS = f"{INSTANCE_GROUP}/instance-pods"
+INSTANCE_GPU_NAME = f"{INSTANCE_GROUP}/instance-gpu-name"
+INSTANCE_GPU_COUNT = f"{INSTANCE_GROUP}/instance-gpu-count"
+INSTANCE_GPU_MEMORY = f"{INSTANCE_GROUP}/instance-gpu-memory"  # MiB
+INSTANCE_ACCELERATOR_NAME = f"{INSTANCE_GROUP}/instance-accelerator-name"
+INSTANCE_ACCELERATOR_COUNT = f"{INSTANCE_GROUP}/instance-accelerator-count"
+INSTANCE_LOCAL_NVME = f"{INSTANCE_GROUP}/instance-local-nvme"  # GiB
+INSTANCE_HYPERVISOR = f"{INSTANCE_GROUP}/instance-hypervisor"
+
+# Capacity types (reference: v1alpha5.CapacityTypeSpot / OnDemand)
+CAPACITY_TYPE_SPOT = "spot"
+CAPACITY_TYPE_ON_DEMAND = "on-demand"
+
+# Keys that pods may not set via nodeSelector because the framework owns them.
+RESTRICTED_LABELS = frozenset({PROVISIONER_NAME, MANAGED_BY})

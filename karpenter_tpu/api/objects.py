@@ -1,0 +1,280 @@
+"""Core API objects.
+
+Native-Python analogues of the kubernetes + karpenter objects the reference operates
+on: Pod, Node, PDB (kube core/v1), and the CRDs — Provisioner
+(``/root/reference/pkg/apis/crds/karpenter.sh_provisioners.yaml:43-316``), Machine
+(used throughout ``/root/reference/pkg/cloudprovider/cloudprovider.go:79-145``), and
+NodeTemplate (the cloud-neutral analogue of AWSNodeTemplate,
+``/root/reference/pkg/apis/v1alpha1/awsnodetemplate.go:50-77``).
+
+Objects are mutable dataclasses managed by the in-memory cluster store
+(`karpenter_tpu.state`); controllers read/patch them exactly as the reference's
+reconcilers do through the apiserver.
+"""
+
+from __future__ import annotations
+
+import itertools
+import time as _time
+from dataclasses import dataclass, field
+from typing import Dict, List, Mapping, Optional, Sequence, Tuple
+
+from . import labels as wk
+from .requirements import Requirement, Requirements
+from .resources import Resources
+from .taints import Taint, Toleration
+
+_uid_counter = itertools.count(1)
+
+
+def new_uid(prefix: str = "uid") -> str:
+    return f"{prefix}-{next(_uid_counter)}"
+
+
+@dataclass
+class ObjectMeta:
+    name: str = ""
+    namespace: str = "default"
+    uid: str = field(default_factory=lambda: new_uid())
+    labels: Dict[str, str] = field(default_factory=dict)
+    annotations: Dict[str, str] = field(default_factory=dict)
+    finalizers: List[str] = field(default_factory=list)
+    creation_timestamp: float = field(default_factory=_time.time)
+    deletion_timestamp: Optional[float] = None
+    owner_kind: Optional[str] = None  # e.g. "ReplicaSet", "DaemonSet", None=controllerless
+    resource_version: int = 0
+
+
+@dataclass(frozen=True)
+class TopologySpreadConstraint:
+    max_skew: int
+    topology_key: str  # zone | hostname | capacity-type
+    when_unsatisfiable: str = "DoNotSchedule"  # or ScheduleAnyway
+    label_selector: Mapping[str, str] = field(default_factory=dict)
+
+    def selects(self, pod: "Pod") -> bool:
+        return all(pod.meta.labels.get(k) == v for k, v in self.label_selector.items())
+
+
+@dataclass(frozen=True)
+class PodAffinityTerm:
+    label_selector: Mapping[str, str]
+    topology_key: str
+    anti: bool = False  # True => anti-affinity
+
+    def selects(self, pod: "Pod") -> bool:
+        return all(pod.meta.labels.get(k) == v for k, v in self.label_selector.items())
+
+
+@dataclass
+class Pod:
+    meta: ObjectMeta
+    requests: Resources = field(default_factory=Resources)
+    node_selector: Dict[str, str] = field(default_factory=dict)
+    # Required node affinity: list of OR'd Requirements terms (each term AND'd inside).
+    required_affinity_terms: List[Requirements] = field(default_factory=list)
+    preferred_affinity_terms: List[Tuple[int, Requirements]] = field(default_factory=list)
+    tolerations: List[Toleration] = field(default_factory=list)
+    topology_spread: List[TopologySpreadConstraint] = field(default_factory=list)
+    affinity_terms: List[PodAffinityTerm] = field(default_factory=list)  # required only
+    priority: int = 0
+    node_name: Optional[str] = None  # bound node
+    phase: str = "Pending"
+    is_daemonset: bool = False
+
+    @property
+    def name(self) -> str:
+        return self.meta.name
+
+    def scheduling_requirement_terms(self) -> List[Requirements]:
+        """OR'd requirement terms: nodeSelector AND'd into each affinity term.
+
+        Mirrors how core's scheduler folds nodeSelector + requiredDuringScheduling
+        node affinity into scheduling requirements (website concepts/scheduling.md).
+        """
+        base = Requirements.from_labels(self.node_selector)
+        if not self.required_affinity_terms:
+            return [base]
+        return [base.intersect(term) for term in self.required_affinity_terms]
+
+    def deletion_cost(self) -> float:
+        try:
+            return float(self.meta.annotations.get("controller.kubernetes.io/pod-deletion-cost", 0))
+        except ValueError:
+            return 0.0
+
+    def is_pending(self) -> bool:
+        return self.phase == "Pending" and self.node_name is None
+
+    def owned(self) -> bool:
+        return self.meta.owner_kind is not None
+
+
+@dataclass
+class Node:
+    meta: ObjectMeta
+    provider_id: str = ""
+    capacity: Resources = field(default_factory=Resources)
+    allocatable: Resources = field(default_factory=Resources)
+    taints: List[Taint] = field(default_factory=list)
+    unschedulable: bool = False
+    ready: bool = False
+    machine_name: Optional[str] = None
+
+    @property
+    def name(self) -> str:
+        return self.meta.name
+
+    @property
+    def labels(self) -> Dict[str, str]:
+        return self.meta.labels
+
+    def zone(self) -> str:
+        return self.meta.labels.get(wk.ZONE, "")
+
+    def capacity_type(self) -> str:
+        return self.meta.labels.get(wk.CAPACITY_TYPE, wk.CAPACITY_TYPE_ON_DEMAND)
+
+    def instance_type(self) -> str:
+        return self.meta.labels.get(wk.INSTANCE_TYPE, "")
+
+    def provisioner_name(self) -> Optional[str]:
+        return self.meta.labels.get(wk.PROVISIONER_NAME)
+
+
+@dataclass
+class KubeletConfiguration:
+    """Per-provisioner kubelet tuning affecting allocatable + pod density.
+
+    Reference: provisioner CRD kubeletConfiguration
+    (karpenter.sh_provisioners.yaml) and its use in overhead math
+    (/root/reference/pkg/providers/instancetype/types.go:241-340).
+    """
+
+    cluster_dns: Optional[str] = None
+    max_pods: Optional[int] = None
+    pods_per_core: Optional[int] = None
+    kube_reserved: Optional[Resources] = None
+    system_reserved: Optional[Resources] = None
+    eviction_hard: Dict[str, str] = field(default_factory=dict)  # e.g. {"memory.available": "100Mi"}
+    eviction_soft: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class Provisioner:
+    """Pool definition: constraints + limits + deprovisioning policy.
+
+    Reference: Provisioner CRD spec (SURVEY §2.2; karpenter.sh_provisioners.yaml).
+    """
+
+    meta: ObjectMeta
+    requirements: Requirements = field(default_factory=Requirements)
+    labels: Dict[str, str] = field(default_factory=dict)
+    annotations: Dict[str, str] = field(default_factory=dict)
+    taints: List[Taint] = field(default_factory=list)
+    startup_taints: List[Taint] = field(default_factory=list)
+    kubelet: KubeletConfiguration = field(default_factory=KubeletConfiguration)
+    limits: Optional[Resources] = None  # cost/resource ceiling (designs/limits.md)
+    consolidation_enabled: bool = False
+    ttl_seconds_after_empty: Optional[int] = None
+    ttl_seconds_until_expired: Optional[int] = None
+    weight: int = 0
+    node_template_ref: Optional[str] = None
+
+    @property
+    def name(self) -> str:
+        return self.meta.name
+
+    def validate(self) -> None:
+        if self.consolidation_enabled and self.ttl_seconds_after_empty is not None:
+            raise ValueError(
+                f"provisioner {self.name}: consolidation.enabled and ttlSecondsAfterEmpty "
+                "are mutually exclusive"
+            )
+        for key in self.requirements.keys():
+            if key in wk.RESTRICTED_LABELS:
+                raise ValueError(f"provisioner {self.name}: restricted label {key}")
+
+
+@dataclass
+class MachineStatus:
+    provider_id: str = ""
+    capacity: Resources = field(default_factory=Resources)
+    allocatable: Resources = field(default_factory=Resources)
+    launched: bool = False
+    registered: bool = False
+    initialized: bool = False
+
+
+@dataclass
+class Machine:
+    """Intermediate machine object bridging scheduler decisions to cloud instances.
+
+    Reference: Machine CRD lifecycle launch -> registration -> initialization
+    (SURVEY §2.2; /root/reference/pkg/cloudprovider/cloudprovider.go:79-145).
+    """
+
+    meta: ObjectMeta
+    provisioner_name: str = ""
+    requirements: Requirements = field(default_factory=Requirements)
+    requests: Resources = field(default_factory=Resources)  # sum of scheduled pod requests
+    taints: List[Taint] = field(default_factory=list)
+    kubelet: KubeletConfiguration = field(default_factory=KubeletConfiguration)
+    node_template_ref: Optional[str] = None
+    status: MachineStatus = field(default_factory=MachineStatus)
+
+    @property
+    def name(self) -> str:
+        return self.meta.name
+
+
+@dataclass
+class BlockDeviceMapping:
+    device_name: str
+    volume_size_gib: int = 20
+    volume_type: str = "ssd"
+    encrypted: bool = True
+    delete_on_termination: bool = True
+
+
+@dataclass
+class NodeTemplate:
+    """Cloud/infra template resolved at launch time.
+
+    Cloud-neutral analogue of AWSNodeTemplate
+    (/root/reference/pkg/apis/v1alpha1/awsnodetemplate.go:50-77, provider.go:24-76):
+    image discovery by family or selector, network placement by selector, userdata,
+    block devices, tags. Status carries resolved concrete ids, maintained by the
+    nodetemplate controller (/root/reference/pkg/controllers/nodetemplate).
+    """
+
+    meta: ObjectMeta
+    image_family: str = "default"  # strategy name; reference amiFamily resolver.go:72-79
+    image_selector: Dict[str, str] = field(default_factory=dict)
+    subnet_selector: Dict[str, str] = field(default_factory=dict)
+    security_group_selector: Dict[str, str] = field(default_factory=dict)
+    instance_profile: Optional[str] = None
+    user_data: Optional[str] = None
+    tags: Dict[str, str] = field(default_factory=dict)
+    block_device_mappings: List[BlockDeviceMapping] = field(default_factory=list)
+    detailed_monitoring: bool = False
+    metadata_options: Dict[str, str] = field(default_factory=dict)
+    # status (resolved by the nodetemplate controller)
+    resolved_subnets: List[str] = field(default_factory=list)
+    resolved_security_groups: List[str] = field(default_factory=list)
+    resolved_images: List[str] = field(default_factory=list)
+
+    @property
+    def name(self) -> str:
+        return self.meta.name
+
+
+@dataclass
+class PodDisruptionBudget:
+    meta: ObjectMeta
+    selector: Dict[str, str] = field(default_factory=dict)
+    min_available: Optional[int] = None
+    max_unavailable: Optional[int] = None
+
+    def selects(self, pod: Pod) -> bool:
+        return all(pod.meta.labels.get(k) == v for k, v in self.selector.items())

@@ -1,0 +1,254 @@
+"""Stateful fake cloud provider.
+
+The backbone of the test pyramid, mirroring the reference's fake EC2
+(``/root/reference/pkg/fake/ec2api.go:39-150``): stateful launches, injectable
+insufficient-capacity pools (ICE), injectable next-call errors, and a generated
+instance-type catalog — so ICE fallback, unavailable-offering caching, and drift
+paths are exercisable hermetically.
+
+Launch semantics follow the reference's instance provider
+(``/root/reference/pkg/providers/instance/instance.go``): filter candidate types by
+requirement compatibility and resource fit, choose spot when the machine allows it
+and a spot offering exists (``:411-424``), order offerings by price (``:426-443``),
+skip offerings marked unavailable, and on ICE mark the offering in the
+unavailable-offerings cache and fall through to the next-cheapest (``:400-406``).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
+
+from ..api import labels as wk
+from ..api.objects import Machine, MachineStatus, Provisioner
+from ..api.requirements import Requirements
+from ..utils.cache import UnavailableOfferings
+from .catalog import generate_catalog
+from .interface import (
+    CloudProvider,
+    CloudProviderError,
+    InsufficientCapacityError,
+    Instance,
+    MachineNotFoundError,
+)
+from .types import InstanceType, Offering
+
+OfferingKey = Tuple[str, str, str]  # (instance_type, zone, capacity_type)
+
+
+class FakeCloudProvider(CloudProvider):
+    def __init__(
+        self,
+        catalog: Optional[List[InstanceType]] = None,
+        unavailable_offerings: Optional[UnavailableOfferings] = None,
+        max_instance_types: int = 60,
+    ):
+        self.catalog = catalog if catalog is not None else generate_catalog()
+        self._by_name = {it.name: it for it in self.catalog}
+        self.unavailable_offerings = unavailable_offerings or UnavailableOfferings()
+        # (type, zone, capacity_type) pools that will ICE on launch — the analogue of
+        # fake EC2's InsufficientCapacityPools (/root/reference/pkg/fake/ec2api.go:107-150).
+        self.insufficient_capacity_pools: Set[OfferingKey] = set()
+        self.next_errors: List[Exception] = []
+        self.instances: Dict[str, Instance] = {}
+        self.current_images: Dict[str, str] = {"default": "image-001"}
+        self.create_calls: List[Machine] = []
+        self.delete_calls: List[str] = []
+        self.launch_attempts = 0
+        self.max_instance_types = max_instance_types
+        self._id_counter = itertools.count(1)
+        self._lock = threading.Lock()
+
+    # -- test injection ----------------------------------------------------
+    def set_insufficient_capacity(self, instance_type: str, zone: str, capacity_type: str) -> None:
+        self.insufficient_capacity_pools.add((instance_type, zone, capacity_type))
+
+    def clear_insufficient_capacity(self) -> None:
+        self.insufficient_capacity_pools.clear()
+
+    def inject_next_error(self, error: Exception) -> None:
+        self.next_errors.append(error)
+
+    def rotate_image(self, family: str = "default") -> str:
+        """Advance the current image, making previously launched machines drifted."""
+        current = self.current_images.get(family, "image-000")
+        nxt = f"image-{int(current.rsplit('-', 1)[1]) + 1:03d}"
+        self.current_images[family] = nxt
+        return nxt
+
+    # -- CloudProvider -----------------------------------------------------
+    @property
+    def name(self) -> str:
+        return "fake"
+
+    def create(self, machine: Machine) -> Machine:
+        with self._lock:
+            if self.next_errors:
+                raise self.next_errors.pop(0)
+            self.create_calls.append(machine)
+            candidates = self._candidate_offerings(machine)
+            if not candidates:
+                raise InsufficientCapacityError(
+                    f"no compatible offerings for machine {machine.name}"
+                )
+            attempted: List[OfferingKey] = []
+            for it, offering in candidates:
+                key = (it.name, offering.zone, offering.capacity_type)
+                self.launch_attempts += 1
+                if key in self.insufficient_capacity_pools:
+                    # ICE: blacklist for 3m and fall through to next-cheapest
+                    # (instance.go:400-406).
+                    self.unavailable_offerings.mark_unavailable(*key, reason="ICE")
+                    attempted.append(key)
+                    continue
+                return self._launch(machine, it, offering)
+            raise InsufficientCapacityError(
+                f"all offerings exhausted for machine {machine.name}", offerings=attempted
+            )
+
+    def _candidate_offerings(
+        self, machine: Machine
+    ) -> List[Tuple[InstanceType, Offering]]:
+        reqs = machine.requirements
+        types = [
+            it
+            for it in self.catalog
+            if it.requirements.compatible(reqs) and machine.requests.fits(it.allocatable())
+        ]
+        # Capacity-type choice: spot when the machine allows it and any spot offering
+        # exists, else on-demand (instance.go:411-424).
+        ct_req = reqs.get(wk.CAPACITY_TYPE)
+        use_spot = ct_req.has(wk.CAPACITY_TYPE_SPOT) and any(
+            o.capacity_type == wk.CAPACITY_TYPE_SPOT and o.available
+            for it in types
+            for o in it.offerings
+        )
+        chosen_ct = wk.CAPACITY_TYPE_SPOT if use_spot else wk.CAPACITY_TYPE_ON_DEMAND
+        zone_req = reqs.get(wk.ZONE)
+        pairs: List[Tuple[InstanceType, Offering]] = []
+        for it in types:
+            for o in it.offerings:
+                if not o.available or o.capacity_type != chosen_ct:
+                    continue
+                if not zone_req.has(o.zone):
+                    continue
+                if self.unavailable_offerings.is_unavailable(it.name, o.zone, o.capacity_type):
+                    continue
+                pairs.append((it, o))
+        pairs.sort(key=lambda p: p[1].price)
+        # Reference truncates the launch request to the cheapest 60 types
+        # (instance.go:55,90-92); we bound offerings similarly.
+        return pairs[: self.max_instance_types]
+
+    def _launch(self, machine: Machine, it: InstanceType, offering: Offering) -> Machine:
+        instance_id = f"i-{next(self._id_counter):08d}"
+        image = self.current_images.get("default", "image-001")
+        instance = Instance(
+            id=instance_id,
+            instance_type=it.name,
+            zone=offering.zone,
+            capacity_type=offering.capacity_type,
+            image_id=image,
+            tags={wk.MANAGED_BY: "karpenter-tpu", wk.PROVISIONER_NAME: machine.provisioner_name},
+            created=time.time(),
+        )
+        self.instances[instance_id] = instance
+        machine.status = MachineStatus(
+            provider_id=f"fake:///{offering.zone}/{instance_id}",
+            capacity=it.capacity,
+            allocatable=it.allocatable(),
+            launched=True,
+        )
+        # Stamp concrete labels the node will carry (instanceToMachine,
+        # /root/reference/pkg/cloudprovider/cloudprovider.go:306-337).
+        machine.meta.labels.update(it.requirements.labels())
+        machine.meta.labels[wk.INSTANCE_TYPE] = it.name
+        machine.meta.labels[wk.ZONE] = offering.zone
+        machine.meta.labels[wk.CAPACITY_TYPE] = offering.capacity_type
+        machine.meta.labels[wk.PROVISIONER_NAME] = machine.provisioner_name
+        return machine
+
+    def delete(self, machine: Machine) -> None:
+        with self._lock:
+            instance_id = _instance_id(machine.status.provider_id)
+            self.delete_calls.append(instance_id)
+            if instance_id not in self.instances:
+                raise MachineNotFoundError(f"instance {instance_id} not found")
+            self.instances[instance_id].state = "terminated"
+            del self.instances[instance_id]
+
+    def get(self, provider_id: str) -> Machine:
+        with self._lock:
+            instance = self.instances.get(_instance_id(provider_id))
+            if instance is None:
+                raise MachineNotFoundError(f"{provider_id} not found")
+            return self._instance_to_machine(instance)
+
+    def list(self) -> List[Machine]:
+        with self._lock:
+            return [self._instance_to_machine(i) for i in self.instances.values()]
+
+    def get_instance_types(self, provisioner: Optional[Provisioner]) -> List[InstanceType]:
+        """Catalog filtered to the provisioner's requirements with current
+        availability masks applied (GetInstanceTypes + resolveInstanceTypes,
+        cloudprovider.go:155-170,254-273)."""
+        out: List[InstanceType] = []
+        for it in self.catalog:
+            if provisioner is not None and not it.requirements.compatible(provisioner.requirements):
+                continue
+            offerings = [
+                Offering(
+                    zone=o.zone,
+                    capacity_type=o.capacity_type,
+                    price=o.price,
+                    available=o.available
+                    and not self.unavailable_offerings.is_unavailable(
+                        it.name, o.zone, o.capacity_type
+                    ),
+                )
+                for o in it.offerings
+            ]
+            out.append(it.with_offerings(offerings))
+        return out
+
+    def is_machine_drifted(self, machine: Machine) -> bool:
+        """AMI drift: machine's image no longer the resolved image for its type
+        (isAMIDrifted, cloudprovider.go:207-236)."""
+        instance = self.instances.get(_instance_id(machine.status.provider_id))
+        if instance is None:
+            return False
+        return instance.image_id != self.current_images.get("default", "image-001")
+
+    def instance_for(self, machine: Machine) -> Optional[Instance]:
+        return self.instances.get(_instance_id(machine.status.provider_id))
+
+    def _instance_to_machine(self, instance: Instance) -> Machine:
+        it = self._by_name[instance.instance_type]
+        from ..api.objects import ObjectMeta
+
+        m = Machine(
+            meta=ObjectMeta(
+                name=instance.id,
+                labels={
+                    **it.requirements.labels(),
+                    wk.INSTANCE_TYPE: instance.instance_type,
+                    wk.ZONE: instance.zone,
+                    wk.CAPACITY_TYPE: instance.capacity_type,
+                    wk.PROVISIONER_NAME: instance.tags.get(wk.PROVISIONER_NAME, ""),
+                },
+            ),
+            provisioner_name=instance.tags.get(wk.PROVISIONER_NAME, ""),
+        )
+        m.status = MachineStatus(
+            provider_id=f"fake:///{instance.zone}/{instance.id}",
+            capacity=it.capacity,
+            allocatable=it.allocatable(),
+            launched=True,
+        )
+        return m
+
+
+def _instance_id(provider_id: str) -> str:
+    return provider_id.rsplit("/", 1)[-1]

@@ -1,0 +1,77 @@
+"""The CloudProvider seam between the engine and any cloud.
+
+Reference interface: ``/root/reference/pkg/cloudprovider/cloudprovider.go:79-205``
+(Create, Delete, Get, List, GetInstanceTypes, IsMachineDrifted, LivenessProbe, Name).
+Everything above this protocol (scheduler, controllers) is cloud-agnostic; everything
+below it talks to real or fake infrastructure.
+"""
+
+from __future__ import annotations
+
+import abc
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ..api.objects import Machine, Provisioner
+from .types import InstanceType
+
+
+class CloudProviderError(Exception):
+    pass
+
+
+class InsufficientCapacityError(CloudProviderError):
+    """All attempted offerings were unavailable (ICE).
+
+    Mirrors the reference's unfulfillable-capacity error taxonomy
+    (/root/reference/pkg/errors/errors.go:31-64)."""
+
+    def __init__(self, message: str, offerings: List[tuple] | None = None):
+        super().__init__(message)
+        self.offerings = offerings or []  # [(instance_type, zone, capacity_type)]
+
+
+class MachineNotFoundError(CloudProviderError):
+    pass
+
+
+@dataclass
+class Instance:
+    """A launched cloud instance (fake or real)."""
+
+    id: str
+    instance_type: str
+    zone: str
+    capacity_type: str
+    image_id: str = ""
+    state: str = "running"
+    tags: Dict[str, str] = field(default_factory=dict)
+    created: float = 0.0
+
+
+class CloudProvider(abc.ABC):
+    @abc.abstractmethod
+    def create(self, machine: Machine) -> Machine:
+        """Launch capacity satisfying the machine's requirements; fill status."""
+
+    @abc.abstractmethod
+    def delete(self, machine: Machine) -> None: ...
+
+    @abc.abstractmethod
+    def get(self, provider_id: str) -> Machine: ...
+
+    @abc.abstractmethod
+    def list(self) -> List[Machine]: ...
+
+    @abc.abstractmethod
+    def get_instance_types(self, provisioner: Optional[Provisioner]) -> List[InstanceType]: ...
+
+    @abc.abstractmethod
+    def is_machine_drifted(self, machine: Machine) -> bool: ...
+
+    def liveness_probe(self) -> bool:
+        return True
+
+    @property
+    def name(self) -> str:
+        return "unknown"

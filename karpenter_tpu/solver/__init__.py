@@ -1,0 +1,23 @@
+from .encode import EncodedProblem, ExistingNode, LaunchOption, PodGroup, build_options, encode, group_pods
+from .greedy import GreedyPacker
+from .result import NewNodeSpec, SolveResult
+from .solver import GreedySolver, Solver, TPUSolver, lower_bound
+from .validate import validate
+
+__all__ = [
+    "EncodedProblem",
+    "ExistingNode",
+    "LaunchOption",
+    "PodGroup",
+    "build_options",
+    "encode",
+    "group_pods",
+    "GreedyPacker",
+    "NewNodeSpec",
+    "SolveResult",
+    "GreedySolver",
+    "Solver",
+    "TPUSolver",
+    "lower_bound",
+    "validate",
+]

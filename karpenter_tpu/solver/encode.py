@@ -1,0 +1,377 @@
+"""Tensor encoders: pods / instance offerings / constraints -> device-ready arrays.
+
+This replaces the reference scheduler's per-pod object walk
+(``Scheduler.Solve()``, behavior at ``/root/reference/designs/bin-packing.md:16-43``)
+with a tensor encoding designed for the TPU:
+
+* Pending pods are **deduplicated into groups** by full scheduling signature
+  (requests, requirement terms, tolerations, spread, affinity, labels). Real fleets
+  are deployment-shaped, so 50k pods typically collapse to tens-hundreds of groups —
+  the solver scans groups, not pods, keeping the hot loop short and static-shaped.
+* Instance types × zones × capacity-types flatten into **launch options** with an
+  allocatable vector (minus daemonset overhead, as the reference accounts daemonsets
+  per candidate node), a price, and an availability mask (the ICE cache surfaces
+  here as unavailable offerings, ``/root/reference/pkg/cache/unavailableofferings.go``).
+* Constraint checks (requirements algebra, taints, zone) are precomputed into a
+  boolean ``compat[G, O]`` mask — the requirements set-algebra runs once on host,
+  never inside jit.
+
+Assignment-dependent constraints (topology spread, anti-affinity) become per-group
+scalar caps interpreted inside the packing scan (see ``jax_solver.py``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..api import labels as wk
+from ..api.objects import Node, Pod, Provisioner
+from ..api.requirements import Requirement, Requirements
+from ..api.resources import CPU, EPHEMERAL_STORAGE, MEMORY, PODS, Resources
+from ..api.taints import Taint, tolerates_all
+from ..cloudprovider.types import InstanceType
+
+BIG_CAP = 1 << 30  # "unlimited" per-node / per-zone count cap
+
+
+# ---------------------------------------------------------------------------
+# Pod grouping
+# ---------------------------------------------------------------------------
+
+@dataclass
+class PodGroup:
+    pods: List[Pod]
+    requests: Resources  # per-pod requests
+    terms: List[Requirements]  # OR'd requirement terms
+    tolerations: tuple
+    node_cap: int = BIG_CAP  # max pods of this group per node (hostname spread / anti-affinity)
+    zone_cap: int = BIG_CAP  # max pods of this group per zone (zone anti-affinity)
+    zone_skew: int = 0  # >0: zone topology-spread maxSkew (DoNotSchedule)
+    colocate: bool = False  # required self pod-affinity on hostname
+
+    @property
+    def count(self) -> int:
+        return len(self.pods)
+
+
+def _signature(pod: Pod) -> tuple:
+    terms = tuple(
+        tuple(sorted((r.key, r.complement, tuple(sorted(r.values)), r.greater_than, r.less_than)
+                     for r in term))
+        for term in pod.scheduling_requirement_terms()
+    )
+    return (
+        pod.requests,
+        terms,
+        tuple(sorted((t.key, t.operator, t.value, t.effect) for t in pod.tolerations)),
+        tuple(sorted((c.max_skew, c.topology_key, c.when_unsatisfiable,
+                      tuple(sorted(c.label_selector.items()))) for c in pod.topology_spread)),
+        tuple(sorted((t.topology_key, t.anti, tuple(sorted(t.label_selector.items())))
+                     for t in pod.affinity_terms)),
+        tuple(sorted(pod.meta.labels.items())),
+    )
+
+
+def group_pods(pods: Sequence[Pod]) -> List[PodGroup]:
+    """Deduplicate pods into scheduling-identical groups and derive the per-group
+    placement caps from spread/affinity constraints."""
+    buckets: Dict[tuple, List[Pod]] = {}
+    order: List[tuple] = []
+    for pod in pods:
+        sig = _signature(pod)
+        if sig not in buckets:
+            buckets[sig] = []
+            order.append(sig)
+        buckets[sig].append(pod)
+
+    groups: List[PodGroup] = []
+    for sig in order:
+        members = buckets[sig]
+        pod = members[0]
+        node_cap = BIG_CAP
+        zone_cap = BIG_CAP
+        zone_skew = 0
+        colocate = False
+        for c in pod.topology_spread:
+            if c.when_unsatisfiable != "DoNotSchedule" or not c.selects(pod):
+                continue
+            if c.topology_key == wk.HOSTNAME:
+                # Conservative: capping each node at maxSkew keeps |max-min| <= skew
+                # for any node population (min can stay 0 on fresh nodes).
+                node_cap = min(node_cap, max(1, c.max_skew))
+            elif c.topology_key == wk.ZONE:
+                zone_skew = max(zone_skew, c.max_skew)
+        for t in pod.affinity_terms:
+            if not t.selects(pod):
+                continue  # cross-group affinity handled only by the greedy fallback
+            if t.anti and t.topology_key == wk.HOSTNAME:
+                node_cap = min(node_cap, 1)
+            elif t.anti and t.topology_key == wk.ZONE:
+                # at most one pod of the group per zone
+                node_cap = min(node_cap, 1)
+                zone_cap = min(zone_cap, 1)
+            elif not t.anti and t.topology_key == wk.HOSTNAME:
+                colocate = True
+        groups.append(
+            PodGroup(
+                pods=members,
+                requests=pod.requests,
+                terms=pod.scheduling_requirement_terms(),
+                tolerations=tuple(pod.tolerations),
+                node_cap=node_cap,
+                zone_cap=zone_cap,
+                zone_skew=zone_skew,
+                colocate=colocate,
+            )
+        )
+    return groups
+
+
+# ---------------------------------------------------------------------------
+# Launch options
+# ---------------------------------------------------------------------------
+
+@dataclass
+class LaunchOption:
+    """One concrete way to open a node: (provisioner, instance type, zone, capacity type)."""
+
+    provisioner: Provisioner
+    instance_type: InstanceType
+    zone: str
+    capacity_type: str
+    price: float
+    node_requirements: Requirements  # label surface the resulting node will carry
+    taints: Tuple[Taint, ...]
+    allocatable: Resources  # after daemonset overhead
+
+
+def build_options(
+    provisioners: Sequence[Tuple[Provisioner, Sequence[InstanceType]]],
+    daemonsets: Sequence[Pod] = (),
+) -> List[LaunchOption]:
+    """Flatten (provisioner x instance type x available offering) into launch options.
+
+    The daemonset overhead of each option is subtracted up front, mirroring how the
+    reference's scheduler accounts daemonset resources per candidate node
+    (designs/bin-packing.md; website concepts/scheduling.md 'daemonsets').
+    """
+    options: List[LaunchOption] = []
+    for provisioner, instance_types in provisioners:
+        prov_reqs = provisioner.requirements.intersect(
+            Requirements.from_labels(provisioner.labels)
+        )
+        taints = tuple(provisioner.taints)
+        for it in instance_types:
+            merged = it.requirements.intersect(prov_reqs)
+            if merged.is_empty_any():
+                continue
+            for offering in it.offerings:
+                if not offering.available:
+                    continue
+                if not merged.get(wk.ZONE).has(offering.zone):
+                    continue
+                if not merged.get(wk.CAPACITY_TYPE).has(offering.capacity_type):
+                    continue
+                node_reqs = merged.intersect(
+                    Requirements(
+                        [
+                            Requirement.in_values(wk.ZONE, [offering.zone]),
+                            Requirement.in_values(wk.CAPACITY_TYPE, [offering.capacity_type]),
+                            Requirement.in_values(wk.PROVISIONER_NAME, [provisioner.name]),
+                        ]
+                    )
+                )
+                alloc = it.allocatable()
+                ds = _daemonset_overhead(daemonsets, node_reqs, taints, alloc)
+                options.append(
+                    LaunchOption(
+                        provisioner=provisioner,
+                        instance_type=it,
+                        zone=offering.zone,
+                        capacity_type=offering.capacity_type,
+                        price=offering.price,
+                        node_requirements=node_reqs,
+                        taints=taints,
+                        allocatable=(alloc - ds).clamp_min_zero(),
+                    )
+                )
+    return options
+
+
+def _daemonset_overhead(
+    daemonsets: Sequence[Pod], node_reqs: Requirements, taints: Tuple[Taint, ...], alloc: Resources
+) -> Resources:
+    total = Resources()
+    for ds in daemonsets:
+        if not tolerates_all(list(ds.tolerations), taints):
+            continue
+        if not any(node_reqs.compatible(term) for term in ds.scheduling_requirement_terms()):
+            continue
+        if not ds.requests.fits(alloc):
+            continue
+        total = total + ds.requests + Resources(pods=1)
+    return total
+
+
+# ---------------------------------------------------------------------------
+# Existing (in-flight) capacity
+# ---------------------------------------------------------------------------
+
+@dataclass
+class ExistingNode:
+    node: Node
+    remaining: Resources  # allocatable minus bound pod requests (incl. daemonsets)
+
+    @property
+    def name(self) -> str:
+        return self.node.name
+
+
+# ---------------------------------------------------------------------------
+# The encoded problem
+# ---------------------------------------------------------------------------
+
+@dataclass
+class EncodedProblem:
+    groups: List[PodGroup]
+    options: List[LaunchOption]
+    existing: List[ExistingNode]
+    resource_axes: List[str]
+    zones: List[str]
+    # arrays (numpy, host-side; the solver moves them to device)
+    demand: np.ndarray  # [G, R] float32, per-pod demand
+    count: np.ndarray  # [G] int32
+    alloc: np.ndarray  # [O, R] float32
+    price: np.ndarray  # [O] float32
+    opt_zone: np.ndarray  # [O] int32
+    compat: np.ndarray  # [G, O] bool
+    node_cap: np.ndarray  # [G] int32
+    zone_cap: np.ndarray  # [G] int32
+    zone_skew: np.ndarray  # [G] int32
+    colocate: np.ndarray  # [G] bool
+    ex_rem: np.ndarray  # [E, R] float32
+    ex_zone: np.ndarray  # [E] int32
+    ex_compat: np.ndarray  # [G, E] bool
+
+    @property
+    def G(self) -> int:
+        return len(self.groups)
+
+    @property
+    def O(self) -> int:
+        return len(self.options)
+
+    @property
+    def E(self) -> int:
+        return len(self.existing)
+
+
+def _resource_axes(groups: Sequence[PodGroup], options: Sequence[LaunchOption]) -> List[str]:
+    axes = [CPU, MEMORY, PODS]
+    extra = set()
+    for g in groups:
+        extra.update(g.requests.keys())
+    for axis in (EPHEMERAL_STORAGE,):
+        if axis in extra:
+            axes.append(axis)
+    for name in sorted(extra - set(axes) - {EPHEMERAL_STORAGE}):
+        axes.append(name)
+    return axes
+
+
+def _vector(r: Resources, axes: Sequence[str], pods: float = 0.0) -> np.ndarray:
+    v = np.array([r.get(a) for a in axes], dtype=np.float64)
+    pods_idx = axes.index(PODS)
+    v[pods_idx] = max(v[pods_idx], pods)
+    return v
+
+
+def encode(
+    pods: Sequence[Pod],
+    provisioners: Sequence[Tuple[Provisioner, Sequence[InstanceType]]],
+    existing: Sequence[ExistingNode] = (),
+    daemonsets: Sequence[Pod] = (),
+) -> EncodedProblem:
+    groups = group_pods(pods)
+    options = build_options(provisioners, daemonsets)
+
+    axes = _resource_axes(groups, options)
+    zones = sorted({o.zone for o in options} | {e.node.zone() for e in existing if e.node.zone()})
+    zone_index = {z: i for i, z in enumerate(zones)}
+
+    G, O, E, R = len(groups), len(options), len(existing), len(axes)
+    demand = np.zeros((G, R), dtype=np.float64)
+    count = np.zeros((G,), dtype=np.int32)
+    node_cap = np.zeros((G,), dtype=np.int64)
+    zone_cap = np.zeros((G,), dtype=np.int64)
+    zone_skew = np.zeros((G,), dtype=np.int32)
+    colocate = np.zeros((G,), dtype=bool)
+    for i, g in enumerate(groups):
+        demand[i] = _vector(g.requests, axes, pods=1.0)
+        count[i] = g.count
+        node_cap[i] = min(g.node_cap, BIG_CAP)
+        zone_cap[i] = min(g.zone_cap, BIG_CAP)
+        zone_skew[i] = g.zone_skew
+        colocate[i] = g.colocate
+
+    alloc = np.zeros((O, R), dtype=np.float64)
+    price = np.zeros((O,), dtype=np.float64)
+    opt_zone = np.zeros((O,), dtype=np.int32)
+    for j, o in enumerate(options):
+        alloc[j] = _vector(o.allocatable, axes)
+        price[j] = o.price
+        opt_zone[j] = zone_index[o.zone]
+
+    compat = np.zeros((G, O), dtype=bool)
+    for i, g in enumerate(groups):
+        per_pod = _vector(g.requests, axes, pods=1.0)
+        for j, o in enumerate(options):
+            if not tolerates_all(list(g.tolerations), o.taints):
+                continue
+            if not any(o.node_requirements.compatible(term) for term in g.terms):
+                continue
+            if np.any(per_pod > alloc[j] + 1e-9):
+                continue
+            compat[i, j] = True
+
+    ex_rem = np.zeros((E, R), dtype=np.float64)
+    ex_zone = np.zeros((E,), dtype=np.int32)
+    ex_compat = np.zeros((G, E), dtype=bool)
+    for k, e in enumerate(existing):
+        ex_rem[k] = _vector(e.remaining, axes)
+        ex_zone[k] = zone_index.get(e.node.zone(), 0)
+        node_reqs = Requirements.from_labels(e.node.labels)
+        for i, g in enumerate(groups):
+            if e.node.unschedulable:
+                continue
+            if not tolerates_all(list(g.tolerations), e.node.taints):
+                continue
+            if not any(node_reqs.compatible(term) for term in g.terms):
+                continue
+            if np.any(demand[i] > ex_rem[k] + 1e-9):
+                continue
+            ex_compat[i, k] = True
+
+    return EncodedProblem(
+        groups=groups,
+        options=options,
+        existing=list(existing),
+        resource_axes=axes,
+        zones=zones,
+        demand=demand.astype(np.float32),
+        count=count,
+        alloc=alloc.astype(np.float32),
+        price=price.astype(np.float32),
+        opt_zone=opt_zone,
+        compat=compat,
+        node_cap=np.minimum(node_cap, BIG_CAP).astype(np.int32),
+        zone_cap=np.minimum(zone_cap, BIG_CAP).astype(np.int32),
+        zone_skew=zone_skew,
+        colocate=colocate,
+        ex_rem=ex_rem.astype(np.float32),
+        ex_zone=ex_zone,
+        ex_compat=ex_compat,
+    )

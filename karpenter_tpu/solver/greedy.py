@@ -1,0 +1,175 @@
+"""Greedy first-fit-decreasing packer — the reference-semantics oracle.
+
+Implements the same algorithm the reference's ``Scheduler.Solve()`` runs
+(``/root/reference/designs/bin-packing.md:16-43``): pods sorted by dominant resource
+descending, first-fit onto existing in-flight capacity then already-opened new
+nodes, else open the cheapest feasible instance offering. Constraint checks
+(topology spread, pod (anti-)affinity) are evaluated exactly against the evolving
+assignment, which makes this packer the correctness oracle for the TPU backend and
+the fallback for constraint shapes the tensor path doesn't support.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..api import labels as wk
+from ..api.objects import Pod
+from .encode import EncodedProblem, LaunchOption, PodGroup
+from .result import NewNodeSpec, SolveResult
+
+
+@dataclass
+class _SimNode:
+    rem: np.ndarray  # remaining capacity [R]
+    zone: str
+    existing_name: Optional[str] = None  # set for in-flight nodes
+    option_index: Optional[int] = None  # set for new nodes
+    pods: List[Pod] = field(default_factory=list)
+
+    def host_id(self) -> str:
+        return self.existing_name or f"new-{id(self)}"
+
+
+def _dominant_size(demand_row: np.ndarray, norm: np.ndarray) -> float:
+    with np.errstate(divide="ignore", invalid="ignore"):
+        frac = np.where(norm > 0, demand_row / norm, 0.0)
+    return float(np.max(frac))
+
+
+class GreedyPacker:
+    def __init__(self, problem: EncodedProblem):
+        self.p = problem
+        self.nodes: List[_SimNode] = [
+            _SimNode(rem=problem.ex_rem[i].astype(np.float64).copy(), zone=e.node.zone() or "",
+                     existing_name=e.name)
+            for i, e in enumerate(problem.existing)
+        ]
+        self.n_existing = len(self.nodes)
+
+    # -- constraint checks against the evolving assignment ------------------
+    def _spread_ok(self, pod: Pod, node: _SimNode) -> bool:
+        for c in pod.topology_spread:
+            if c.when_unsatisfiable != "DoNotSchedule":
+                continue
+            # Zone domains include every zone in the problem (empty zones count 0);
+            # hostname domains always admit a fresh empty node, so min stays 0.
+            counts: Dict[str, int] = (
+                {z: 0 for z in self.p.zones} if c.topology_key == wk.ZONE else {}
+            )
+            for n in self.nodes:
+                key = n.host_id() if c.topology_key == wk.HOSTNAME else n.zone
+                counts.setdefault(key, 0)
+                counts[key] += sum(1 for q in n.pods if c.selects(q))
+            key = node.host_id() if c.topology_key == wk.HOSTNAME else node.zone
+            new_count = counts.get(key, 0) + 1
+            min_count = 0 if c.topology_key == wk.HOSTNAME else min(counts.values(), default=0)
+            if new_count - min_count > c.max_skew:
+                return False
+        return True
+
+    def _affinity_ok(self, pod: Pod, node: _SimNode) -> bool:
+        for term in pod.affinity_terms:
+            matching_domains = set()
+            any_match = False
+            for n in self.nodes:
+                if any(term.selects(q) for q in n.pods):
+                    any_match = True
+                    matching_domains.add(
+                        n.host_id() if term.topology_key == wk.HOSTNAME else n.zone
+                    )
+            key = node.host_id() if term.topology_key == wk.HOSTNAME else node.zone
+            if term.anti:
+                if key in matching_domains:
+                    return False
+            else:
+                # Required affinity: restrict to matching domains once one exists;
+                # the first matching pod bootstraps anywhere.
+                if any_match and key not in matching_domains:
+                    return False
+        return True
+
+    def _fits(self, demand: np.ndarray, node: _SimNode) -> bool:
+        return bool(np.all(demand <= node.rem + 1e-9))
+
+    def _try_place(self, pod: Pod, gi: int, demand: np.ndarray, node: _SimNode, ni: int) -> bool:
+        if node.existing_name is not None:
+            if not self.p.ex_compat[gi, ni]:  # existing nodes occupy indices [0, E)
+                return False
+        else:
+            if not self.p.compat[gi, node.option_index]:
+                return False
+        if not self._fits(demand, node):
+            return False
+        if not self._spread_ok(pod, node):
+            return False
+        if not self._affinity_ok(pod, node):
+            return False
+        node.rem -= demand
+        node.pods.append(pod)
+        return True
+
+    def solve(self) -> SolveResult:
+        p = self.p
+        # FFD order: dominant resource fraction, descending (bin-packing.md:28-43).
+        norm = p.alloc.max(axis=0) if p.O else np.ones(p.demand.shape[1])
+        norm = np.where(norm > 0, norm, 1.0)
+        pod_order: List[Tuple[float, int, Pod]] = []
+        for gi, g in enumerate(p.groups):
+            size = _dominant_size(p.demand[gi], norm)
+            for pod in g.pods:
+                pod_order.append((size, gi, pod))
+        pod_order.sort(key=lambda t: -t[0])
+
+        unschedulable: List[str] = []
+        # cheapest-first option order; larger capacity breaks price ties
+        opt_order = sorted(
+            range(p.O), key=lambda j: (p.price[j], -float(p.alloc[j].sum()))
+        )
+        for size, gi, pod in pod_order:
+            demand = p.demand[gi].astype(np.float64)
+            placed = False
+            for ni, node in enumerate(self.nodes):
+                if self._try_place(pod, gi, demand, node, ni):
+                    placed = True
+                    break
+            if placed:
+                continue
+            for j in opt_order:
+                if not p.compat[gi, j]:
+                    continue
+                node = _SimNode(
+                    rem=p.alloc[j].astype(np.float64).copy(),
+                    zone=p.options[j].zone,
+                    option_index=j,
+                )
+                # must pass all constraint checks on the fresh node too
+                self.nodes.append(node)
+                if self._try_place(pod, gi, demand, node, len(self.nodes) - 1):
+                    placed = True
+                    break
+                self.nodes.pop()
+            if not placed:
+                unschedulable.append(pod.name)
+
+        new_nodes = [
+            NewNodeSpec(option=p.options[n.option_index], pod_names=[q.name for q in n.pods])
+            for n in self.nodes[self.n_existing:]
+            if n.pods
+        ]
+        existing_assignments = {
+            n.existing_name: [q.name for q in n.pods]
+            for n in self.nodes[: self.n_existing]
+            if n.pods
+        }
+        cost = float(sum(s.price for s in new_nodes))
+        return SolveResult(
+            new_nodes=new_nodes,
+            existing_assignments=existing_assignments,
+            unschedulable=unschedulable,
+            cost=cost,
+            stats={"backend": 0.0, "nodes_opened": float(len(new_nodes))},
+        )

@@ -1,0 +1,265 @@
+"""TPU bin-packing kernel: grouped first-fit-decreasing with a vmapped portfolio.
+
+The reference packs pods one at a time in a single-threaded Go loop
+(``/root/reference/designs/bin-packing.md:16-43``). This kernel is the TPU-native
+redesign:
+
+* The scan runs over **pod groups** (deduplicated identical pods), not pods — one
+  step places an entire group's count across all open capacity with cumulative-sum
+  arithmetic, so 50k deployment pods cost tens of steps, not 50k.
+* Each step is fully vectorized over node slots and launch options (MXU/VPU
+  friendly, no data-dependent Python control flow — ``lax.scan`` only).
+* A **portfolio** of packing strategies (group orderings × option-scoring
+  exponents) runs under ``vmap``; the cheapest feasible member wins. This is the
+  embarrassingly-parallel search SURVEY §7.3 calls for, and the axis that shards
+  across TPU cores (see ``karpenter_tpu.parallel``).
+* Solving is two-phase: phase 1 evaluates the whole portfolio returning cost only;
+  phase 2 re-runs the single winning member emitting per-slot assignments. This
+  keeps peak memory at O(S) instead of O(K·G·S).
+
+Topology constraints enter as per-group caps computed by the encoder: ``node_cap``
+(hostname spread / anti-affinity), ``zone_skew`` (zone spread quotas), ``colocate``
+(self pod-affinity). Zone quotas are enforced with per-zone prefix sums (zones are
+a small static axis, unrolled).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+INF = jnp.float32(1e30)
+IBIG = jnp.int32(1 << 30)
+UNPLACED_PENALTY = jnp.float32(1e6)  # per-pod cost penalty for infeasible members
+
+
+class PackInputs(NamedTuple):
+    demand: jax.Array  # [G, R] f32 per-pod demand (normalized)
+    count: jax.Array  # [G] i32
+    node_cap: jax.Array  # [G] i32
+    zone_cap: jax.Array  # [G] i32
+    zone_skew: jax.Array  # [G] i32
+    colocate: jax.Array  # [G] bool
+    compat: jax.Array  # [G, O] bool
+    alloc: jax.Array  # [O, R] f32 (normalized)
+    price: jax.Array  # [O] f32
+    opt_zone: jax.Array  # [O] i32
+    opt_valid: jax.Array  # [O] bool
+    ex_rem: jax.Array  # [E, R] f32 (normalized)
+    ex_zone: jax.Array  # [E] i32
+    ex_compat: jax.Array  # [G, E] bool
+    ex_valid: jax.Array  # [E] bool
+
+
+def _units(rem: jax.Array, d: jax.Array) -> jax.Array:
+    """How many whole pods of per-pod demand d fit in each remaining vector."""
+    safe = jnp.where(d > 0, rem / jnp.maximum(d, 1e-30), INF)
+    u = jnp.floor(jnp.min(safe, axis=-1) + 1e-4)
+    return jnp.clip(u, 0, IBIG).astype(jnp.int32)
+
+
+def _greedy_fill(fit: jax.Array, want: jax.Array) -> jax.Array:
+    """Place `want` units into slots front-to-back given per-slot capacity `fit`."""
+    before = jnp.cumsum(fit) - fit
+    return jnp.clip(want - before, 0, fit)
+
+
+def _apply_zone_quota(
+    fit: jax.Array, zone: jax.Array, quota: jax.Array, n_zones: int, enabled: jax.Array
+) -> jax.Array:
+    """Cap per-zone cumulative placement at ``quota[z]``."""
+    out = fit
+    for z in range(n_zones):  # static unroll; Z is small
+        mask = zone == z
+        zfit = jnp.where(mask, out, 0)
+        before = jnp.cumsum(zfit) - zfit
+        allow = jnp.clip(quota[z] - before, 0, out)
+        out = jnp.where(mask & enabled, jnp.minimum(out, allow), out)
+    return out
+
+
+def _pack_one(
+    inputs: PackInputs,
+    order: jax.Array,  # [G] permutation of group indices
+    alpha: jax.Array,  # scalar: option score exponent
+    s_new: int,
+    n_zones: int,
+    with_assignments: bool,
+):
+    G, R = inputs.demand.shape
+    O = inputs.price.shape[0]
+    E = inputs.ex_rem.shape[0]
+
+    new_rem0 = jnp.zeros((s_new, R), jnp.float32)
+    new_opt0 = jnp.full((s_new,), -1, jnp.int32)
+    new_active0 = jnp.zeros((s_new,), bool)
+
+    def step(carry, g):
+        ex_rem, new_rem, new_opt, new_active, unplaced, exhausted = carry
+        d = inputs.demand[g]
+        cnt = inputs.count[g]
+        cap = inputs.node_cap[g]
+        zcap = inputs.zone_cap[g]
+        skew = inputs.zone_skew[g]
+        coloc = inputs.colocate[g]
+        spread = skew > 0
+        zone_limited = spread | (zcap < IBIG)
+
+        # Zones that could host this group at all (for the quota denominator).
+        zones_avail = jnp.zeros((n_zones,), bool)
+        opt_ok_any = inputs.opt_valid & inputs.compat[g]
+        for z in range(n_zones):
+            has_opt = jnp.any(opt_ok_any & (inputs.opt_zone == z))
+            has_ex = jnp.any(inputs.ex_valid & inputs.ex_compat[g] & (inputs.ex_zone == z))
+            zones_avail = zones_avail.at[z].set(has_opt | has_ex)
+        n_avail = jnp.maximum(jnp.sum(zones_avail.astype(jnp.int32)), 1)
+        # Exact equal split across available zones: the first (cnt % n) zones take
+        # ceil(cnt/n), the rest floor(cnt/n) — |max-min| <= 1 <= any maxSkew.
+        rank = jnp.cumsum(zones_avail.astype(jnp.int32)) - 1  # [Z]
+        equal_quota = cnt // n_avail + (rank < (cnt % n_avail)).astype(jnp.int32)
+        equal_quota = jnp.where(zones_avail, equal_quota, 0)
+        quota = jnp.where(spread, equal_quota, IBIG)
+        quota = jnp.minimum(quota, zcap)  # zone anti-affinity cap
+
+        # ---- capacity of already-open slots (existing first, then new) ----
+        fit_e = _units(ex_rem, d)
+        ok_e = inputs.ex_valid & inputs.ex_compat[g]
+        fit_e = jnp.where(ok_e, jnp.minimum(fit_e, cap), 0)
+
+        opt_idx = jnp.clip(new_opt, 0, O - 1)
+        ok_n = new_active & inputs.compat[g, opt_idx] & (new_opt >= 0)
+        fit_n = jnp.where(ok_n, jnp.minimum(_units(new_rem, d), cap), 0)
+
+        all_fit = jnp.concatenate([fit_e, fit_n])
+        new_zone = inputs.opt_zone[opt_idx]
+        all_zone = jnp.concatenate([inputs.ex_zone, new_zone])
+        all_fit = _apply_zone_quota(all_fit, all_zone, quota, n_zones, zone_limited)
+        # Colocation: the whole group must land on one node.
+        all_fit = jnp.where(coloc, jnp.where(all_fit >= cnt, cnt, 0), all_fit)
+
+        place = _greedy_fill(all_fit, cnt)
+        left = cnt - jnp.sum(place)
+        place_e, place_n = place[:E], place[E:]
+        ex_rem = ex_rem - place_e[:, None].astype(jnp.float32) * d
+        new_rem = new_rem - place_n[:, None].astype(jnp.float32) * d
+        placed_z = jnp.zeros((n_zones,), jnp.int32)
+        for z in range(n_zones):
+            placed_z = placed_z.at[z].set(jnp.sum(jnp.where(all_zone == z, place, 0)))
+
+        # ---- open fresh nodes ------------------------------------------
+        units_o = _units(inputs.alloc, d)
+        units_o = jnp.minimum(units_o, cap)
+        units_o = jnp.where(opt_ok_any, units_o, 0)
+        units_o = jnp.where(coloc, jnp.where(units_o >= cnt, units_o, 0), units_o)
+        usable = units_o > 0
+        # Score: price per pod-slot, with a portfolio-varied exponent that trades
+        # "cheapest absolute node" against "cheapest per unit".
+        score = inputs.price / jnp.power(jnp.maximum(units_o, 1).astype(jnp.float32), alpha)
+        score = jnp.where(usable, score, INF)
+
+        new_place_acc = jnp.zeros((s_new,), jnp.int32)
+
+        def open_pass(state, zone_restrict, enabled):
+            new_rem, new_opt, new_active, left, placed_z, new_place_acc = state
+            if zone_restrict is None:
+                pass_score = score
+                want_cap = IBIG
+            else:
+                pass_score = jnp.where(inputs.opt_zone == zone_restrict, score, INF)
+                want_cap = jnp.maximum(quota[zone_restrict] - placed_z[zone_restrict], 0)
+            o = jnp.argmin(pass_score)
+            c = units_o[o]
+            feasible = enabled & (pass_score[o] < INF) & (left > 0)
+            want = jnp.where(feasible, jnp.minimum(left, want_cap), 0)
+            k = jnp.where(c > 0, -(-want // jnp.maximum(c, 1)), 0)  # ceil
+            free_rank = jnp.cumsum((~new_active).astype(jnp.int32)) * (~new_active)
+            take = (~new_active) & (free_rank >= 1) & (free_rank <= k)
+            idx = jnp.maximum(free_rank - 1, 0)
+            per_slot = jnp.clip(want - idx * c, 0, c) * take
+            new_rem = jnp.where(
+                take[:, None], inputs.alloc[o] - per_slot[:, None].astype(jnp.float32) * d, new_rem
+            )
+            new_opt = jnp.where(take, o, new_opt)
+            new_active = new_active | take
+            opened_total = jnp.sum(per_slot)
+            left = left - opened_total
+            if zone_restrict is not None:
+                placed_z = placed_z.at[zone_restrict].add(opened_total)
+            new_place_acc = new_place_acc + per_slot
+            return (new_rem, new_opt, new_active, left, placed_z, new_place_acc)
+
+        state = (new_rem, new_opt, new_active, left, placed_z, new_place_acc)
+        for z in range(n_zones):  # zone-limited groups: fill zones under quota
+            state = open_pass(state, z, zone_limited)
+        state = open_pass(state, None, ~zone_limited)  # others: one best option
+        new_rem, new_opt, new_active, left, placed_z, new_place_acc = state
+
+        unplaced = unplaced + left
+        # Leftover with every slot in use = slot exhaustion (host grows S and
+        # retries); leftover with free slots = genuine infeasibility.
+        exhausted = exhausted | ((left > 0) & jnp.all(new_active))
+        carry = (ex_rem, new_rem, new_opt, new_active, unplaced, exhausted)
+        if with_assignments:
+            ys = jnp.concatenate([place_e, place_n + new_place_acc])
+        else:
+            ys = left
+        return carry, ys
+
+    carry0 = (inputs.ex_rem, new_rem0, new_opt0, new_active0, jnp.int32(0), jnp.bool_(False))
+    carry, ys = lax.scan(step, carry0, order)
+    ex_rem, new_rem, new_opt, new_active, unplaced, exhausted = carry
+    node_prices = jnp.where(new_active, inputs.price[jnp.clip(new_opt, 0, O - 1)], 0.0)
+    cost = jnp.sum(node_prices) + unplaced.astype(jnp.float32) * UNPLACED_PENALTY
+    if with_assignments:
+        return cost, unplaced, new_opt, new_active, ys  # ys: [G, E+S] in scan order
+    return cost, unplaced, exhausted
+
+
+@functools.partial(jax.jit, static_argnames=("s_new", "n_zones"))
+def pack_portfolio_cost(
+    inputs: PackInputs, orders: jax.Array, alphas: jax.Array, s_new: int, n_zones: int
+):
+    """Phase 1: run every member, return (costs[K], unplaced[K], exhausted[K])."""
+    fn = functools.partial(
+        _pack_one, s_new=s_new, n_zones=n_zones, with_assignments=False
+    )
+    return jax.vmap(lambda o, a: fn(inputs, o, a))(orders, alphas)
+
+
+@functools.partial(jax.jit, static_argnames=("s_new", "n_zones"))
+def pack_single_assign(
+    inputs: PackInputs, order: jax.Array, alpha: jax.Array, s_new: int, n_zones: int
+):
+    """Phase 2: re-run the winning member emitting assignments."""
+    return _pack_one(inputs, order, alpha, s_new, n_zones, with_assignments=True)
+
+
+def make_orders(
+    sizes: np.ndarray, count: np.ndarray, k: int, seed: int = 0
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Portfolio construction: K group orderings × option-score exponents.
+
+    Member 0 is plain FFD (size-descending). Other members perturb the ordering
+    with multiplicative noise and sweep the score exponent, covering
+    cheapest-per-unit (alpha=1) through cheapest-absolute (alpha->0) strategies.
+    """
+    g = sizes.shape[0]
+    rng = np.random.default_rng(seed)
+    orders = np.empty((k, g), dtype=np.int32)
+    alphas = np.empty((k,), dtype=np.float32)
+    base_alphas = [1.0, 0.85, 1.0, 0.7, 1.15, 1.0, 0.9, 1.05]
+    for i in range(k):
+        if i == 0:
+            key = -sizes
+        elif i == 1:
+            key = -sizes * count  # total-footprint descending
+        else:
+            key = -sizes * rng.uniform(0.6, 1.4, size=g)
+        orders[i] = np.argsort(key, kind="stable").astype(np.int32)
+        alphas[i] = base_alphas[i % len(base_alphas)]
+    return orders, alphas

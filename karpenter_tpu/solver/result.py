@@ -1,0 +1,41 @@
+"""Solver request/result types shared by every backend."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ..api.objects import Pod
+from .encode import EncodedProblem, LaunchOption
+
+
+@dataclass
+class NewNodeSpec:
+    """A node the solver decided to launch, with its pod placement."""
+
+    option: LaunchOption
+    pod_names: List[str] = field(default_factory=list)
+
+    @property
+    def instance_type_name(self) -> str:
+        return self.option.instance_type.name
+
+    @property
+    def price(self) -> float:
+        return self.option.price
+
+
+@dataclass
+class SolveResult:
+    new_nodes: List[NewNodeSpec] = field(default_factory=list)
+    # existing node name -> newly assigned pod names
+    existing_assignments: Dict[str, List[str]] = field(default_factory=dict)
+    unschedulable: List[str] = field(default_factory=list)
+    cost: float = 0.0  # total hourly price of new nodes
+    stats: Dict[str, float] = field(default_factory=dict)
+
+    @property
+    def scheduled_count(self) -> int:
+        return sum(len(n.pod_names) for n in self.new_nodes) + sum(
+            len(v) for v in self.existing_assignments.values()
+        )

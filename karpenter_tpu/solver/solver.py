@@ -1,0 +1,317 @@
+"""Solver backends behind one interface.
+
+The reference hard-codes one greedy packer inside its provisioning controller; here
+``Solver`` is a seam (the BASELINE north star's ``scheduling.Solver`` plugin
+interface) with two backends:
+
+* ``GreedySolver`` — the reference-semantics oracle (``greedy.py``), exact
+  constraint handling, used for differential testing and as fallback.
+* ``TPUSolver`` — encodes to tensors, runs the vmapped portfolio kernel
+  (``jax_solver.py``) under jit, decodes, and **validates** the result; any
+  violation or unsupported constraint shape falls back to the oracle, so the TPU
+  path can never strand a pod (SURVEY §7.3).
+"""
+
+from __future__ import annotations
+
+import abc
+import math
+import time
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..api.objects import Pod, Provisioner
+from ..cloudprovider.types import InstanceType
+from .encode import EncodedProblem, ExistingNode, LaunchOption, encode
+from .greedy import GreedyPacker
+from .jax_solver import PackInputs, make_orders, pack_portfolio_cost, pack_single_assign
+from .result import NewNodeSpec, SolveResult
+from .validate import validate
+
+
+def _next_pow2(n: int, floor: int = 8) -> int:
+    return max(floor, 1 << (max(n, 1) - 1).bit_length())
+
+
+def lower_bound(problem: EncodedProblem) -> float:
+    """Fractional-covering lower bound on new-node cost: for each resource axis,
+    cost >= leftover_demand_r * min_o price_o / alloc_{o,r}. Ignoring constraints
+    and integrality keeps it a true bound; used for the >=95%-of-optimal metric."""
+    if problem.O == 0 or problem.G == 0:
+        return 0.0
+    total = (problem.demand * problem.count[:, None]).sum(axis=0)
+    # capacity already available for free on existing nodes
+    free = problem.ex_rem.sum(axis=0) if problem.E else 0.0
+    leftover = np.maximum(total - free, 0.0)
+    best = 0.0
+    for r in range(len(problem.resource_axes)):
+        caps = problem.alloc[:, r]
+        ok = caps > 0
+        if not np.any(ok) or leftover[r] <= 0:
+            continue
+        rate = float(np.min(problem.price[ok] / caps[ok]))
+        best = max(best, leftover[r] * rate)
+    return best
+
+
+class Solver(abc.ABC):
+    @abc.abstractmethod
+    def solve(self, problem: EncodedProblem) -> SolveResult: ...
+
+    def solve_pods(
+        self,
+        pods: Sequence[Pod],
+        provisioners: Sequence[Tuple[Provisioner, Sequence[InstanceType]]],
+        existing: Sequence[ExistingNode] = (),
+        daemonsets: Sequence[Pod] = (),
+    ) -> SolveResult:
+        t0 = time.perf_counter()
+        problem = encode(pods, provisioners, existing, daemonsets)
+        t1 = time.perf_counter()
+        result = self.solve(problem)
+        result.stats["encode_s"] = t1 - t0
+        result.stats["total_s"] = time.perf_counter() - t0
+        result.stats["lower_bound"] = lower_bound(problem)
+        return result
+
+
+class GreedySolver(Solver):
+    """Reference-semantics FFD (single ordering, host CPU)."""
+
+    def solve(self, problem: EncodedProblem) -> SolveResult:
+        t0 = time.perf_counter()
+        result = GreedyPacker(problem).solve()
+        result.stats["solve_s"] = time.perf_counter() - t0
+        result.stats["backend"] = 0.0
+        return result
+
+
+def _has_cross_group_constraints(problem: EncodedProblem) -> bool:
+    """True when a spread/affinity selector reaches across pod groups — the tensor
+    path models those constraints per-group, so such problems use the oracle."""
+    groups = problem.groups
+    for gi, g in enumerate(groups):
+        rep = g.pods[0]
+        selectors = [c.label_selector for c in rep.topology_spread] + [
+            t.label_selector for t in rep.affinity_terms
+        ]
+        for sel in selectors:
+            if not sel:
+                continue
+            for gj, other in enumerate(groups):
+                if gi == gj:
+                    continue
+                if all(other.pods[0].meta.labels.get(k) == v for k, v in sel.items()):
+                    return True
+        # cross-group required affinity on another group's labels
+        for t in rep.affinity_terms:
+            if not t.anti and not t.selects(rep):
+                return True
+    return False
+
+
+class TPUSolver(Solver):
+    """Portfolio FFD on TPU (or any JAX backend) with validation + fallback."""
+
+    def __init__(self, portfolio: int = 8, seed: int = 0, max_slots: int = 1 << 15):
+        self.portfolio = portfolio
+        self.seed = seed
+        self.max_slots = max_slots
+        self._fallback = GreedySolver()
+
+    def solve(self, problem: EncodedProblem) -> SolveResult:
+        t0 = time.perf_counter()
+        if problem.G == 0:
+            return SolveResult(stats={"backend": 1.0})
+        if problem.O == 0 and problem.E == 0:
+            return SolveResult(
+                unschedulable=[p.name for g in problem.groups for p in g.pods],
+                stats={"backend": 1.0},
+            )
+        if _has_cross_group_constraints(problem):
+            result = self._fallback.solve(problem)
+            result.stats["fallback"] = 1.0
+            return result
+
+        inputs, orders, alphas, s_new, n_zones = self._prepare(problem)
+        import jax.numpy as jnp
+
+        while True:
+            costs, unplaced, exhausted = pack_portfolio_cost(
+                inputs, jnp.asarray(orders), jnp.asarray(alphas), s_new, n_zones
+            )
+            costs = np.asarray(costs)
+            unplaced = np.asarray(unplaced)
+            exhausted = np.asarray(exhausted)
+            # Grow S only when members actually ran out of slots; leftover pods
+            # with free slots are genuinely unschedulable and re-running can't help.
+            if exhausted.any() and unplaced.min() > 0 and s_new < self.max_slots:
+                s_new *= 2
+                continue
+            break
+        best = int(np.argmin(costs))
+        _, _, new_opt, new_active, ys = pack_single_assign(
+            inputs, jnp.asarray(orders[best]), jnp.asarray(alphas[best]), s_new, n_zones
+        )
+        t_solve = time.perf_counter() - t0
+        result = self._decode(
+            problem, np.asarray(orders[best]), np.asarray(new_opt), np.asarray(new_active),
+            np.asarray(ys),
+        )
+        result.stats["solve_s"] = t_solve
+        result.stats["backend"] = 1.0
+        result.stats["portfolio_best"] = float(best)
+        violations = validate(problem, result)
+        if violations:
+            fallback = self._fallback.solve(problem)
+            fallback.stats["fallback"] = 1.0
+            fallback.stats["tpu_violations"] = float(len(violations))
+            return fallback
+        return result
+
+    # -- encoding to device-ready padded arrays -----------------------------
+    def _prepare(self, problem: EncodedProblem):
+        G, O, E, R = problem.G, problem.O, problem.E, len(problem.resource_axes)
+        Gp = _next_pow2(G)
+        Op = _next_pow2(O)
+        Ep = max(E, 1)
+        n_zones = max(len(problem.zones), 1)
+
+        scale = problem.alloc.max(axis=0) if O else np.ones(R, np.float32)
+        if E:
+            scale = np.maximum(scale, problem.ex_rem.max(axis=0))
+        scale = np.where(scale > 0, scale, 1.0).astype(np.float32)
+
+        demand = np.zeros((Gp, R), np.float32)
+        demand[:G] = problem.demand / scale
+        count = np.zeros((Gp,), np.int32)
+        count[:G] = problem.count
+        node_cap = np.full((Gp,), 1 << 30, np.int32)
+        node_cap[:G] = problem.node_cap
+        zone_cap = np.full((Gp,), 1 << 30, np.int32)
+        zone_cap[:G] = problem.zone_cap
+        zone_skew = np.zeros((Gp,), np.int32)
+        zone_skew[:G] = problem.zone_skew
+        colocate = np.zeros((Gp,), bool)
+        colocate[:G] = problem.colocate
+        compat = np.zeros((Gp, Op), bool)
+        compat[:G, :O] = problem.compat
+        alloc = np.zeros((Op, R), np.float32)
+        price = np.full((Op,), np.float32(1e30))
+        opt_zone = np.zeros((Op,), np.int32)
+        opt_valid = np.zeros((Op,), bool)
+        ex_rem = np.zeros((Ep, R), np.float32)
+        ex_zone = np.zeros((Ep,), np.int32)
+        ex_valid = np.zeros((Ep,), bool)
+        ex_compat = np.zeros((Gp, Ep), bool)
+        if E:
+            ex_rem[:E] = problem.ex_rem / scale
+            ex_zone[:E] = problem.ex_zone
+            ex_valid[:E] = True
+            ex_compat[:G, :E] = problem.ex_compat
+
+        alloc[:O] = problem.alloc / scale
+        price[:O] = problem.price
+        opt_zone[:O] = problem.opt_zone
+        opt_valid[:O] = True
+        inputs = PackInputs(
+            demand=demand,
+            count=count,
+            node_cap=node_cap,
+            zone_cap=zone_cap,
+            zone_skew=zone_skew,
+            colocate=colocate,
+            compat=compat,
+            alloc=alloc,
+            price=price,
+            opt_zone=opt_zone,
+            opt_valid=opt_valid,
+            ex_rem=ex_rem,
+            ex_zone=ex_zone,
+            ex_compat=ex_compat,
+            ex_valid=ex_valid,
+        )
+
+        sizes = np.zeros((Gp,), np.float64)
+        sizes[:G] = (problem.demand / scale).max(axis=1)
+        orders, alphas = make_orders(sizes, count.astype(np.float64), self.portfolio, self.seed)
+
+        s_new = self._estimate_slots(problem)
+        return inputs, orders, alphas, s_new, n_zones
+
+    def _estimate_slots(self, problem: EncodedProblem) -> int:
+        if problem.O == 0:
+            return 8
+        # Per-group upper-ish estimate: nodes if each group used its best-capacity
+        # compatible option alone; doubled for portfolio variance, pow2-bucketed.
+        total = 0
+        units_all = np.zeros((problem.G, problem.O), np.float64)
+        with np.errstate(divide="ignore", invalid="ignore", over="ignore"):
+            for r in range(len(problem.resource_axes)):
+                d = problem.demand[:, r : r + 1]
+                c = problem.alloc[:, r][None, :]
+                frac = np.where(d > 0, np.floor(np.where(d > 0, c / np.maximum(d, 1e-30), np.inf)), np.inf)
+                units_all = frac if r == 0 else np.minimum(units_all, frac)
+        for gi in range(problem.G):
+            ok = problem.compat[gi]
+            if not np.any(ok):
+                continue
+            best_units = np.max(np.where(ok, units_all[gi], 0))
+            if best_units > 0:
+                total += math.ceil(problem.count[gi] / best_units)
+        return min(_next_pow2(int(total * 2) + 8, floor=16), self.max_slots)
+
+    # -- decode --------------------------------------------------------------
+    def _decode(
+        self,
+        problem: EncodedProblem,
+        order: np.ndarray,
+        new_opt: np.ndarray,
+        new_active: np.ndarray,
+        ys: np.ndarray,
+    ) -> SolveResult:
+        E = problem.E
+        Ep = max(E, 1)
+        s_new = new_opt.shape[0]
+        # slot -> list of pod names
+        new_pods: List[List[str]] = [[] for _ in range(s_new)]
+        existing_assignments = {}
+        unschedulable: List[str] = []
+        for t in range(ys.shape[0]):
+            g = int(order[t])
+            if g >= problem.G:
+                continue
+            group = problem.groups[g]
+            cursor = 0
+            row = ys[t]
+            for s in range(Ep + s_new):
+                n = int(row[s])
+                if n <= 0:
+                    continue
+                names = [p.name for p in group.pods[cursor : cursor + n]]
+                cursor += n
+                if s < Ep:
+                    if s < E:
+                        key = problem.existing[s].name
+                        existing_assignments.setdefault(key, []).extend(names)
+                else:
+                    new_pods[s - Ep].extend(names)
+            if cursor < group.count:
+                unschedulable.extend(p.name for p in group.pods[cursor:])
+
+        new_nodes = []
+        cost = 0.0
+        for s in range(s_new):
+            if not new_active[s] or not new_pods[s]:
+                continue
+            option = problem.options[int(new_opt[s])]
+            new_nodes.append(NewNodeSpec(option=option, pod_names=new_pods[s]))
+            cost += option.price
+        return SolveResult(
+            new_nodes=new_nodes,
+            existing_assignments=existing_assignments,
+            unschedulable=unschedulable,
+            cost=cost,
+            stats={"nodes_opened": float(len(new_nodes))},
+        )

@@ -1,0 +1,141 @@
+"""Solution feasibility validator.
+
+The invariant gate for every solver backend: capacity never exceeded, every
+placement compatible (requirements + taints), topology spread skew respected,
+anti-affinity/colocation honored. The TPU backend's output is validated before any
+machine is launched; a violation falls the request back to the greedy oracle
+(SURVEY §7.3 "consolidation correctness — never strand a pod").
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import Dict, List, Sequence
+
+import numpy as np
+
+from ..api import labels as wk
+from ..api.objects import Pod
+from .encode import EncodedProblem
+from .result import SolveResult
+
+
+def validate(problem: EncodedProblem, result: SolveResult) -> List[str]:
+    """Returns a list of violation descriptions; empty means feasible."""
+    violations: List[str] = []
+    pod_by_name: Dict[str, tuple] = {}
+    for gi, g in enumerate(problem.groups):
+        for pod in g.pods:
+            pod_by_name[pod.name] = (gi, pod)
+
+    # host -> (zone, [(gi, pod)]) for every placement
+    placements: List[tuple] = []  # (host_id, zone, gi, pod)
+
+    # -- new nodes: capacity + compat -----------------------------------
+    for idx, spec in enumerate(result.new_nodes):
+        j = problem.options.index(spec.option)
+        used = np.zeros(len(problem.resource_axes), dtype=np.float64)
+        host = f"new-{idx}"
+        for name in spec.pod_names:
+            if name not in pod_by_name:
+                violations.append(f"unknown pod {name} on {host}")
+                continue
+            gi, pod = pod_by_name[name]
+            if not problem.compat[gi, j]:
+                violations.append(f"pod {name} incompatible with option {j} on {host}")
+            used += problem.demand[gi]
+            placements.append((host, spec.option.zone, gi, pod))
+        over = used > problem.alloc[j] + 1e-6
+        if np.any(over):
+            axes = [problem.resource_axes[k] for k in np.where(over)[0]]
+            violations.append(f"{host} over capacity on {axes}")
+
+    # -- existing nodes: remaining capacity + compat --------------------
+    ex_index = {e.name: i for i, e in enumerate(problem.existing)}
+    for node_name, names in result.existing_assignments.items():
+        if node_name not in ex_index:
+            violations.append(f"unknown existing node {node_name}")
+            continue
+        k = ex_index[node_name]
+        used = np.zeros(len(problem.resource_axes), dtype=np.float64)
+        for name in names:
+            if name not in pod_by_name:
+                violations.append(f"unknown pod {name} on existing node {node_name}")
+                continue
+            gi, pod = pod_by_name[name]
+            if not problem.ex_compat[gi, k]:
+                violations.append(f"pod {name} incompatible with existing node {node_name}")
+            used += problem.demand[gi]
+            placements.append((node_name, problem.existing[k].node.zone(), gi, pod))
+        over = used > problem.ex_rem[k] + 1e-6
+        if np.any(over):
+            axes = [problem.resource_axes[kk] for kk in np.where(over)[0]]
+            violations.append(f"existing {node_name} over capacity on {axes}")
+
+    # -- completeness ----------------------------------------------------
+    placed_names = {p.name for _, _, _, p in placements}
+    all_names = set(pod_by_name)
+    missing = all_names - placed_names - set(result.unschedulable)
+    if missing:
+        violations.append(f"{len(missing)} pods neither placed nor reported unschedulable")
+    double = [n for n, c in _count_names(result).items() if c > 1]
+    if double:
+        violations.append(f"pods placed more than once: {double[:5]}")
+
+    # -- topology spread / anti-affinity / colocation --------------------
+    for gi, g in enumerate(problem.groups):
+        rep = g.pods[0]
+        for c in rep.topology_spread:
+            if c.when_unsatisfiable != "DoNotSchedule":
+                continue
+            counts: Dict[str, int] = defaultdict(int)
+            for host, zone, _, pod in placements:
+                if c.selects(pod):
+                    key = host if c.topology_key == wk.HOSTNAME else (zone or "")
+                    counts[key] += 1
+            if counts:
+                # min domain count is 0 as long as an empty feasible domain exists;
+                # conservatively compare against 0 for new-capacity scenarios.
+                if max(counts.values()) - 0 > c.max_skew and c.topology_key == wk.HOSTNAME:
+                    violations.append(
+                        f"group {gi} hostname spread skew {max(counts.values())} > {c.max_skew}"
+                    )
+                if c.topology_key == wk.ZONE and len(counts) > 0:
+                    skew = max(counts.values()) - min(
+                        [counts.get(z, 0) for z in problem.zones] or [0]
+                    )
+                    if skew > c.max_skew:
+                        violations.append(f"group {gi} zone spread skew {skew} > {c.max_skew}")
+        for term in rep.affinity_terms:
+            domains: Dict[str, int] = defaultdict(int)
+            my_hosts = set()
+            for host, zone, _, pod in placements:
+                key = host if term.topology_key == wk.HOSTNAME else (zone or "")
+                if term.selects(pod):
+                    domains[key] += 1
+                if pod.name in {q.name for q in g.pods}:
+                    my_hosts.add(key)
+            if term.anti:
+                for key, n in domains.items():
+                    mine = sum(
+                        1
+                        for host, zone, gj, pod in placements
+                        if gj == gi and (host if term.topology_key == wk.HOSTNAME else zone) == key
+                    )
+                    others = n
+                    if term.selects(rep) and mine > 1:
+                        violations.append(f"group {gi} anti-affinity violated in {key}")
+            elif term.selects(rep) and len(my_hosts) > 1:
+                violations.append(f"group {gi} required self-affinity split across {len(my_hosts)}")
+    return violations
+
+
+def _count_names(result: SolveResult) -> Dict[str, int]:
+    counts: Dict[str, int] = defaultdict(int)
+    for spec in result.new_nodes:
+        for n in spec.pod_names:
+            counts[n] += 1
+    for names in result.existing_assignments.values():
+        for n in names:
+            counts[n] += 1
+    return counts

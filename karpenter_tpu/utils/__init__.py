@@ -1,0 +1,22 @@
+from .batcher import Batcher, BatcherOptions
+from .cache import (
+    DEFAULT_TTL,
+    INSTANCE_TYPES_ZONES_TTL,
+    UNAVAILABLE_OFFERINGS_TTL,
+    Clock,
+    FakeClock,
+    TTLCache,
+    UnavailableOfferings,
+)
+
+__all__ = [
+    "Batcher",
+    "BatcherOptions",
+    "DEFAULT_TTL",
+    "INSTANCE_TYPES_ZONES_TTL",
+    "UNAVAILABLE_OFFERINGS_TTL",
+    "Clock",
+    "FakeClock",
+    "TTLCache",
+    "UnavailableOfferings",
+]

@@ -1,0 +1,78 @@
+"""Object factories for tests — the analogue of the reference's coretest factories
+(pod/provisioner builders used in every suite_test.go)."""
+
+from __future__ import annotations
+
+import itertools
+from typing import Dict, List, Optional, Sequence
+
+from karpenter_tpu.api import (
+    ObjectMeta,
+    Pod,
+    PodAffinityTerm,
+    Provisioner,
+    Requirement,
+    Requirements,
+    Resources,
+    Toleration,
+    TopologySpreadConstraint,
+)
+from karpenter_tpu.api import labels as wk
+from karpenter_tpu.cloudprovider import generate_catalog
+
+_counter = itertools.count(1)
+
+
+def make_pod(
+    name: Optional[str] = None,
+    cpu="100m",
+    memory="128Mi",
+    labels: Optional[Dict[str, str]] = None,
+    node_selector: Optional[Dict[str, str]] = None,
+    requirements: Optional[Sequence[Requirement]] = None,
+    tolerations: Sequence[Toleration] = (),
+    spread: Sequence[TopologySpreadConstraint] = (),
+    affinity: Sequence[PodAffinityTerm] = (),
+    extra_resources: Optional[Dict[str, float]] = None,
+    owner: Optional[str] = "ReplicaSet",
+    daemonset: bool = False,
+) -> Pod:
+    name = name or f"pod-{next(_counter)}"
+    requests = Resources(cpu=cpu, memory=memory)
+    if extra_resources:
+        requests = requests + Resources(extra_resources)
+    return Pod(
+        meta=ObjectMeta(name=name, labels=dict(labels or {}), owner_kind=owner),
+        requests=requests,
+        node_selector=dict(node_selector or {}),
+        required_affinity_terms=[Requirements(requirements)] if requirements else [],
+        tolerations=list(tolerations),
+        topology_spread=list(spread),
+        affinity_terms=list(affinity),
+        is_daemonset=daemonset,
+    )
+
+
+def make_pods(n: int, prefix: str = "pod", **kw) -> List[Pod]:
+    return [make_pod(name=f"{prefix}-{i}", **kw) for i in range(n)]
+
+
+def make_provisioner(
+    name: str = "default",
+    requirements: Optional[Sequence[Requirement]] = None,
+    **kw,
+) -> Provisioner:
+    return Provisioner(
+        meta=ObjectMeta(name=name),
+        requirements=Requirements(requirements or []),
+        **kw,
+    )
+
+
+def small_catalog(n_types: int = 20):
+    return generate_catalog(n_types=n_types)
+
+
+def setup(n_types: int = 20, provisioner: Optional[Provisioner] = None):
+    p = provisioner or make_provisioner()
+    return [(p, small_catalog(n_types))]

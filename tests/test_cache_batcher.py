@@ -1,0 +1,133 @@
+import threading
+
+from karpenter_tpu.utils import (
+    Batcher,
+    BatcherOptions,
+    FakeClock,
+    TTLCache,
+    UnavailableOfferings,
+)
+
+
+class TestTTLCache:
+    def test_expiry(self):
+        clock = FakeClock()
+        cache = TTLCache(ttl=60, clock=clock)
+        cache.set("k", "v")
+        assert cache.get("k") == "v"
+        clock.step(61)
+        assert cache.get("k") is None
+
+    def test_get_or_compute(self):
+        cache = TTLCache(ttl=60, clock=FakeClock())
+        calls = []
+        assert cache.get_or_compute("k", lambda: calls.append(1) or "v") == "v"
+        assert cache.get_or_compute("k", lambda: calls.append(1) or "v2") == "v"
+        assert len(calls) == 1
+
+
+class TestUnavailableOfferings:
+    def test_mark_and_expire(self):
+        clock = FakeClock()
+        uo = UnavailableOfferings(clock=clock)
+        uo.mark_unavailable("m7.large", "zone-a", "spot")
+        assert uo.is_unavailable("m7.large", "zone-a", "spot")
+        assert not uo.is_unavailable("m7.large", "zone-b", "spot")
+        clock.step(181)  # 3m TTL
+        assert not uo.is_unavailable("m7.large", "zone-a", "spot")
+
+    def test_seqnum_bumps(self):
+        uo = UnavailableOfferings()
+        s0 = uo.seqnum
+        uo.mark_unavailable("a", "b", "c")
+        assert uo.seqnum == s0 + 1
+
+
+class TestBatcher:
+    def test_merges_concurrent_requests(self):
+        batches = []
+
+        def executor(requests):
+            batches.append(list(requests))
+            return [r * 10 for r in requests]
+
+        b = Batcher(
+            request_hasher=lambda r: "same",
+            batch_executor=executor,
+            options=BatcherOptions(idle_timeout=0.05, max_timeout=0.5),
+        )
+        results = {}
+
+        def call(i):
+            results[i] = b.add(i)
+
+        threads = [threading.Thread(target=call, args=(i,)) for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=5)
+        assert results == {i: i * 10 for i in range(8)}
+        # all 8 merged into far fewer backend calls (usually 1)
+        assert len(batches) < 8
+        assert sum(len(x) for x in batches) == 8
+
+    def test_different_hashes_not_merged(self):
+        batches = []
+
+        def executor(requests):
+            batches.append(list(requests))
+            return list(requests)
+
+        b = Batcher(
+            request_hasher=lambda r: r % 2,
+            batch_executor=executor,
+            options=BatcherOptions(idle_timeout=0.02, max_timeout=0.2),
+        )
+        threads = [threading.Thread(target=b.add, args=(i,)) for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=5)
+        for batch in batches:
+            assert len({r % 2 for r in batch}) == 1
+
+    def test_executor_error_propagates(self):
+        def executor(requests):
+            raise RuntimeError("backend down")
+
+        b = Batcher(
+            request_hasher=lambda r: 0,
+            batch_executor=executor,
+            options=BatcherOptions(idle_timeout=0.01, max_timeout=0.1),
+        )
+        errors = []
+
+        def call():
+            try:
+                b.add(1)
+            except RuntimeError as e:
+                errors.append(e)
+
+        t = threading.Thread(target=call)
+        t.start()
+        t.join(timeout=5)
+        assert len(errors) == 1
+
+    def test_max_items_flushes(self):
+        batches = []
+
+        def executor(requests):
+            batches.append(list(requests))
+            return list(requests)
+
+        b = Batcher(
+            request_hasher=lambda r: 0,
+            batch_executor=executor,
+            options=BatcherOptions(idle_timeout=5.0, max_timeout=10.0, max_items=4),
+        )
+        threads = [threading.Thread(target=b.add, args=(i,)) for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=5)  # would hang if max_items didn't flush before idle
+        assert sum(len(x) for x in batches) == 4

@@ -1,0 +1,124 @@
+import numpy as np
+
+from karpenter_tpu.api import PodAffinityTerm, Requirement, Toleration, TopologySpreadConstraint
+from karpenter_tpu.api import labels as wk
+from karpenter_tpu.solver import build_options, encode, group_pods
+
+from helpers import make_pod, make_pods, make_provisioner, setup
+
+
+class TestGrouping:
+    def test_identical_pods_grouped(self):
+        pods = make_pods(50, cpu="250m", memory="512Mi", labels={"app": "web"})
+        groups = group_pods(pods)
+        assert len(groups) == 1
+        assert groups[0].count == 50
+
+    def test_distinct_requests_split(self):
+        pods = make_pods(10, cpu="250m") + make_pods(10, cpu="500m")
+        assert len(group_pods(pods)) == 2
+
+    def test_distinct_selectors_split(self):
+        pods = make_pods(5) + make_pods(5, node_selector={wk.ZONE: "zone-a"})
+        assert len(group_pods(pods)) == 2
+
+    def test_hostname_antiaffinity_sets_node_cap(self):
+        pods = make_pods(
+            4,
+            labels={"app": "db"},
+            affinity=[PodAffinityTerm(label_selector={"app": "db"}, topology_key=wk.HOSTNAME, anti=True)],
+        )
+        (g,) = group_pods(pods)
+        assert g.node_cap == 1
+
+    def test_hostname_spread_sets_node_cap(self):
+        pods = make_pods(
+            6,
+            labels={"app": "x"},
+            spread=[TopologySpreadConstraint(max_skew=2, topology_key=wk.HOSTNAME,
+                                            label_selector={"app": "x"})],
+        )
+        (g,) = group_pods(pods)
+        assert g.node_cap == 2
+
+    def test_zone_spread_sets_skew(self):
+        pods = make_pods(
+            6,
+            labels={"app": "x"},
+            spread=[TopologySpreadConstraint(max_skew=1, topology_key=wk.ZONE,
+                                            label_selector={"app": "x"})],
+        )
+        (g,) = group_pods(pods)
+        assert g.zone_skew == 1
+
+    def test_self_affinity_sets_colocate(self):
+        pods = make_pods(
+            3,
+            labels={"app": "x"},
+            affinity=[PodAffinityTerm(label_selector={"app": "x"}, topology_key=wk.HOSTNAME)],
+        )
+        (g,) = group_pods(pods)
+        assert g.colocate
+
+
+class TestOptions:
+    def test_options_cover_offerings(self):
+        provs = setup(n_types=10)
+        options = build_options(provs)
+        # 10 types x 3 zones x (spot + on-demand)
+        assert len(options) == 10 * 3 * 2
+
+    def test_provisioner_requirements_filter_options(self):
+        p = make_provisioner(
+            requirements=[Requirement.in_values(wk.ZONE, ["zone-a"]),
+                          Requirement.in_values(wk.CAPACITY_TYPE, ["on-demand"])],
+        )
+        provs = [(p, setup(10)[0][1])]
+        options = build_options(provs)
+        assert options
+        assert all(o.zone == "zone-a" and o.capacity_type == "on-demand" for o in options)
+
+    def test_daemonset_overhead_subtracted(self):
+        provs = setup(n_types=5)
+        base = build_options(provs)
+        with_ds = build_options(provs, daemonsets=[make_pod(cpu="500m", memory="1Gi", daemonset=True)])
+        for b, d in zip(base, with_ds):
+            assert d.allocatable["cpu"] <= b.allocatable["cpu"] - 0.5 + 1e-9
+            assert d.allocatable["pods"] == b.allocatable["pods"] - 1
+
+
+class TestEncode:
+    def test_shapes(self):
+        pods = make_pods(100, cpu="250m") + make_pods(50, cpu="1")
+        prob = encode(pods, setup(20))
+        assert prob.G == 2
+        assert prob.O == 20 * 3 * 2
+        assert prob.demand.shape == (2, len(prob.resource_axes))
+        assert prob.compat.shape == (2, prob.O)
+        assert prob.count.tolist() == [100, 50]
+
+    def test_compat_zone_selector(self):
+        pods = make_pods(5, node_selector={wk.ZONE: "zone-b"})
+        prob = encode(pods, setup(5))
+        for j, opt in enumerate(prob.options):
+            assert prob.compat[0, j] == (opt.zone == "zone-b")
+
+    def test_compat_toleration_required_for_tainted_provisioner(self):
+        from karpenter_tpu.api import Taint
+
+        p = make_provisioner(name="tainted", taints=[Taint(key="team", value="ml")])
+        prob = encode(make_pods(3), [(p, setup(5)[0][1])])
+        assert not prob.compat.any()
+        tol = [Toleration(key="team", operator="Equal", value="ml")]
+        prob2 = encode(make_pods(3, tolerations=tol), [(p, setup(5)[0][1])])
+        assert prob2.compat.any()
+
+    def test_pods_axis_always_one(self):
+        prob = encode(make_pods(3), setup(5))
+        pods_idx = prob.resource_axes.index("pods")
+        assert np.all(prob.demand[:, pods_idx] == 1.0)
+
+    def test_too_big_pod_incompatible(self):
+        pods = make_pods(1, cpu="10000")
+        prob = encode(pods, setup(20))
+        assert not prob.compat.any()

@@ -1,0 +1,135 @@
+import pytest
+
+from karpenter_tpu.api import Machine, ObjectMeta, Provisioner, Requirement, Requirements, Resources
+from karpenter_tpu.api import labels as wk
+from karpenter_tpu.cloudprovider import (
+    FakeCloudProvider,
+    InsufficientCapacityError,
+    MachineNotFoundError,
+    generate_catalog,
+)
+
+
+def make_machine(name="machine-1", cpu=2, mem="4Gi", reqs=None, provisioner="default"):
+    return Machine(
+        meta=ObjectMeta(name=name),
+        provisioner_name=provisioner,
+        requirements=reqs or Requirements(),
+        requests=Resources(cpu=cpu, memory=mem),
+    )
+
+
+@pytest.fixture
+def provider():
+    return FakeCloudProvider(catalog=generate_catalog(n_types=60))
+
+
+class TestCreate:
+    def test_launches_cheapest_fitting(self, provider):
+        m = provider.create(make_machine())
+        assert m.status.launched
+        assert m.status.provider_id
+        assert m.requests.fits(m.status.allocatable)
+        inst = provider.instance_for(m)
+        # spot is chosen by default (machine has no capacity-type restriction)
+        assert inst.capacity_type == wk.CAPACITY_TYPE_SPOT
+
+    def test_on_demand_when_required(self, provider):
+        reqs = Requirements([
+            Requirement.in_values(wk.CAPACITY_TYPE, [wk.CAPACITY_TYPE_ON_DEMAND])
+        ])
+        m = provider.create(make_machine(reqs=reqs))
+        assert provider.instance_for(m).capacity_type == wk.CAPACITY_TYPE_ON_DEMAND
+
+    def test_zone_restriction(self, provider):
+        reqs = Requirements([Requirement.in_values(wk.ZONE, ["zone-b"])])
+        m = provider.create(make_machine(reqs=reqs))
+        assert provider.instance_for(m).zone == "zone-b"
+        assert m.meta.labels[wk.ZONE] == "zone-b"
+
+    def test_instance_type_restriction(self, provider):
+        name = provider.catalog[10].name
+        reqs = Requirements([Requirement.in_values(wk.INSTANCE_TYPE, [name])])
+        m = provider.create(make_machine(cpu=0.1, mem="128Mi", reqs=reqs))
+        assert provider.instance_for(m).instance_type == name
+
+    def test_ice_falls_through_to_next_offering(self, provider):
+        # ICE every spot offering of the cheapest fitting type in zone-a; launch
+        # must still succeed on another pool and mark the ICE'd ones unavailable.
+        m0 = provider.create(make_machine())
+        first = provider.instance_for(m0)
+        provider.delete(m0)
+        provider.set_insufficient_capacity(
+            first.instance_type, first.zone, first.capacity_type
+        )
+        m1 = provider.create(make_machine(name="machine-2"))
+        second = provider.instance_for(m1)
+        assert (second.instance_type, second.zone, second.capacity_type) != (
+            first.instance_type,
+            first.zone,
+            first.capacity_type,
+        )
+        assert provider.unavailable_offerings.is_unavailable(
+            first.instance_type, first.zone, first.capacity_type
+        )
+
+    def test_all_ice_raises(self, provider):
+        reqs = Requirements([
+            Requirement.in_values(wk.INSTANCE_TYPE, [provider.catalog[20].name])
+        ])
+        it = provider.catalog[20]
+        for o in it.offerings:
+            provider.set_insufficient_capacity(it.name, o.zone, o.capacity_type)
+        with pytest.raises(InsufficientCapacityError):
+            provider.create(make_machine(cpu=0.1, mem="128Mi", reqs=reqs))
+
+    def test_unschedulable_requests_raise(self, provider):
+        with pytest.raises(InsufficientCapacityError):
+            provider.create(make_machine(cpu=10000))
+
+    def test_injected_error(self, provider):
+        provider.inject_next_error(RuntimeError("throttled"))
+        with pytest.raises(RuntimeError):
+            provider.create(make_machine())
+        provider.create(make_machine())  # next call succeeds
+
+
+class TestLifecycle:
+    def test_get_list_delete(self, provider):
+        m = provider.create(make_machine())
+        assert len(provider.list()) == 1
+        got = provider.get(m.status.provider_id)
+        assert got.status.provider_id == m.status.provider_id
+        provider.delete(m)
+        assert provider.list() == []
+        with pytest.raises(MachineNotFoundError):
+            provider.get(m.status.provider_id)
+
+    def test_drift(self, provider):
+        m = provider.create(make_machine())
+        assert not provider.is_machine_drifted(m)
+        provider.rotate_image()
+        assert provider.is_machine_drifted(m)
+
+    def test_get_instance_types_applies_unavailability(self, provider):
+        p = Provisioner(meta=ObjectMeta(name="default"))
+        it = provider.catalog[0]
+        o = it.offerings[0]
+        provider.unavailable_offerings.mark_unavailable(it.name, o.zone, o.capacity_type)
+        types = provider.get_instance_types(p)
+        got = next(t for t in types if t.name == it.name)
+        masked = next(
+            x for x in got.offerings if x.zone == o.zone and x.capacity_type == o.capacity_type
+        )
+        assert not masked.available
+
+    def test_get_instance_types_filters_by_provisioner(self, provider):
+        p = Provisioner(
+            meta=ObjectMeta(name="amd-only"),
+            requirements=Requirements([
+                Requirement.in_values(wk.INSTANCE_CATEGORY, ["c"])
+            ]),
+        )
+        types = provider.get_instance_types(p)
+        assert types
+        assert all(t.requirements.get(wk.INSTANCE_CATEGORY).single_value() == "c" for t in types)

@@ -1,0 +1,132 @@
+import pytest
+
+from karpenter_tpu.api import KubeletConfiguration, Resources
+from karpenter_tpu.api import labels as wk
+from karpenter_tpu.api.requirements import Requirement, Requirements
+from karpenter_tpu.api.resources import CPU, EPHEMERAL_STORAGE, GPU_TPU, MEMORY, PODS
+from karpenter_tpu.cloudprovider import (
+    eni_limited_pods,
+    eviction_threshold,
+    generate_catalog,
+    kube_reserved,
+    make_instance_type,
+    pods_capacity,
+)
+from karpenter_tpu.cloudprovider.types import GIB, MIB
+
+
+class TestOverheadMath:
+    """Golden tests for the allocatable formulas (reference types.go:237-324)."""
+
+    def test_eni_limited_pods(self):
+        # ENIs*(IPs-1)+2
+        assert eni_limited_pods(3, 10) == 29
+        assert eni_limited_pods(4, 15) == 58
+
+    def test_pods_capacity_priority(self):
+        assert pods_capacity(3, 10, 4) == 29  # ENI formula
+        assert pods_capacity(3, 10, 4, KubeletConfiguration(max_pods=50)) == 50
+        assert pods_capacity(3, 10, 4, eni_limited_density=False) == 110
+        # podsPerCore caps
+        assert pods_capacity(3, 10, 4, KubeletConfiguration(pods_per_core=2)) == 8
+
+    def test_kube_reserved_cpu_steps(self):
+        # 6% of first core, 1% of second, 0.5% of cores 3-4, 0.25% above 4.
+        assert kube_reserved(1, 0)[CPU] == pytest.approx(0.06)
+        assert kube_reserved(2, 0)[CPU] == pytest.approx(0.07)
+        assert kube_reserved(4, 0)[CPU] == pytest.approx(0.08)
+        assert kube_reserved(16, 0)[CPU] == pytest.approx(0.08 + 12 * 0.0025)
+        assert kube_reserved(96, 0)[CPU] == pytest.approx(0.08 + 92 * 0.0025)
+
+    def test_kube_reserved_memory(self):
+        # 255MiB + 11MiB per pod
+        assert kube_reserved(4, 29)[MEMORY] == pytest.approx((255 + 11 * 29) * MIB)
+        assert kube_reserved(4, 110)[MEMORY] == pytest.approx((255 + 11 * 110) * MIB)
+
+    def test_kube_reserved_override(self):
+        kc = KubeletConfiguration(kube_reserved=Resources(cpu="80m"))
+        assert kube_reserved(4, 29, kc)[CPU] == pytest.approx(0.08)
+        # unoverridden keys keep defaults
+        assert kube_reserved(4, 29, kc)[MEMORY] == pytest.approx((255 + 11 * 29) * MIB)
+
+    def test_eviction_threshold_defaults(self):
+        th = eviction_threshold(8 * GIB, 20 * GIB)
+        assert th[MEMORY] == pytest.approx(100 * MIB)
+        assert th[EPHEMERAL_STORAGE] == pytest.approx(2 * GIB)  # 10% of 20Gi
+
+    def test_eviction_threshold_percent_override(self):
+        kc = KubeletConfiguration(eviction_hard={"memory.available": "5%"})
+        th = eviction_threshold(8 * GIB, 20 * GIB, kc)
+        assert th[MEMORY] == pytest.approx(0.4 * GIB)
+
+    def test_eviction_hard_soft_max(self):
+        kc = KubeletConfiguration(
+            eviction_hard={"memory.available": "200Mi"},
+            eviction_soft={"memory.available": "500Mi"},
+        )
+        th = eviction_threshold(8 * GIB, 20 * GIB, kc)
+        assert th[MEMORY] == pytest.approx(500 * MIB)
+
+
+class TestInstanceType:
+    def test_allocatable_less_than_capacity(self):
+        it = make_instance_type(
+            "m7.xlarge", "m", "7", "xlarge", 8, 32.0, 0.40, ["zone-a"]
+        )
+        alloc = it.allocatable()
+        assert 0 < alloc[CPU] < 8
+        assert 0 < alloc[MEMORY] < 32 * GIB
+        assert alloc[PODS] == it.capacity[PODS]
+
+    def test_vm_memory_overhead(self):
+        it = make_instance_type(
+            "m7.large", "m", "7", "large", 4, 16.0, 0.2, ["zone-a"],
+            vm_memory_overhead_percent=0.075,
+        )
+        assert it.capacity[MEMORY] == pytest.approx(16 * GIB * 0.925)
+
+    def test_requirement_labels(self):
+        it = make_instance_type(
+            "c7.2xlarge", "c", "7", "2xlarge", 16, 32.0, 0.7, ["zone-a", "zone-b"]
+        )
+        r = it.requirements
+        assert r.get(wk.INSTANCE_TYPE).single_value() == "c7.2xlarge"
+        assert r.get(wk.INSTANCE_CPU).single_value() == "16"
+        assert r.get(wk.ZONE).has("zone-b")
+        # Gt numeric constraint works against the label surface
+        pod_reqs = Requirements([Requirement.from_operator(wk.INSTANCE_CPU, "Gt", ["8"])])
+        assert r.compatible(pod_reqs)
+
+    def test_cheapest_price_filters(self):
+        it = make_instance_type("m7.large", "m", "7", "large", 4, 16.0, 0.2, ["zone-a", "zone-b"])
+        od = it.cheapest_price(capacity_types=[wk.CAPACITY_TYPE_ON_DEMAND])
+        spot = it.cheapest_price(capacity_types=[wk.CAPACITY_TYPE_SPOT])
+        assert spot < od == 0.2
+
+
+class TestCatalog:
+    def test_deterministic(self):
+        a = generate_catalog(n_types=50)
+        b = generate_catalog(n_types=50)
+        assert [it.name for it in a] == [it.name for it in b]
+        assert a[0].offerings == b[0].offerings
+
+    def test_scale(self):
+        cat = generate_catalog()
+        assert len(cat) >= 130
+        assert len(generate_catalog(n_types=20)) == 20
+
+    def test_spot_cheaper_than_od(self):
+        for it in generate_catalog(n_types=30):
+            od = it.cheapest_price(capacity_types=["on-demand"])
+            spot = it.cheapest_price(capacity_types=["spot"])
+            if spot is not None:
+                assert spot < od
+
+    def test_accelerator_types_present(self):
+        cat = generate_catalog()
+        tpus = [it for it in cat if it.capacity[GPU_TPU] > 0]
+        assert tpus
+        assert all(
+            it.requirements.get(wk.INSTANCE_ACCELERATOR_NAME).single_value() for it in tpus
+        )

@@ -1,0 +1,103 @@
+from karpenter_tpu.api.requirements import Requirement, Requirements
+
+
+def req(key, op, *values):
+    return Requirement.from_operator(key, op, values)
+
+
+class TestRequirement:
+    def test_in(self):
+        r = req("zone", "In", "a", "b")
+        assert r.has("a") and r.has("b") and not r.has("c")
+
+    def test_not_in(self):
+        r = req("zone", "NotIn", "a")
+        assert not r.has("a") and r.has("b")
+
+    def test_exists(self):
+        r = req("zone", "Exists")
+        assert r.has("anything")
+        assert not r.is_empty()
+
+    def test_does_not_exist(self):
+        r = req("zone", "DoesNotExist")
+        assert not r.has("anything")
+        assert r.is_empty()
+
+    def test_gt_lt(self):
+        gt = req("cpu", "Gt", "4")
+        assert gt.has("8") and not gt.has("4") and not gt.has("2")
+        assert not gt.has("banana")
+        lt = req("cpu", "Lt", "16")
+        assert lt.has("8") and not lt.has("16")
+
+    def test_intersect_in_in(self):
+        r = req("z", "In", "a", "b").intersect(req("z", "In", "b", "c"))
+        assert r.has("b") and not r.has("a") and not r.has("c")
+
+    def test_intersect_in_notin(self):
+        r = req("z", "In", "a", "b").intersect(req("z", "NotIn", "a"))
+        assert r.has("b") and not r.has("a")
+
+    def test_intersect_notin_notin(self):
+        r = req("z", "NotIn", "a").intersect(req("z", "NotIn", "b"))
+        assert not r.has("a") and not r.has("b") and r.has("c")
+
+    def test_intersect_gt_lt_with_in(self):
+        r = req("cpu", "In", "2", "8", "32").intersect(req("cpu", "Gt", "4"))
+        assert not r.has("2") and r.has("8") and r.has("32")
+        r2 = r.intersect(req("cpu", "Lt", "16"))
+        assert r2.has("8") and not r2.has("32")
+
+    def test_empty_gt_lt_range(self):
+        r = req("cpu", "Gt", "4").intersect(req("cpu", "Lt", "5"))
+        assert r.is_empty()
+        r2 = req("cpu", "Gt", "4").intersect(req("cpu", "Lt", "6"))
+        assert not r2.is_empty() and r2.has("5")
+
+    def test_tolerates_absence(self):
+        assert req("z", "NotIn", "a").tolerates_absence()
+        assert req("z", "DoesNotExist").tolerates_absence()
+        assert not req("z", "In", "a").tolerates_absence()
+        assert not req("z", "Exists").tolerates_absence()
+        assert not req("z", "Gt", "1").tolerates_absence()
+
+
+class TestRequirements:
+    def test_duplicate_keys_intersected(self):
+        rs = Requirements([req("z", "In", "a", "b"), req("z", "NotIn", "a")])
+        assert rs.get("z").has("b") and not rs.get("z").has("a")
+
+    def test_compatible_basic(self):
+        node = Requirements([req("zone", "In", "a", "b"), req("arch", "In", "amd64")])
+        pod = Requirements([req("zone", "In", "b")])
+        assert node.compatible(pod)
+        assert not node.compatible(Requirements([req("zone", "In", "c")]))
+
+    def test_compatible_missing_key_absence_tolerant(self):
+        node = Requirements([req("zone", "In", "a")])
+        # Node doesn't define "special"; NotIn tolerates absence, In does not.
+        assert node.compatible(Requirements([req("special", "NotIn", "x")]))
+        assert node.compatible(Requirements([req("special", "DoesNotExist")]))
+        assert not node.compatible(Requirements([req("special", "In", "x")]))
+        assert not node.compatible(Requirements([req("special", "Exists")]))
+
+    def test_compatible_does_not_exist_conflict(self):
+        node = Requirements([req("gpu", "In", "a100")])
+        assert not node.compatible(Requirements([req("gpu", "DoesNotExist")]))
+
+    def test_intersect_requirements(self):
+        a = Requirements([req("z", "In", "a", "b")])
+        b = Requirements([req("z", "In", "b"), req("arch", "In", "arm64")])
+        c = a.intersect(b)
+        assert c.get("z").single_value() == "b"
+        assert c.get("arch").single_value() == "arm64"
+
+    def test_from_labels_and_labels_roundtrip(self):
+        rs = Requirements.from_labels({"a": "1", "b": "2"})
+        assert rs.labels() == {"a": "1", "b": "2"}
+
+    def test_gt_compat_with_numeric_label(self):
+        node = Requirements([req("instance-cpu", "In", "8")])
+        assert node.compatible(Requirements([req("instance-cpu", "Gt", "4")]))
+        assert not node.compatible(Requirements([req("instance-cpu", "Gt", "8")]))

@@ -1,0 +1,82 @@
+import pytest
+
+from karpenter_tpu.api.resources import (
+    CPU,
+    MEMORY,
+    PODS,
+    Resources,
+    merge,
+    parse_quantity,
+)
+
+
+class TestParseQuantity:
+    def test_plain_numbers(self):
+        assert parse_quantity(2) == 2.0
+        assert parse_quantity("4") == 4.0
+        assert parse_quantity(1.5) == 1.5
+
+    def test_milli(self):
+        assert parse_quantity("100m") == pytest.approx(0.1)
+        assert parse_quantity("1500m") == pytest.approx(1.5)
+
+    def test_binary_suffixes(self):
+        assert parse_quantity("1Ki") == 1024
+        assert parse_quantity("1Mi") == 1024**2
+        assert parse_quantity("1536Mi") == 1536 * 1024**2
+        assert parse_quantity("2Gi") == 2 * 1024**3
+        assert parse_quantity("1Ti") == 1024**4
+
+    def test_decimal_suffixes(self):
+        assert parse_quantity("1k") == 1000
+        assert parse_quantity("5M") == 5e6
+        assert parse_quantity("2G") == 2e9
+
+    def test_invalid(self):
+        with pytest.raises(ValueError):
+            parse_quantity("abc")
+        with pytest.raises(ValueError):
+            parse_quantity("1Qx")
+
+
+class TestResources:
+    def test_construction_and_get(self):
+        r = Resources({CPU: "500m", MEMORY: "1Gi"}, pods=1)
+        assert r[CPU] == pytest.approx(0.5)
+        assert r[MEMORY] == 1024**3
+        assert r[PODS] == 1
+        assert r["nonexistent"] == 0.0
+
+    def test_add_sub(self):
+        a = Resources(cpu=1, memory="1Gi")
+        b = Resources(cpu="500m", pods=2)
+        s = a + b
+        assert s[CPU] == pytest.approx(1.5)
+        assert s[PODS] == 2
+        d = s - b
+        assert d[CPU] == pytest.approx(1.0)
+        assert d[PODS] == 0.0
+
+    def test_zero_dropped(self):
+        assert Resources(cpu=0) == Resources()
+        assert (Resources(cpu=1) - Resources(cpu=1)).is_zero()
+
+    def test_fits(self):
+        cap = Resources(cpu=4, memory="16Gi", pods=110)
+        assert Resources(cpu=4, memory="16Gi").fits(cap)
+        assert Resources(cpu="100m").fits(cap)
+        assert not Resources(cpu=5).fits(cap)
+        assert not Resources(**{"nvidia.com/gpu": 1}).fits(cap)
+
+    def test_any_exceeds_limits(self):
+        limit = Resources(cpu=100)
+        assert Resources(cpu=101).any_exceeds(limit)
+        assert not Resources(cpu=99, memory="1Ti").any_exceeds(limit)  # memory unlimited
+
+    def test_merge(self):
+        total = merge([Resources(cpu=1), Resources(cpu=2, memory="1Gi")])
+        assert total[CPU] == 3
+
+    def test_hash_eq(self):
+        assert Resources(cpu="1000m") == Resources(cpu=1)
+        assert hash(Resources(cpu="1000m")) == hash(Resources(cpu=1))

@@ -1,0 +1,257 @@
+import numpy as np
+import pytest
+
+from karpenter_tpu.api import (
+    Node,
+    ObjectMeta,
+    PodAffinityTerm,
+    Requirement,
+    Resources,
+    Taint,
+    Toleration,
+    TopologySpreadConstraint,
+)
+from karpenter_tpu.api import labels as wk
+from karpenter_tpu.solver import (
+    ExistingNode,
+    GreedySolver,
+    TPUSolver,
+    encode,
+    lower_bound,
+    validate,
+)
+
+from helpers import make_pod, make_pods, make_provisioner, setup
+
+
+@pytest.fixture(scope="module")
+def provs():
+    return setup(n_types=20)
+
+
+def assert_feasible_and_complete(problem, result, n_pods):
+    violations = validate(problem, result)
+    assert violations == []
+    assert result.scheduled_count + len(result.unschedulable) == n_pods
+
+
+class TestGreedySolver:
+    def test_all_pods_scheduled(self, provs):
+        pods = make_pods(100, cpu="250m", memory="512Mi")
+        problem = encode(pods, provs)
+        result = GreedySolver().solve(problem)
+        assert result.unschedulable == []
+        assert_feasible_and_complete(problem, result, 100)
+        assert result.cost > 0
+
+    def test_unschedulable_reported(self, provs):
+        pods = make_pods(2, cpu="9999")
+        problem = encode(pods, provs)
+        result = GreedySolver().solve(problem)
+        assert len(result.unschedulable) == 2
+
+    def test_existing_capacity_used_first(self, provs):
+        pods = make_pods(4, cpu="500m", memory="512Mi")
+        node = Node(
+            meta=ObjectMeta(name="existing-1", labels={wk.ZONE: "zone-a"}),
+            allocatable=Resources(cpu=8, memory="16Gi", pods=50),
+        )
+        existing = [ExistingNode(node=node, remaining=Resources(cpu=8, memory="16Gi", pods=50))]
+        problem = encode(pods, provs, existing=existing)
+        result = GreedySolver().solve(problem)
+        assert result.new_nodes == []
+        assert len(result.existing_assignments["existing-1"]) == 4
+
+    def test_anti_affinity_one_per_node(self, provs):
+        pods = make_pods(
+            3,
+            labels={"app": "db"},
+            affinity=[PodAffinityTerm(label_selector={"app": "db"}, topology_key=wk.HOSTNAME, anti=True)],
+        )
+        problem = encode(pods, provs)
+        result = GreedySolver().solve(problem)
+        assert_feasible_and_complete(problem, result, 3)
+        assert len(result.new_nodes) == 3
+
+    def test_self_affinity_colocates(self, provs):
+        pods = make_pods(
+            3,
+            labels={"app": "x"},
+            cpu="250m",
+            affinity=[PodAffinityTerm(label_selector={"app": "x"}, topology_key=wk.HOSTNAME)],
+        )
+        problem = encode(pods, provs)
+        result = GreedySolver().solve(problem)
+        assert_feasible_and_complete(problem, result, 3)
+        assert len(result.new_nodes) == 1
+
+    def test_two_existing_nodes_first_incompatible(self, provs):
+        # regression: list.index on _SimNode crashed with >=2 existing nodes
+        pods = make_pods(2, cpu="500m", node_selector={wk.ZONE: "zone-b"})
+        nodes = []
+        for i, zone in enumerate(["zone-a", "zone-b"]):
+            n = Node(
+                meta=ObjectMeta(name=f"existing-{i}", labels={wk.ZONE: zone}),
+                allocatable=Resources(cpu=8, memory="16Gi", pods=50),
+            )
+            nodes.append(ExistingNode(node=n, remaining=Resources(cpu=8, memory="16Gi", pods=50)))
+        problem = encode(pods, provs, existing=nodes)
+        result = GreedySolver().solve(problem)
+        assert result.existing_assignments == {"existing-1": ["pod-0", "pod-1"]} or \
+            len(result.existing_assignments.get("existing-1", [])) == 2
+
+    def test_zone_spread(self, provs):
+        pods = make_pods(
+            9,
+            labels={"app": "x"},
+            spread=[TopologySpreadConstraint(max_skew=1, topology_key=wk.ZONE,
+                                            label_selector={"app": "x"})],
+        )
+        problem = encode(pods, provs)
+        result = GreedySolver().solve(problem)
+        assert_feasible_and_complete(problem, result, 9)
+        zone_counts = {}
+        for spec in result.new_nodes:
+            zone_counts[spec.option.zone] = zone_counts.get(spec.option.zone, 0) + len(spec.pod_names)
+        skew = max(zone_counts.values()) - min(zone_counts.values())
+        assert skew <= 1
+
+
+class TestTPUSolver:
+    def test_matches_greedy_on_simple(self, provs):
+        pods = make_pods(200, cpu="250m", memory="512Mi")
+        problem = encode(pods, provs)
+        tpu = TPUSolver().solve(problem)
+        greedy = GreedySolver().solve(problem)
+        assert_feasible_and_complete(problem, tpu, 200)
+        assert tpu.unschedulable == []
+        # portfolio should never be materially worse than single-order greedy
+        assert tpu.cost <= greedy.cost * 1.05 + 1e-9
+
+    def test_mixed_sizes_feasible(self, provs):
+        pods = (
+            make_pods(60, "a", cpu="250m", memory="512Mi")
+            + make_pods(30, "b", cpu="1", memory="2Gi")
+            + make_pods(10, "c", cpu="1500m", memory="3Gi")
+        )
+        problem = encode(pods, provs)
+        result = TPUSolver().solve(problem)
+        assert_feasible_and_complete(problem, result, 100)
+        assert result.unschedulable == []
+        assert result.cost >= lower_bound(problem) - 1e-9
+
+    def test_existing_capacity_preferred(self, provs):
+        pods = make_pods(4, cpu="500m", memory="512Mi")
+        node = Node(
+            meta=ObjectMeta(name="existing-1", labels={wk.ZONE: "zone-a"}),
+            allocatable=Resources(cpu=8, memory="16Gi", pods=50),
+        )
+        existing = [ExistingNode(node=node, remaining=Resources(cpu=8, memory="16Gi", pods=50))]
+        problem = encode(pods, provs, existing=existing)
+        result = TPUSolver().solve(problem)
+        assert result.new_nodes == []
+        assert sum(len(v) for v in result.existing_assignments.values()) == 4
+
+    def test_zone_selector_respected(self, provs):
+        pods = make_pods(10, node_selector={wk.ZONE: "zone-c"})
+        problem = encode(pods, provs)
+        result = TPUSolver().solve(problem)
+        assert_feasible_and_complete(problem, result, 10)
+        assert all(spec.option.zone == "zone-c" for spec in result.new_nodes)
+
+    def test_tainted_provisioner_requires_toleration(self):
+        p = make_provisioner(name="tainted", taints=[Taint(key="team", value="ml")])
+        provs_tainted = [(p, setup(10)[0][1])]
+        pods_no_tol = make_pods(3)
+        problem = encode(pods_no_tol, provs_tainted)
+        result = TPUSolver().solve(problem)
+        assert len(result.unschedulable) == 3
+
+    def test_unschedulable_partial(self, provs):
+        pods = make_pods(5, cpu="250m") + make_pods(2, "huge", cpu="9999")
+        problem = encode(pods, provs)
+        result = TPUSolver().solve(problem)
+        assert_feasible_and_complete(problem, result, 7)
+        assert len(result.unschedulable) == 2
+
+    def test_anti_affinity_one_per_node(self, provs):
+        pods = make_pods(
+            4,
+            labels={"app": "db"},
+            affinity=[PodAffinityTerm(label_selector={"app": "db"}, topology_key=wk.HOSTNAME, anti=True)],
+        )
+        problem = encode(pods, provs)
+        result = TPUSolver().solve(problem)
+        assert_feasible_and_complete(problem, result, 4)
+        per_node = [len(s.pod_names) for s in result.new_nodes]
+        assert all(n == 1 for n in per_node)
+
+    def test_zone_spread_skew_respected(self, provs):
+        # 10 over 3 zones: equal split must be 4/3/3, not 4/4/2 (regression)
+        pods = make_pods(
+            10,
+            labels={"app": "x"},
+            spread=[TopologySpreadConstraint(max_skew=1, topology_key=wk.ZONE,
+                                            label_selector={"app": "x"})],
+        )
+        problem = encode(pods, provs)
+        result = TPUSolver().solve(problem)
+        assert_feasible_and_complete(problem, result, 10)
+        assert result.unschedulable == []
+        # must be solved on the TPU path, not silently fall back to greedy
+        assert result.stats.get("fallback") is None
+        assert result.stats["backend"] == 1.0
+
+    def test_unschedulable_fast_no_slot_doubling(self, provs):
+        # regression: pods unplaceable by *compatibility* must not trigger the
+        # slot-growth loop (11 recompiles); only true slot exhaustion grows S
+        import time
+
+        pods = make_pods(10, cpu="9999")
+        problem = encode(pods, provs)
+        solver = TPUSolver()
+        solver.solve(problem)  # warm the compile for this shape
+        t0 = time.perf_counter()
+        result = solver.solve(problem)
+        elapsed = time.perf_counter() - t0
+        assert len(result.unschedulable) == 10
+        assert elapsed < 5.0
+
+    def test_colocate_single_node(self, provs):
+        pods = make_pods(
+            3,
+            labels={"app": "x"},
+            cpu="250m",
+            affinity=[PodAffinityTerm(label_selector={"app": "x"}, topology_key=wk.HOSTNAME)],
+        )
+        problem = encode(pods, provs)
+        result = TPUSolver().solve(problem)
+        assert_feasible_and_complete(problem, result, 3)
+        assert len(result.new_nodes) == 1
+
+    def test_randomized_fuzz_feasibility(self, provs):
+        rng = np.random.default_rng(42)
+        for trial in range(5):
+            pods = []
+            for shape in range(int(rng.integers(2, 6))):
+                n = int(rng.integers(1, 40))
+                cpu = float(rng.choice([0.1, 0.25, 0.5, 1]))
+                mem_gi = float(rng.choice([0.25, 0.5, 1, 2]))
+                sel = {}
+                if rng.random() < 0.3:
+                    sel[wk.ZONE] = str(rng.choice(["zone-a", "zone-b", "zone-c"]))
+                pods += make_pods(n, f"t{trial}s{shape}", cpu=cpu, memory=f"{mem_gi}Gi",
+                                  node_selector=sel)
+            problem = encode(pods, provs)
+            result = TPUSolver().solve(problem)
+            assert validate(problem, result) == [], f"trial {trial}"
+            assert result.unschedulable == []
+
+    def test_cost_vs_lower_bound(self, provs):
+        pods = make_pods(300, cpu="500m", memory="1Gi")
+        problem = encode(pods, provs)
+        result = TPUSolver().solve(problem)
+        lb = lower_bound(problem)
+        assert result.cost >= lb - 1e-9
+        # portfolio FFD should land within 30% of the fractional bound on this easy mix
+        assert result.cost <= lb * 1.3

@@ -1,0 +1,43 @@
+from karpenter_tpu.api.taints import (
+    NO_SCHEDULE,
+    PREFER_NO_SCHEDULE,
+    Taint,
+    Toleration,
+    tolerates_all,
+)
+
+
+def test_equal_toleration():
+    taint = Taint(key="team", value="ml", effect=NO_SCHEDULE)
+    assert Toleration(key="team", operator="Equal", value="ml").tolerates(taint)
+    assert not Toleration(key="team", operator="Equal", value="web").tolerates(taint)
+
+
+def test_exists_toleration():
+    taint = Taint(key="team", value="ml")
+    assert Toleration(key="team", operator="Exists").tolerates(taint)
+    assert Toleration(operator="Exists").tolerates(taint)  # empty key = all
+
+
+def test_effect_matching():
+    taint = Taint(key="k", effect="NoExecute")
+    assert Toleration(key="k", operator="Exists", effect="NoExecute").tolerates(taint)
+    assert not Toleration(key="k", operator="Exists", effect=NO_SCHEDULE).tolerates(taint)
+    assert Toleration(key="k", operator="Exists").tolerates(taint)  # empty effect = all
+
+
+def test_tolerates_all_prefer_no_schedule_soft():
+    taints = [Taint(key="soft", effect=PREFER_NO_SCHEDULE)]
+    assert tolerates_all([], taints)  # soft taints don't block
+    assert not tolerates_all([], [Taint(key="hard")])
+
+
+def test_provisioner_validation():
+    import pytest
+
+    from karpenter_tpu.api import ObjectMeta, Provisioner
+
+    p = Provisioner(meta=ObjectMeta(name="default"), consolidation_enabled=True,
+                    ttl_seconds_after_empty=30)
+    with pytest.raises(ValueError):
+        p.validate()
