@@ -1,0 +1,244 @@
+"""Benchmark harness — prints ONE JSON line with the headline metric.
+
+Headline (BASELINE.json north star): Solve() p50 latency for 50k pending pods x
+400 instance types x 3 AZs, spot-price weighted, target <100ms at >=95% packing
+efficiency. ``vs_baseline`` is the speedup factor against the 100ms target budget
+(>1.0 = faster than target). The reference itself is a single-threaded greedy Go
+packer with no published numbers (BASELINE.md), so the target budget is the bar.
+
+All five BASELINE configs run; per-config results land in the ``details`` field.
+"""
+
+from __future__ import annotations
+
+import json
+import statistics
+import sys
+import time
+
+import numpy as np
+
+REPEATS = 15
+TARGET_MS = 100.0
+
+
+def _pods(shapes):
+    from karpenter_tpu.api import ObjectMeta, Pod, Resources
+
+    out = []
+    for i, (prefix, n, cpu, mem, kw) in enumerate(shapes):
+        for j in range(n):
+            out.append(
+                Pod(
+                    meta=ObjectMeta(name=f"{prefix}-{j}", labels=dict(kw.get("labels", {}))),
+                    requests=Resources(cpu=cpu, memory=mem),
+                    node_selector=dict(kw.get("node_selector", {})),
+                    tolerations=list(kw.get("tolerations", [])),
+                    topology_spread=list(kw.get("spread", [])),
+                    affinity_terms=list(kw.get("affinity", [])),
+                )
+            )
+    return out
+
+
+def config_1k():
+    """1k pods, cpu+mem only, 20 types (the Go-FFD-baseline shape)."""
+    from karpenter_tpu.api import ObjectMeta, Provisioner
+    from karpenter_tpu.cloudprovider import generate_catalog
+
+    pods = _pods([
+        ("w", 600, "250m", "512Mi", {}),
+        ("m", 250, "800m", "2Gi", {}),
+        ("l", 150, "500m", "1Gi", {}),
+    ])
+    prov = Provisioner(meta=ObjectMeta(name="default"))
+    return pods, [(prov, generate_catalog(n_types=20))], []
+
+
+def config_5k_constrained():
+    """5k pods with nodeSelector + taints/tolerations across 3 provisioners."""
+    from karpenter_tpu.api import ObjectMeta, Provisioner, Taint, Toleration
+    from karpenter_tpu.api import labels as wk
+    from karpenter_tpu.cloudprovider import generate_catalog
+
+    cat = generate_catalog(n_types=100)
+    provs = []
+    tols = {}
+    for team in ("web", "batch", "ml"):
+        provs.append(
+            Provisioner(meta=ObjectMeta(name=team), taints=[Taint(key="team", value=team)])
+        )
+        tols[team] = [Toleration(key="team", operator="Equal", value=team)]
+    shapes = []
+    for i, team in enumerate(("web", "batch", "ml")):
+        for z, zone in enumerate(("zone-a", "zone-b", "zone-c")):
+            shapes.append(
+                (f"{team}-{zone}", 555, ["250m", "500m", "1"][i], ["512Mi", "1Gi", "2Gi"][i],
+                 {"node_selector": {wk.ZONE: zone}, "tolerations": tols[team]})
+            )
+    pods = _pods(shapes)
+    return pods, [(p, cat) for p in provs], []
+
+
+def config_10k_topology():
+    """10k pods with zone topology spread + hostname anti-affinity mixes."""
+    from karpenter_tpu.api import ObjectMeta, PodAffinityTerm, Provisioner, TopologySpreadConstraint
+    from karpenter_tpu.api import labels as wk
+    from karpenter_tpu.cloudprovider import generate_catalog
+
+    spread = lambda app: [
+        TopologySpreadConstraint(max_skew=1, topology_key=wk.ZONE, label_selector={"app": app})
+    ]
+    anti = lambda app: [
+        PodAffinityTerm(label_selector={"app": app}, topology_key=wk.HOSTNAME, anti=True)
+    ]
+    shapes = []
+    for i in range(8):
+        app = f"svc{i}"
+        shapes.append(
+            (app, 1200, ["250m", "500m"][i % 2], ["512Mi", "1Gi"][i % 2],
+             {"labels": {"app": app}, "spread": spread(app)})
+        )
+    for i in range(4):
+        app = f"db{i}"
+        shapes.append(
+            (app, 100, "1", "4Gi", {"labels": {"app": app}, "affinity": anti(app)})
+        )
+    pods = _pods(shapes)
+    prov = Provisioner(meta=ObjectMeta(name="default"))
+    return pods, [(prov, generate_catalog(n_types=150))], []
+
+
+def config_20k_repack():
+    """Consolidation-shaped: 2k in-flight nodes, 20k pods repacked to min cost."""
+    from karpenter_tpu.api import Node, ObjectMeta, Provisioner, Resources
+    from karpenter_tpu.api import labels as wk
+    from karpenter_tpu.cloudprovider import generate_catalog
+    from karpenter_tpu.solver import ExistingNode
+
+    cat = generate_catalog()
+    rng = np.random.default_rng(7)
+    existing = []
+    mids = [it for it in cat if 8 <= it.capacity["cpu"] <= 32]
+    for i in range(2000):
+        it = mids[int(rng.integers(0, len(mids)))]
+        zone = ["zone-a", "zone-b", "zone-c"][i % 3]
+        node = Node(
+            meta=ObjectMeta(
+                name=f"node-{i}",
+                labels={**it.requirements.labels(), wk.ZONE: zone,
+                        wk.PROVISIONER_NAME: "default", wk.INSTANCE_TYPE: it.name},
+            ),
+            capacity=it.capacity,
+            allocatable=it.allocatable(),
+            ready=True,
+        )
+        # nodes arrive partially utilized
+        util = float(rng.uniform(0.2, 0.7))
+        remaining = it.allocatable() * (1.0 - util)
+        existing.append(ExistingNode(node=node, remaining=remaining))
+    pods = _pods([
+        ("a", 8000, "250m", "512Mi", {}),
+        ("b", 6000, "500m", "1Gi", {}),
+        ("c", 4000, "1", "2Gi", {}),
+        ("d", 2000, "2", "4Gi", {}),
+    ])
+    prov = Provisioner(meta=ObjectMeta(name="default"))
+    return pods, [(prov, cat)], existing
+
+
+def config_50k_full():
+    """The north star: 50k pods x 400 types x 3 AZs, spot-price weighted."""
+    from karpenter_tpu.api import ObjectMeta, Provisioner
+    from karpenter_tpu.api import labels as wk
+    from karpenter_tpu.cloudprovider import generate_catalog
+
+    cat = generate_catalog(n_types=400)
+    rng = np.random.default_rng(11)
+    shapes = []
+    remaining = 50_000
+    cpus = ["100m", "250m", "500m", "1", "2", "4"]
+    mems = ["256Mi", "512Mi", "1Gi", "2Gi", "4Gi", "8Gi"]
+    for i in range(40):
+        n = int(rng.integers(300, 2500))
+        n = min(n, remaining - (39 - i))  # keep some for the tail
+        remaining -= n
+        sel = {}
+        if i % 5 == 0:
+            sel[wk.ZONE] = ["zone-a", "zone-b", "zone-c"][i % 3]
+        shapes.append(
+            (f"s{i}", n, cpus[int(rng.integers(0, 6))], mems[int(rng.integers(0, 6))],
+             {"node_selector": sel})
+        )
+    if remaining > 0:
+        shapes.append(("tail", remaining, "250m", "512Mi", {}))
+    pods = _pods(shapes)
+    prov = Provisioner(meta=ObjectMeta(name="default"))
+    return pods, [(prov, cat)], []
+
+
+CONFIGS = [
+    ("1k_basic", config_1k),
+    ("5k_constrained", config_5k_constrained),
+    ("10k_topology", config_10k_topology),
+    ("20k_repack", config_20k_repack),
+    ("50k_full", config_50k_full),
+]
+
+
+def bench_config(name, make, repeats=REPEATS):
+    from karpenter_tpu.solver import TPUSolver, encode, lower_bound, validate
+
+    pods, provs, existing = make()
+    t0 = time.perf_counter()
+    problem = encode(pods, provs, existing=existing)
+    encode_s = time.perf_counter() - t0
+    solver = TPUSolver(portfolio=8)
+    result = solver.solve(problem)  # warmup (compile)
+    violations = validate(problem, result)
+    times = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        result = solver.solve(problem)
+        times.append(time.perf_counter() - t0)
+    lb = float(lower_bound(problem))
+    eff = (lb / result.cost) if result.cost > 0 else 1.0
+    return {
+        "pods": len(pods),
+        "groups": problem.G,
+        "options": problem.O,
+        "existing": problem.E,
+        "solve_p50_ms": round(statistics.median(times) * 1e3, 3),
+        "solve_p90_ms": round(sorted(times)[int(len(times) * 0.9)] * 1e3, 3),
+        "encode_ms": round(encode_s * 1e3, 1),
+        "cost_per_hour": round(float(result.cost), 3),
+        "lower_bound": round(lb, 3),
+        "efficiency_vs_lb": round(float(eff), 4),
+        "unschedulable": len(result.unschedulable),
+        "violations": len(violations),
+        "backend": "tpu" if result.stats.get("backend") else "greedy",
+    }
+
+
+def main():
+    details = {}
+    for name, make in CONFIGS:
+        try:
+            details[name] = bench_config(name, make)
+        except Exception as e:  # a config failure shouldn't kill the whole bench
+            details[name] = {"error": f"{type(e).__name__}: {e}"}
+    head = details.get("50k_full", {})
+    p50 = head.get("solve_p50_ms", float("nan"))
+    line = {
+        "metric": "solve_p50_ms_50k_pods_400_types",
+        "value": p50,
+        "unit": "ms",
+        "vs_baseline": round(TARGET_MS / p50, 3) if p50 == p50 and p50 > 0 else 0.0,
+        "efficiency_vs_lb": head.get("efficiency_vs_lb"),
+        "details": details,
+    }
+    print(json.dumps(line))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,36 @@
+"""Global settings.
+
+Reference: the ``karpenter-global-settings`` ConfigMap injected into ctx
+(``/root/reference/pkg/apis/settings/settings.go:40-93``): cluster identity, batch
+tuning (batchIdleDuration 1s / batchMaxDuration 10s), vmMemoryOverheadPercent
+(0.075), feature gates (driftEnabled), interruption queue name.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+
+@dataclass
+class Settings:
+    cluster_name: str = "karpenter-tpu"
+    cluster_endpoint: str = ""
+    batch_idle_duration: float = 1.0  # settings.md:41-47
+    batch_max_duration: float = 10.0
+    vm_memory_overhead_percent: float = 0.075
+    interruption_queue_name: Optional[str] = None
+    drift_enabled: bool = True
+    node_name_convention: str = "resource-name"  # or ip-name
+    tags: Dict[str, str] = field(default_factory=dict)
+    # deprovisioning knobs (reference designs/consolidation.md:59-67)
+    consolidation_validation_ttl: float = 15.0
+    stabilization_window: float = 300.0
+
+    def validate(self) -> None:
+        if not self.cluster_name:
+            raise ValueError("cluster_name is required")
+        if self.batch_idle_duration < 0 or self.batch_max_duration < self.batch_idle_duration:
+            raise ValueError("invalid batch durations")
+        if not 0 <= self.vm_memory_overhead_percent < 1:
+            raise ValueError("vmMemoryOverheadPercent must be in [0,1)")

@@ -54,6 +54,8 @@ _SIZES = {
     "8xlarge": 64,
     "12xlarge": 96,
     "16xlarge": 128,
+    "24xlarge": 192,
+    "32xlarge": 256,
 }
 
 # category -> (GiB memory per vCPU, $ per vCPU-hour base)
@@ -62,10 +64,13 @@ _CATEGORIES = {
     "m": (4.0, 0.050),   # general purpose
     "r": (8.0, 0.062),   # memory-optimized
     "d": (4.0, 0.058),   # storage-dense (local NVMe)
+    "i": (8.0, 0.069),   # storage+memory (large local NVMe)
+    "h": (2.0, 0.048),   # hpc, high bandwidth
+    "x": (16.0, 0.086),  # extreme memory
     "t": (4.0, 0.042),   # burstable
 }
 
-_GENERATIONS = ("5", "6", "7")
+_GENERATIONS = ("4", "5", "6", "7")
 
 # TPU accelerator types: name -> (chips, vcpus, mem GiB, $/h on-demand)
 _ACCEL = {
@@ -167,8 +172,8 @@ def generate_catalog(
     kubelet: Optional[KubeletConfiguration] = None,
     include_accelerators: bool = True,
 ) -> List[InstanceType]:
-    """Deterministic catalog; ``n_types`` truncates (cheapest families first kept
-    diverse by interleaving categories)."""
+    """Deterministic catalog; ``n_types`` samples evenly across the size spectrum
+    so a truncated catalog still spans small through large types."""
     out: List[InstanceType] = []
     for gen in _GENERATIONS:
         gen_discount = 1.0 - 0.04 * (int(gen) - 5)  # newer generations slightly cheaper
@@ -178,7 +183,7 @@ def generate_catalog(
                     continue  # burstable caps out small
                 mem = gib_per_vcpu * vcpus
                 price = (base * vcpus + 0.004 * mem) * gen_discount
-                nvme = vcpus * 75 if cat == "d" else 0
+                nvme = vcpus * 75 if cat == "d" else (vcpus * 120 if cat == "i" else 0)
                 out.append(
                     make_instance_type(
                         f"{cat}{gen}.{size}",
@@ -212,8 +217,15 @@ def generate_catalog(
                 )
             )
     if n_types is not None and n_types < len(out):
-        # Interleave by size so truncation keeps category/size diversity.
-        out = sorted(out, key=lambda it: (it.capacity[CPU], it.name))[:n_types]
+        # Sample evenly across the size spectrum so a truncated catalog still
+        # spans small through large types (not just the N smallest).
+        ranked = sorted(out, key=lambda it: (it.capacity[CPU], it.name))
+        if n_types == 1:
+            out = [ranked[0]]
+        else:
+            # step > 1 under the n_types < len(out) guard, so indices are distinct
+            step = (len(ranked) - 1) / (n_types - 1)
+            out = [ranked[round(i * step)] for i in range(n_types)]
     return out
 
 

@@ -1,0 +1,8 @@
+from .provisioning import PodBatcher, ProvisioningController, ProvisioningResult, register_node
+
+__all__ = [
+    "PodBatcher",
+    "ProvisioningController",
+    "ProvisioningResult",
+    "register_node",
+]

@@ -1,0 +1,243 @@
+"""Provisioning controller: pending pods -> batch -> solve -> launch -> bind.
+
+The rebuild of core's provisioning controller + ``Scheduler.Solve()`` call path
+(reference call stack in SURVEY §3.2): a batcher windows pending pods (idle 1s /
+max 10s, ``/root/reference/website/.../settings.md:41-47``), the solver packs the
+batch onto existing in-flight capacity plus the cheapest feasible new offerings, and
+each new node spec becomes a Machine that the cloud provider launches
+(``CloudProvider.Create``, ``/root/reference/pkg/cloudprovider/cloudprovider.go:79``).
+
+Provisioner resource limits gate scale-up (``designs/limits.md``); insufficient
+capacity errors fall back offering-by-offering inside the provider and, if
+exhausted, leave pods pending for the next cycle with the ICE cache masking the
+failed offerings.
+"""
+
+from __future__ import annotations
+
+import itertools
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..api import labels as wk
+from ..api.objects import Machine, Node, ObjectMeta, Pod, Provisioner
+from ..api.requirements import Requirement, Requirements
+from ..api.resources import Resources, merge
+from ..api.settings import Settings
+from ..cloudprovider.interface import CloudProvider, CloudProviderError, InsufficientCapacityError
+from ..solver.encode import ExistingNode
+from ..solver.result import NewNodeSpec, SolveResult
+from ..solver.solver import Solver, TPUSolver
+from ..state.cluster import Cluster
+from ..utils import metrics
+from ..utils.events import Recorder
+
+_machine_ids = itertools.count(1)
+
+
+class PodBatcher:
+    """Windows pending-pod arrivals: fire after `idle` seconds of quiet or `max`
+    seconds total (reference batchIdleDuration/batchMaxDuration)."""
+
+    def __init__(self, idle: float = 1.0, max_duration: float = 10.0):
+        self.idle = idle
+        self.max_duration = max_duration
+        self._first: Optional[float] = None
+        self._last: Optional[float] = None
+
+    def note_arrival(self, now: Optional[float] = None) -> None:
+        now = time.monotonic() if now is None else now
+        if self._first is None:
+            self._first = now
+        self._last = now
+
+    def ready(self, now: Optional[float] = None) -> bool:
+        if self._first is None:
+            return False
+        now = time.monotonic() if now is None else now
+        return (now - self._last) >= self.idle or (now - self._first) >= self.max_duration
+
+    def reset(self) -> None:
+        self._first = None
+        self._last = None
+
+
+@dataclass
+class ProvisioningResult:
+    machines: List[Machine]
+    nodes: List[Node]
+    bound: Dict[str, str]  # pod name -> node name
+    unschedulable: List[str]
+    solve: Optional[SolveResult] = None
+
+
+class ProvisioningController:
+    def __init__(
+        self,
+        cluster: Cluster,
+        provider: CloudProvider,
+        solver: Optional[Solver] = None,
+        settings: Optional[Settings] = None,
+        recorder: Optional[Recorder] = None,
+    ):
+        self.cluster = cluster
+        self.provider = provider
+        self.solver = solver or TPUSolver()
+        self.settings = settings or Settings()
+        self.recorder = recorder or Recorder()
+        self.batcher = PodBatcher(
+            idle=self.settings.batch_idle_duration, max_duration=self.settings.batch_max_duration
+        )
+        cluster.watch(self._on_event)
+
+    def _on_event(self, event: str, obj) -> None:
+        if isinstance(obj, Pod) and event == "ADDED" and obj.is_pending() and not obj.is_daemonset:
+            self.batcher.note_arrival()
+
+    # -- the reconcile loop body -------------------------------------------
+    def reconcile(self) -> ProvisioningResult:
+        t0 = time.perf_counter()
+        pods = self.cluster.pending_pods()
+        result = ProvisioningResult(machines=[], nodes=[], bound={}, unschedulable=[])
+        if not pods:
+            return result
+
+        provisioners = sorted(
+            self.cluster.provisioners.values(), key=lambda p: -p.weight
+        )
+        if not provisioners:
+            result.unschedulable = [p.name for p in pods]
+            metrics.PODS_UNSCHEDULABLE.set(len(result.unschedulable))
+            return result
+
+        provs = [(p, self.provider.get_instance_types(p)) for p in provisioners]
+        existing = self.cluster.existing_capacity()
+        daemonsets = self.cluster.daemonsets()
+
+        solve = self.solver.solve_pods(pods, provs, existing=existing, daemonsets=daemonsets)
+        result.solve = solve
+        metrics.SOLVE_DURATION.observe(solve.stats.get("total_s", 0.0))
+
+        # bind pods onto existing nodes first
+        for node_name, pod_names in solve.existing_assignments.items():
+            for pod_name in pod_names:
+                self.cluster.bind_pod(pod_name, node_name)
+                result.bound[pod_name] = node_name
+                metrics.PODS_SCHEDULED.inc()
+
+        # launch new nodes, honoring provisioner limits
+        usage: Dict[str, Resources] = {}
+        for spec in solve.new_nodes:
+            prov = spec.option.provisioner
+            if prov.limits is not None:
+                used = usage.get(prov.name)
+                if used is None:
+                    used = self.cluster.provisioner_usage(prov.name)
+                projected = used + spec.option.instance_type.capacity
+                if projected.any_exceeds(prov.limits):
+                    self.recorder.publish(
+                        "LimitExceeded",
+                        f"provisioner {prov.name} resource limits reached",
+                        object_name=prov.name,
+                        object_kind="Provisioner",
+                        type="Warning",
+                    )
+                    result.unschedulable.extend(spec.pod_names)
+                    continue
+                usage[prov.name] = projected
+            try:
+                machine, node = self._launch(spec)
+            except InsufficientCapacityError:
+                # offerings exhausted even after in-provider fallback: pods stay
+                # pending; the ICE cache masks these offerings next cycle
+                # (instance.go:400-406)
+                result.unschedulable.extend(spec.pod_names)
+                continue
+            except Exception as e:
+                # Any launch failure (cloud API outage, throttling, SDK error) is
+                # retryable next cycle — it must not abort the rest of the batch.
+                metrics.CLOUDPROVIDER_ERRORS.inc()
+                self.recorder.publish(
+                    "LaunchFailed", str(e), object_name=machineless_name(spec), type="Warning"
+                )
+                result.unschedulable.extend(spec.pod_names)
+                continue
+            result.machines.append(machine)
+            result.nodes.append(node)
+            metrics.NODES_CREATED.inc({"provisioner": prov.name})
+            for pod_name in spec.pod_names:
+                self.cluster.bind_pod(pod_name, node.name)
+                result.bound[pod_name] = node.name
+                metrics.PODS_SCHEDULED.inc()
+
+        result.unschedulable.extend(solve.unschedulable)
+        for name in solve.unschedulable:
+            self.recorder.publish(
+                "FailedScheduling", "no feasible instance offering", object_name=name,
+                object_kind="Pod", type="Warning",
+            )
+        metrics.PODS_UNSCHEDULABLE.set(float(len(result.unschedulable)))
+        metrics.PROVISIONING_DURATION.observe(time.perf_counter() - t0)
+        self.batcher.reset()
+        return result
+
+    def _launch(self, spec: NewNodeSpec) -> Tuple[Machine, Node]:
+        option = spec.option
+        prov = option.provisioner
+        name = f"{prov.name}-{next(_machine_ids)}"
+        machine = Machine(
+            meta=ObjectMeta(name=name, labels=dict(prov.labels)),
+            provisioner_name=prov.name,
+            requirements=Requirements(
+                [
+                    Requirement.in_values(wk.INSTANCE_TYPE, [option.instance_type.name]),
+                    Requirement.in_values(wk.ZONE, [option.zone]),
+                    Requirement.in_values(wk.CAPACITY_TYPE, [option.capacity_type]),
+                ]
+            ),
+            requests=merge(
+                [self._pod_requests(n) for n in spec.pod_names]
+            ),
+            taints=list(prov.taints),
+            kubelet=prov.kubelet,
+            node_template_ref=prov.node_template_ref,
+        )
+        t0 = time.perf_counter()
+        machine = self.provider.create(machine)
+        metrics.CLOUDPROVIDER_DURATION.observe(
+            time.perf_counter() - t0, {"method": "create"}
+        )
+        self.cluster.add_machine(machine)
+        node = register_node(self.cluster, machine, prov)
+        return machine, node
+
+    def _pod_requests(self, pod_name: str) -> Resources:
+        pod = self.cluster.pods.get(pod_name)
+        return pod.requests if pod else Resources()
+
+
+def machineless_name(spec: NewNodeSpec) -> str:
+    return f"{spec.option.provisioner.name}/{spec.instance_type_name}"
+
+
+def register_node(cluster: Cluster, machine: Machine, provisioner: Provisioner) -> Node:
+    """Machine -> Node registration (the kubelet's role in a real cluster; core's
+    machine lifecycle launch->registration->initialization, SURVEY §2.2)."""
+    node = Node(
+        meta=ObjectMeta(
+            name=machine.name,
+            labels=dict(machine.meta.labels),
+            finalizers=[wk.TERMINATION_FINALIZER],
+        ),
+        provider_id=machine.status.provider_id,
+        capacity=machine.status.capacity,
+        allocatable=machine.status.allocatable,
+        taints=list(machine.taints) + list(provisioner.startup_taints),
+        ready=True,
+        machine_name=machine.name,
+    )
+    machine.status.registered = True
+    machine.status.initialized = True
+    cluster.add_node(node)
+    return node

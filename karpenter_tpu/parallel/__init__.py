@@ -1,0 +1,3 @@
+from .mesh import PORTFOLIO_AXIS, make_mesh, round_up_portfolio, shard_portfolio
+
+__all__ = ["PORTFOLIO_AXIS", "make_mesh", "round_up_portfolio", "shard_portfolio"]

@@ -1,0 +1,48 @@
+"""Device-mesh distribution of the solver portfolio.
+
+The reference's "parallelism" is goroutine fan-out (SURVEY §2.3); the TPU-native
+equivalent is SPMD over a device mesh: the portfolio axis (independent packing
+strategies) is embarrassingly parallel, so members shard across chips via
+``jax.sharding`` and the winner reduces with a single argmin — collectives ride ICI,
+no host round-trips. This is the data-parallel axis of the BASELINE north star
+("vmapped FFD ... across TPU cores").
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+PORTFOLIO_AXIS = "portfolio"
+
+
+def make_mesh(n_devices: Optional[int] = None) -> Mesh:
+    devices = jax.devices()
+    n = n_devices or len(devices)
+    if len(devices) < n:
+        raise ValueError(f"need {n} devices, have {len(devices)}")
+    return Mesh(np.array(devices[:n]), (PORTFOLIO_AXIS,))
+
+
+def shard_portfolio(mesh: Mesh, inputs, orders: jax.Array, alphas: jax.Array):
+    """Place portfolio members across the mesh; problem tensors replicate.
+
+    orders/alphas lead with the portfolio axis; K must divide evenly by mesh size
+    (make_orders rounds K up to a multiple of the device count when sharding).
+    """
+    member = NamedSharding(mesh, P(PORTFOLIO_AXIS))
+    replicated = NamedSharding(mesh, P())
+    orders = jax.device_put(orders, member)
+    alphas = jax.device_put(alphas, member)
+    inputs = jax.tree.map(lambda x: jax.device_put(x, replicated), inputs)
+    return inputs, orders, alphas
+
+
+def round_up_portfolio(k: int, mesh: Optional[Mesh]) -> int:
+    if mesh is None:
+        return k
+    d = mesh.devices.size
+    return ((k + d - 1) // d) * d

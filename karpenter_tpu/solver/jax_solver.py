@@ -58,6 +58,9 @@ class PackInputs(NamedTuple):
 
 def _units(rem: jax.Array, d: jax.Array) -> jax.Array:
     """How many whole pods of per-pod demand d fit in each remaining vector."""
+    # Epsilon is biased toward PLACING: overcounting by float noise is caught by
+    # the validator's relative tolerance (or falls back to the oracle), while
+    # undercounting would silently strand an exactly-fitting pod with no recheck.
     safe = jnp.where(d > 0, rem / jnp.maximum(d, 1e-30), INF)
     u = jnp.floor(jnp.min(safe, axis=-1) + 1e-4)
     return jnp.clip(u, 0, IBIG).astype(jnp.int32)
@@ -237,6 +240,62 @@ def pack_single_assign(
 ):
     """Phase 2: re-run the winning member emitting assignments."""
     return _pack_one(inputs, order, alpha, s_new, n_zones, with_assignments=True)
+
+
+@functools.partial(jax.jit, static_argnames=("s_new", "n_zones"))
+def pack_solve_fused(
+    inputs: PackInputs, orders: jax.Array, alphas: jax.Array, s_new: int, n_zones: int
+) -> jax.Array:
+    """Full solve in ONE device call: evaluate the portfolio, argmin the winner on
+    device, re-run it with assignments, and pack everything into a single int32
+    buffer so the host pays exactly one transfer round-trip.
+
+    Layout of the returned [2 + K + K + S + S + G*(E+S)] int32 vector:
+      [0] best member index        [1] unplaced count of the winner
+      [2:2+K] member costs (f32 bitcast)   [2+K:2+2K] member slot-exhaustion flags
+      [.. S] new_opt   [.. S] new_active
+      [..] ys assignment counts, row-major [G, E+S] in the winner's scan order.
+    The winner's order row is gathered on device; the host recovers group identity
+    from its own copy of `orders`.
+    """
+    costs, unplaced, exhausted = jax.vmap(
+        lambda o, a: _pack_one(inputs, o, a, s_new, n_zones, with_assignments=False)
+    )(orders, alphas)
+    best = jnp.argmin(costs).astype(jnp.int32)
+    _, left, new_opt, new_active, ys = _pack_one(
+        inputs, orders[best], alphas[best], s_new, n_zones, with_assignments=True
+    )
+    return jnp.concatenate(
+        [
+            jnp.stack([best, left]),
+            _bitcast_f32_i32(costs),
+            exhausted.astype(jnp.int32),
+            new_opt,
+            new_active.astype(jnp.int32),
+            ys.reshape(-1),
+        ]
+    )
+
+
+def _bitcast_f32_i32(x: jax.Array) -> jax.Array:
+    return lax.bitcast_convert_type(x.astype(jnp.float32), jnp.int32)
+
+
+def unpack_solve_fused(buf: np.ndarray, k: int, s_new: int, g: int, e_pad: int):
+    """Host-side unpacking of the pack_solve_fused buffer."""
+    best = int(buf[0])
+    unplaced = int(buf[1])
+    off = 2
+    costs = np.frombuffer(buf[off : off + k].tobytes(), dtype=np.float32)
+    off += k
+    exhausted = buf[off : off + k].astype(bool)
+    off += k
+    new_opt = buf[off : off + s_new]
+    off += s_new
+    new_active = buf[off : off + s_new].astype(bool)
+    off += s_new
+    ys = buf[off:].reshape(g, e_pad + s_new)
+    return best, unplaced, costs, exhausted, new_opt, new_active, ys
 
 
 def make_orders(

@@ -15,6 +15,7 @@ class NewNodeSpec:
 
     option: LaunchOption
     pod_names: List[str] = field(default_factory=list)
+    option_index: Optional[int] = None  # index into EncodedProblem.options, if known
 
     @property
     def instance_type_name(self) -> str:

@@ -25,7 +25,12 @@ from ..api.objects import Pod, Provisioner
 from ..cloudprovider.types import InstanceType
 from .encode import EncodedProblem, ExistingNode, LaunchOption, encode
 from .greedy import GreedyPacker
-from .jax_solver import PackInputs, make_orders, pack_portfolio_cost, pack_single_assign
+from .jax_solver import (
+    PackInputs,
+    make_orders,
+    pack_solve_fused,
+    unpack_solve_fused,
+)
 from .result import NewNodeSpec, SolveResult
 from .validate import validate
 
@@ -119,6 +124,10 @@ class TPUSolver(Solver):
         self.seed = seed
         self.max_slots = max_slots
         self._fallback = GreedySolver()
+        # Device-resident input cache: repeated solves of the same encoded problem
+        # (benchmarks, consolidation candidate sweeps) pay zero re-upload. The
+        # tunnel/PCIe round-trip is the latency floor, so transfers are hoarded.
+        self._device_cache: dict = {}
 
     def solve(self, problem: EncodedProblem) -> SolveResult:
         t0 = time.perf_counter()
@@ -134,31 +143,33 @@ class TPUSolver(Solver):
             result.stats["fallback"] = 1.0
             return result
 
-        inputs, orders, alphas, s_new, n_zones = self._prepare(problem)
-        import jax.numpy as jnp
-
+        inputs, orders, alphas, s_new, n_zones = self._device_inputs(problem)
+        k = orders.shape[0]
+        Gp = inputs.count.shape[0]
+        Ep = inputs.ex_valid.shape[0]
         while True:
-            costs, unplaced, exhausted = pack_portfolio_cost(
-                inputs, jnp.asarray(orders), jnp.asarray(alphas), s_new, n_zones
+            # ONE device call, ONE host fetch: portfolio eval + on-device argmin +
+            # winner re-run, packed into a single int32 buffer.
+            buf = np.asarray(
+                pack_solve_fused(inputs, orders, alphas, s_new, n_zones)
             )
-            costs = np.asarray(costs)
-            unplaced = np.asarray(unplaced)
-            exhausted = np.asarray(exhausted)
+            best, unplaced, costs, exhausted, new_opt, new_active, ys = unpack_solve_fused(
+                buf, k, s_new, Gp, Ep
+            )
             # Grow S only when members actually ran out of slots; leftover pods
             # with free slots are genuinely unschedulable and re-running can't help.
-            if exhausted.any() and unplaced.min() > 0 and s_new < self.max_slots:
+            if exhausted.any() and unplaced > 0 and s_new < self.max_slots:
+                # Only the static slot count changes — reuse the device-resident
+                # tensors, just re-store the cache entry with the larger S.
                 s_new *= 2
+                self._device_cache[id(problem)] = (
+                    problem, inputs, orders, alphas, s_new, n_zones
+                )
                 continue
             break
-        best = int(np.argmin(costs))
-        _, _, new_opt, new_active, ys = pack_single_assign(
-            inputs, jnp.asarray(orders[best]), jnp.asarray(alphas[best]), s_new, n_zones
-        )
         t_solve = time.perf_counter() - t0
-        result = self._decode(
-            problem, np.asarray(orders[best]), np.asarray(new_opt), np.asarray(new_active),
-            np.asarray(ys),
-        )
+        order_host = self._host_orders[best]
+        result = self._decode(problem, order_host, new_opt, new_active, ys)
         result.stats["solve_s"] = t_solve
         result.stats["backend"] = 1.0
         result.stats["portfolio_best"] = float(best)
@@ -169,6 +180,25 @@ class TPUSolver(Solver):
             fallback.stats["tpu_violations"] = float(len(violations))
             return fallback
         return result
+
+    def _device_inputs(self, problem: EncodedProblem):
+        """Problem tensors on device, cached by problem identity. The entry holds a
+        strong reference to the problem so a recycled id() can never alias a
+        different problem onto stale tensors."""
+        import jax
+        import jax.numpy as jnp
+
+        key = id(problem)
+        cached = self._device_cache.get(key)
+        if cached is not None and cached[0] is problem:
+            return cached[1:]
+        inputs, orders, alphas, s_new, n_zones = self._prepare(problem)
+        self._host_orders = orders
+        inputs = jax.tree.map(jnp.asarray, inputs)
+        entry = (problem, inputs, jnp.asarray(orders), jnp.asarray(alphas), s_new, n_zones)
+        self._device_cache.clear()  # hold at most one problem resident
+        self._device_cache[key] = entry
+        return entry[1:]
 
     # -- encoding to device-ready padded arrays -----------------------------
     def _prepare(self, problem: EncodedProblem):
@@ -278,17 +308,19 @@ class TPUSolver(Solver):
         new_pods: List[List[str]] = [[] for _ in range(s_new)]
         existing_assignments = {}
         unschedulable: List[str] = []
-        for t in range(ys.shape[0]):
+        # Only walk nonzero placements — ys is [G, Ep+S] and mostly zeros.
+        rows, cols = np.nonzero(ys)
+        placements_by_row: dict = {}
+        for t, s in zip(rows.tolist(), cols.tolist()):
+            placements_by_row.setdefault(t, []).append(s)
+        for t, slots in placements_by_row.items():
             g = int(order[t])
             if g >= problem.G:
                 continue
             group = problem.groups[g]
             cursor = 0
-            row = ys[t]
-            for s in range(Ep + s_new):
-                n = int(row[s])
-                if n <= 0:
-                    continue
+            for s in sorted(slots):
+                n = int(ys[t, s])
                 names = [p.name for p in group.pods[cursor : cursor + n]]
                 cursor += n
                 if s < Ep:
@@ -299,14 +331,21 @@ class TPUSolver(Solver):
                     new_pods[s - Ep].extend(names)
             if cursor < group.count:
                 unschedulable.extend(p.name for p in group.pods[cursor:])
+        # groups with zero placements anywhere are wholly unschedulable
+        placed_rows = set(placements_by_row)
+        for t in range(ys.shape[0]):
+            g = int(order[t])
+            if g < problem.G and t not in placed_rows:
+                unschedulable.extend(p.name for p in problem.groups[g].pods)
 
         new_nodes = []
         cost = 0.0
         for s in range(s_new):
             if not new_active[s] or not new_pods[s]:
                 continue
-            option = problem.options[int(new_opt[s])]
-            new_nodes.append(NewNodeSpec(option=option, pod_names=new_pods[s]))
+            j = int(new_opt[s])
+            option = problem.options[j]
+            new_nodes.append(NewNodeSpec(option=option, pod_names=new_pods[s], option_index=j))
             cost += option.price
         return SolveResult(
             new_nodes=new_nodes,

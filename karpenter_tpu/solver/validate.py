@@ -20,6 +20,12 @@ from .encode import EncodedProblem
 from .result import SolveResult
 
 
+# Relative capacity tolerance: the packing kernel runs in normalized f32, so unit
+# counts can overshoot true capacity by float noise (~1e-4 of a node). That is far
+# inside the kubelet reserve margins; anything beyond it is a real violation.
+CAP_RTOL = 5e-4
+
+
 def validate(problem: EncodedProblem, result: SolveResult) -> List[str]:
     """Returns a list of violation descriptions; empty means feasible."""
     violations: List[str] = []
@@ -32,20 +38,29 @@ def validate(problem: EncodedProblem, result: SolveResult) -> List[str]:
     placements: List[tuple] = []  # (host_id, zone, gi, pod)
 
     # -- new nodes: capacity + compat -----------------------------------
+    option_index_by_id = {id(o): j for j, o in enumerate(problem.options)}
     for idx, spec in enumerate(result.new_nodes):
-        j = problem.options.index(spec.option)
-        used = np.zeros(len(problem.resource_axes), dtype=np.float64)
+        j = spec.option_index
+        if j is None:
+            j = option_index_by_id.get(id(spec.option))
+        if j is None:
+            violations.append(f"new node {idx} references an unknown launch option")
+            continue
         host = f"new-{idx}"
+        group_counts: Dict[int, int] = defaultdict(int)
         for name in spec.pod_names:
             if name not in pod_by_name:
                 violations.append(f"unknown pod {name} on {host}")
                 continue
             gi, pod = pod_by_name[name]
-            if not problem.compat[gi, j]:
-                violations.append(f"pod {name} incompatible with option {j} on {host}")
-            used += problem.demand[gi]
+            group_counts[gi] += 1
             placements.append((host, spec.option.zone, gi, pod))
-        over = used > problem.alloc[j] + 1e-6
+        used = np.zeros(len(problem.resource_axes), dtype=np.float64)
+        for gi, n in group_counts.items():
+            if not problem.compat[gi, j]:
+                violations.append(f"group {gi} incompatible with option {j} on {host}")
+            used += problem.demand[gi] * n
+        over = used > problem.alloc[j] * (1 + CAP_RTOL) + 1e-6
         if np.any(over):
             axes = [problem.resource_axes[k] for k in np.where(over)[0]]
             violations.append(f"{host} over capacity on {axes}")
@@ -57,17 +72,20 @@ def validate(problem: EncodedProblem, result: SolveResult) -> List[str]:
             violations.append(f"unknown existing node {node_name}")
             continue
         k = ex_index[node_name]
-        used = np.zeros(len(problem.resource_axes), dtype=np.float64)
+        group_counts = defaultdict(int)
         for name in names:
             if name not in pod_by_name:
                 violations.append(f"unknown pod {name} on existing node {node_name}")
                 continue
             gi, pod = pod_by_name[name]
-            if not problem.ex_compat[gi, k]:
-                violations.append(f"pod {name} incompatible with existing node {node_name}")
-            used += problem.demand[gi]
+            group_counts[gi] += 1
             placements.append((node_name, problem.existing[k].node.zone(), gi, pod))
-        over = used > problem.ex_rem[k] + 1e-6
+        used = np.zeros(len(problem.resource_axes), dtype=np.float64)
+        for gi, n in group_counts.items():
+            if not problem.ex_compat[gi, k]:
+                violations.append(f"group {gi} incompatible with existing node {node_name}")
+            used += problem.demand[gi] * n
+        over = used > problem.ex_rem[k] * (1 + CAP_RTOL) + 1e-6
         if np.any(over):
             axes = [problem.resource_axes[kk] for kk in np.where(over)[0]]
             violations.append(f"existing {node_name} over capacity on {axes}")
@@ -83,50 +101,56 @@ def validate(problem: EncodedProblem, result: SolveResult) -> List[str]:
         violations.append(f"pods placed more than once: {double[:5]}")
 
     # -- topology spread / anti-affinity / colocation --------------------
+    # Selector matching depends only on group labels, so aggregate placements to
+    # (group, host, zone) counts once and evaluate constraints at group level.
+    agg: Dict[tuple, int] = defaultdict(int)  # (gi, host, zone) -> count
+    for host, zone, gi, _ in placements:
+        agg[(gi, host, zone or "")] += 1
+    reps = [g.pods[0] for g in problem.groups]
     for gi, g in enumerate(problem.groups):
-        rep = g.pods[0]
+        rep = reps[gi]
         for c in rep.topology_spread:
             if c.when_unsatisfiable != "DoNotSchedule":
                 continue
+            selected_groups = [gj for gj, r in enumerate(reps) if c.selects(r)]
             counts: Dict[str, int] = defaultdict(int)
-            for host, zone, _, pod in placements:
-                if c.selects(pod):
-                    key = host if c.topology_key == wk.HOSTNAME else (zone or "")
-                    counts[key] += 1
+            for (gj, host, zone), n in agg.items():
+                if gj in selected_groups:
+                    key = host if c.topology_key == wk.HOSTNAME else zone
+                    counts[key] += n
             if counts:
                 # min domain count is 0 as long as an empty feasible domain exists;
                 # conservatively compare against 0 for new-capacity scenarios.
-                if max(counts.values()) - 0 > c.max_skew and c.topology_key == wk.HOSTNAME:
+                if c.topology_key == wk.HOSTNAME and max(counts.values()) > c.max_skew:
                     violations.append(
                         f"group {gi} hostname spread skew {max(counts.values())} > {c.max_skew}"
                     )
-                if c.topology_key == wk.ZONE and len(counts) > 0:
+                if c.topology_key == wk.ZONE:
                     skew = max(counts.values()) - min(
                         [counts.get(z, 0) for z in problem.zones] or [0]
                     )
                     if skew > c.max_skew:
                         violations.append(f"group {gi} zone spread skew {skew} > {c.max_skew}")
         for term in rep.affinity_terms:
-            domains: Dict[str, int] = defaultdict(int)
-            my_hosts = set()
-            for host, zone, _, pod in placements:
-                key = host if term.topology_key == wk.HOSTNAME else (zone or "")
-                if term.selects(pod):
-                    domains[key] += 1
-                if pod.name in {q.name for q in g.pods}:
-                    my_hosts.add(key)
+            my_domains = {
+                (host if term.topology_key == wk.HOSTNAME else zone)
+                for (gj, host, zone), n in agg.items()
+                if gj == gi and n > 0
+            }
             if term.anti:
-                for key, n in domains.items():
-                    mine = sum(
-                        1
-                        for host, zone, gj, pod in placements
-                        if gj == gi and (host if term.topology_key == wk.HOSTNAME else zone) == key
-                    )
-                    others = n
-                    if term.selects(rep) and mine > 1:
-                        violations.append(f"group {gi} anti-affinity violated in {key}")
-            elif term.selects(rep) and len(my_hosts) > 1:
-                violations.append(f"group {gi} required self-affinity split across {len(my_hosts)}")
+                if term.selects(rep):
+                    domain_counts: Dict[str, int] = defaultdict(int)
+                    for (gj, host, zone), n in agg.items():
+                        if gj == gi:
+                            key = host if term.topology_key == wk.HOSTNAME else zone
+                            domain_counts[key] += n
+                    for key, n in domain_counts.items():
+                        if n > 1:
+                            violations.append(f"group {gi} anti-affinity violated in {key}")
+            elif term.selects(rep) and len(my_domains) > 1:
+                violations.append(
+                    f"group {gi} required self-affinity split across {len(my_domains)}"
+                )
     return violations
 
 

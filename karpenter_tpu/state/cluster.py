@@ -1,0 +1,193 @@
+"""In-memory cluster state: the apiserver-shaped object store + capacity model.
+
+Two reference roles merged into one subsystem:
+
+* the kube-apiserver object store the controllers reconcile against (the tests'
+  envtest environment, SURVEY §4 — nodes are plain objects, no kubelets), and
+* core's ``state.Cluster`` in-memory model of nodes/pods/bindings that drives
+  scheduling and consolidation (``state.NewCluster`` at
+  ``/root/reference/cmd/controller/main.go:60``).
+
+Watch callbacks give controllers the reconcile-trigger shape of controller-runtime
+informers without the network layer.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import defaultdict
+from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple
+
+from ..api import labels as wk
+from ..api.objects import (
+    Machine,
+    Node,
+    Pod,
+    PodDisruptionBudget,
+    Provisioner,
+    NodeTemplate,
+)
+from ..api.resources import Resources, merge
+from ..solver.encode import ExistingNode
+
+WatchFn = Callable[[str, object], None]  # (event_type: ADDED|MODIFIED|DELETED, obj)
+
+
+class Cluster:
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self.pods: Dict[str, Pod] = {}
+        self.nodes: Dict[str, Node] = {}
+        self.machines: Dict[str, Machine] = {}
+        self.provisioners: Dict[str, Provisioner] = {}
+        self.node_templates: Dict[str, NodeTemplate] = {}
+        self.pdbs: Dict[str, PodDisruptionBudget] = {}
+        self._watchers: List[WatchFn] = []
+        self._version = 0
+
+    # -- store primitives --------------------------------------------------
+    def _emit(self, event: str, obj) -> None:
+        for w in list(self._watchers):
+            w(event, obj)
+
+    def watch(self, fn: WatchFn) -> None:
+        with self._lock:
+            self._watchers.append(fn)
+
+    def _put(self, coll: Dict[str, object], obj, name: str) -> None:
+        with self._lock:
+            event = "MODIFIED" if name in coll else "ADDED"
+            self._version += 1
+            obj.meta.resource_version = self._version
+            coll[name] = obj
+        self._emit(event, obj)
+
+    def _delete(self, coll: Dict[str, object], name: str):
+        with self._lock:
+            obj = coll.pop(name, None)
+        if obj is not None:
+            self._emit("DELETED", obj)
+        return obj
+
+    # -- typed accessors ---------------------------------------------------
+    def add_pod(self, pod: Pod) -> Pod:
+        self._put(self.pods, pod, pod.name)
+        return pod
+
+    def delete_pod(self, name: str) -> Optional[Pod]:
+        return self._delete(self.pods, name)
+
+    def add_node(self, node: Node) -> Node:
+        self._put(self.nodes, node, node.name)
+        return node
+
+    def delete_node(self, name: str) -> Optional[Node]:
+        return self._delete(self.nodes, name)
+
+    def add_machine(self, machine: Machine) -> Machine:
+        self._put(self.machines, machine, machine.name)
+        return machine
+
+    def delete_machine(self, name: str) -> Optional[Machine]:
+        return self._delete(self.machines, name)
+
+    def add_provisioner(self, provisioner: Provisioner) -> Provisioner:
+        provisioner.validate()
+        self._put(self.provisioners, provisioner, provisioner.name)
+        return provisioner
+
+    def delete_provisioner(self, name: str) -> Optional[Provisioner]:
+        return self._delete(self.provisioners, name)
+
+    def add_node_template(self, t: NodeTemplate) -> NodeTemplate:
+        self._put(self.node_templates, t, t.name)
+        return t
+
+    def add_pdb(self, pdb: PodDisruptionBudget) -> PodDisruptionBudget:
+        self._put(self.pdbs, pdb, pdb.meta.name)
+        return pdb
+
+    def update(self, obj) -> None:
+        """Re-announce a mutated object (bump version, fire watches)."""
+        if isinstance(obj, Pod):
+            self._put(self.pods, obj, obj.name)
+        elif isinstance(obj, Node):
+            self._put(self.nodes, obj, obj.name)
+        elif isinstance(obj, Machine):
+            self._put(self.machines, obj, obj.name)
+        elif isinstance(obj, Provisioner):
+            self._put(self.provisioners, obj, obj.name)
+        elif isinstance(obj, NodeTemplate):
+            self._put(self.node_templates, obj, obj.name)
+        else:
+            raise TypeError(f"unknown object {type(obj)}")
+
+    # -- queries (the scheduling-relevant views) ---------------------------
+    def pending_pods(self) -> List[Pod]:
+        with self._lock:
+            return [
+                p
+                for p in self.pods.values()
+                if p.is_pending() and not p.is_daemonset and p.meta.deletion_timestamp is None
+            ]
+
+    def daemonsets(self) -> List[Pod]:
+        """Daemonset pod templates (one representative per daemonset)."""
+        with self._lock:
+            return [p for p in self.pods.values() if p.is_daemonset and p.node_name is None]
+
+    def bind_pod(self, pod_name: str, node_name: str) -> None:
+        with self._lock:
+            pod = self.pods[pod_name]
+            pod.node_name = node_name
+            pod.phase = "Running"
+        self._emit("MODIFIED", pod)
+
+    def pods_on_node(self, node_name: str) -> List[Pod]:
+        with self._lock:
+            return [p for p in self.pods.values() if p.node_name == node_name]
+
+    def node_remaining(self, node: Node) -> Resources:
+        """Allocatable minus the requests of everything bound to the node."""
+        bound = merge([p.requests + Resources(pods=1) for p in self.pods_on_node(node.name)])
+        return (node.allocatable - bound).clamp_min_zero()
+
+    def managed_nodes(self, provisioner: Optional[str] = None) -> List[Node]:
+        with self._lock:
+            out = []
+            for n in self.nodes.values():
+                pname = n.provisioner_name()
+                if pname is None:
+                    continue
+                if provisioner is not None and pname != provisioner:
+                    continue
+                out.append(n)
+            return out
+
+    def existing_capacity(self) -> List[ExistingNode]:
+        """Schedulable in-flight capacity for the solver: every ready, managed,
+        non-deleting node with its remaining allocatable."""
+        out = []
+        for n in self.managed_nodes():
+            if n.unschedulable or n.meta.deletion_timestamp is not None:
+                continue
+            out.append(ExistingNode(node=n, remaining=self.node_remaining(n)))
+        return out
+
+    def provisioner_usage(self, provisioner: str) -> Resources:
+        """Total capacity footprint of a provisioner's nodes — compared against
+        Provisioner.limits (reference designs/limits.md)."""
+        return merge([n.capacity for n in self.managed_nodes(provisioner)])
+
+    def machine_for_node(self, node: Node) -> Optional[Machine]:
+        with self._lock:
+            if node.machine_name:
+                return self.machines.get(node.machine_name)
+            for m in self.machines.values():
+                if m.status.provider_id and m.status.provider_id == node.provider_id:
+                    return m
+        return None
+
+    def pdbs_for_pod(self, pod: Pod) -> List[PodDisruptionBudget]:
+        with self._lock:
+            return [b for b in self.pdbs.values() if b.selects(pod)]

@@ -1,0 +1,58 @@
+"""Kubernetes-style event recording.
+
+Reference: core's events.Recorder used by every controller (e.g.
+``/root/reference/pkg/controllers/interruption/events/events.go``) to surface
+user-visible decisions as k8s Events.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional
+
+
+@dataclass(frozen=True)
+class Event:
+    reason: str
+    message: str
+    object_name: str = ""
+    object_kind: str = ""
+    type: str = "Normal"  # Normal | Warning
+    timestamp: float = field(default_factory=time.time)
+
+
+class Recorder:
+    def __init__(self) -> None:
+        self._events: List[Event] = []
+        self._lock = threading.Lock()
+        self._sinks: List[Callable[[Event], None]] = []
+
+    def publish(
+        self,
+        reason: str,
+        message: str,
+        object_name: str = "",
+        object_kind: str = "",
+        type: str = "Normal",
+    ) -> None:
+        event = Event(reason=reason, message=message, object_name=object_name,
+                      object_kind=object_kind, type=type)
+        with self._lock:
+            self._events.append(event)
+            sinks = list(self._sinks)
+        for sink in sinks:
+            sink(event)
+
+    def subscribe(self, sink: Callable[[Event], None]) -> None:
+        with self._lock:
+            self._sinks.append(sink)
+
+    def events(self, reason: Optional[str] = None) -> List[Event]:
+        with self._lock:
+            return [e for e in self._events if reason is None or e.reason == reason]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._events.clear()

@@ -1,0 +1,154 @@
+"""Prometheus-style in-process metrics registry.
+
+Mirrors the reference's metric catalog shape (counters/histograms with label
+dimensions — ``/root/reference/pkg/controllers/interruption/metrics.go:31-66``,
+``designs/metrics.md:199-247``). Exposition is text-format compatible so the
+registry can back a real scrape endpoint later.
+"""
+
+from __future__ import annotations
+
+import threading
+from bisect import bisect_right
+from typing import Dict, List, Optional, Sequence, Tuple
+
+_DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+
+LabelKey = Tuple[Tuple[str, str], ...]
+
+
+def _key(labels: Optional[Dict[str, str]]) -> LabelKey:
+    return tuple(sorted((labels or {}).items()))
+
+
+class Counter:
+    def __init__(self, name: str, help: str = "", registry: "Registry | None" = None):
+        self.name = name
+        self.help = help
+        self._values: Dict[LabelKey, float] = {}
+        self._lock = threading.Lock()
+        if registry is not None:
+            registry.register(self)
+
+    def inc(self, labels: Optional[Dict[str, str]] = None, value: float = 1.0) -> None:
+        k = _key(labels)
+        with self._lock:
+            self._values[k] = self._values.get(k, 0.0) + value
+
+    def value(self, labels: Optional[Dict[str, str]] = None) -> float:
+        return self._values.get(_key(labels), 0.0)
+
+    def collect(self) -> List[str]:
+        lines = [f"# TYPE {self.name} counter"]
+        for k, v in sorted(self._values.items()):
+            lines.append(f"{self.name}{_fmt(k)} {v}")
+        return lines
+
+
+class Gauge(Counter):
+    def set(self, value: float, labels: Optional[Dict[str, str]] = None) -> None:
+        with self._lock:
+            self._values[_key(labels)] = value
+
+    def collect(self) -> List[str]:
+        lines = [f"# TYPE {self.name} gauge"]
+        for k, v in sorted(self._values.items()):
+            lines.append(f"{self.name}{_fmt(k)} {v}")
+        return lines
+
+
+class Histogram:
+    def __init__(
+        self,
+        name: str,
+        help: str = "",
+        buckets: Sequence[float] = _DEFAULT_BUCKETS,
+        registry: "Registry | None" = None,
+    ):
+        self.name = name
+        self.help = help
+        self.buckets = list(buckets)
+        self._counts: Dict[LabelKey, List[int]] = {}
+        self._sums: Dict[LabelKey, float] = {}
+        self._totals: Dict[LabelKey, int] = {}
+        self._lock = threading.Lock()
+        if registry is not None:
+            registry.register(self)
+
+    def observe(self, value: float, labels: Optional[Dict[str, str]] = None) -> None:
+        k = _key(labels)
+        with self._lock:
+            if k not in self._counts:
+                self._counts[k] = [0] * len(self.buckets)
+                self._sums[k] = 0.0
+                self._totals[k] = 0
+            i = bisect_right(self.buckets, value)
+            for j in range(i, len(self.buckets)):
+                self._counts[k][j] += 1
+            self._sums[k] += value
+            self._totals[k] += 1
+
+    def count(self, labels: Optional[Dict[str, str]] = None) -> int:
+        return self._totals.get(_key(labels), 0)
+
+    def sum(self, labels: Optional[Dict[str, str]] = None) -> float:
+        return self._sums.get(_key(labels), 0.0)
+
+    def collect(self) -> List[str]:
+        lines = [f"# TYPE {self.name} histogram"]
+        for k in sorted(self._counts):
+            for b, c in zip(self.buckets, self._counts[k]):
+                lines.append(f'{self.name}_bucket{_fmt(k, le=str(b))} {c}')
+            lines.append(f'{self.name}_bucket{_fmt(k, le="+Inf")} {self._totals[k]}')
+            lines.append(f"{self.name}_sum{_fmt(k)} {self._sums[k]}")
+            lines.append(f"{self.name}_count{_fmt(k)} {self._totals[k]}")
+        return lines
+
+
+def _fmt(k: LabelKey, le: Optional[str] = None) -> str:
+    items = list(k) + ([("le", le)] if le is not None else [])
+    if not items:
+        return ""
+    inner = ",".join(f'{name}="{value}"' for name, value in items)
+    return "{" + inner + "}"
+
+
+class Registry:
+    def __init__(self) -> None:
+        self._collectors: List = []
+        self._lock = threading.Lock()
+
+    def register(self, collector) -> None:
+        with self._lock:
+            self._collectors.append(collector)
+
+    def exposition(self) -> str:
+        lines: List[str] = []
+        with self._lock:
+            for c in self._collectors:
+                lines.extend(c.collect())
+        return "\n".join(lines) + "\n"
+
+
+# Global default registry + the framework metric catalog (names mirror the
+# reference's karpenter_* metrics, designs/metrics.md).
+REGISTRY = Registry()
+
+PODS_SCHEDULED = Counter("karpenter_tpu_pods_scheduled_total", registry=REGISTRY)
+PODS_UNSCHEDULABLE = Gauge("karpenter_tpu_pods_unschedulable", registry=REGISTRY)
+NODES_CREATED = Counter("karpenter_tpu_nodes_created_total", registry=REGISTRY)
+NODES_TERMINATED = Counter("karpenter_tpu_nodes_terminated_total", registry=REGISTRY)
+SOLVE_DURATION = Histogram("karpenter_tpu_solve_duration_seconds", registry=REGISTRY)
+PROVISIONING_DURATION = Histogram(
+    "karpenter_tpu_provisioning_duration_seconds", registry=REGISTRY
+)
+DEPROVISIONING_ACTIONS = Counter(
+    "karpenter_tpu_deprovisioning_actions_total", registry=REGISTRY
+)
+INTERRUPTION_MESSAGES = Counter(
+    "karpenter_tpu_interruption_messages_total", registry=REGISTRY
+)
+CLOUDPROVIDER_DURATION = Histogram(
+    "karpenter_tpu_cloudprovider_duration_seconds", registry=REGISTRY
+)
+CLOUDPROVIDER_ERRORS = Counter("karpenter_tpu_cloudprovider_errors_total", registry=REGISTRY)

@@ -1,10 +1,16 @@
 import os
 
 # Tests run on a virtual 8-device CPU mesh so multi-chip sharding paths execute
-# without TPU hardware. Must be set before jax import (any transitive import).
+# without TPU hardware. XLA_FLAGS must be set before the backend initializes; the
+# jax.config update overrides any platform forced by site customizations (this
+# image pins JAX_PLATFORMS=axon at interpreter startup).
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
         xla_flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,167 @@
+import pytest
+
+from karpenter_tpu.api import ObjectMeta, Provisioner, Requirement, Requirements, Resources, Taint
+from karpenter_tpu.api import labels as wk
+from karpenter_tpu.api.settings import Settings
+from karpenter_tpu.cloudprovider import FakeCloudProvider, generate_catalog
+from karpenter_tpu.controllers import PodBatcher, ProvisioningController
+from karpenter_tpu.solver import GreedySolver
+from karpenter_tpu.state import Cluster
+
+from helpers import make_pod, make_pods, make_provisioner
+
+
+@pytest.fixture
+def env():
+    cluster = Cluster()
+    provider = FakeCloudProvider(catalog=generate_catalog(n_types=60))
+    controller = ProvisioningController(
+        cluster, provider, settings=Settings(batch_idle_duration=0, batch_max_duration=0)
+    )
+    cluster.add_provisioner(make_provisioner())
+    return cluster, provider, controller
+
+
+class TestPodBatcher:
+    def test_idle_window(self):
+        b = PodBatcher(idle=1.0, max_duration=10.0)
+        assert not b.ready(now=0)
+        b.note_arrival(now=0.0)
+        assert not b.ready(now=0.5)
+        assert b.ready(now=1.1)
+
+    def test_max_window_caps_stream(self):
+        b = PodBatcher(idle=1.0, max_duration=10.0)
+        t = 0.0
+        b.note_arrival(now=t)
+        while t < 9.9:  # continuous arrivals never go idle
+            t += 0.5
+            b.note_arrival(now=t)
+            assert not b.ready(now=t + 0.1) or t >= 10.0 - 1e-9
+        b.note_arrival(now=10.0)
+        assert b.ready(now=10.05)
+
+
+class TestProvisioning:
+    def test_end_to_end_small(self, env):
+        cluster, provider, controller = env
+        for pod in make_pods(50, cpu="250m", memory="512Mi"):
+            cluster.add_pod(pod)
+        result = controller.reconcile()
+        assert result.unschedulable == []
+        assert len(result.bound) == 50
+        assert len(cluster.nodes) == len(result.nodes) > 0
+        assert len(provider.instances) == len(result.nodes)
+        # every pod bound to a node that exists and fits
+        for pod_name, node_name in result.bound.items():
+            assert node_name in cluster.nodes
+        for node in cluster.nodes.values():
+            used = Resources()
+            for p in cluster.pods_on_node(node.name):
+                used = used + p.requests
+            assert used.fits(node.allocatable)
+
+    def test_end_to_end_1k_mixed(self, env):
+        cluster, provider, controller = env
+        for pod in make_pods(700, "web", cpu="250m", memory="512Mi"):
+            cluster.add_pod(pod)
+        for pod in make_pods(300, "db", cpu="1", memory="4Gi"):
+            cluster.add_pod(pod)
+        result = controller.reconcile()
+        assert result.unschedulable == []
+        assert len(result.bound) == 1000
+        assert all(not p.is_pending() for p in cluster.pods.values())
+
+    def test_existing_capacity_reused(self, env):
+        cluster, provider, controller = env
+        for pod in make_pods(10, "first", cpu="250m", memory="256Mi"):
+            cluster.add_pod(pod)
+        r1 = controller.reconcile()
+        n_nodes = len(cluster.nodes)
+        assert n_nodes > 0
+        # second small wave fits in the remaining capacity of wave-1 nodes
+        for pod in make_pods(3, "second", cpu="100m", memory="128Mi"):
+            cluster.add_pod(pod)
+        r2 = controller.reconcile()
+        assert len(cluster.nodes) == n_nodes
+        assert r2.machines == []
+        assert len(r2.bound) == 3
+
+    def test_no_provisioner_leaves_pending(self):
+        cluster = Cluster()
+        provider = FakeCloudProvider(catalog=generate_catalog(n_types=10))
+        controller = ProvisioningController(cluster, provider, settings=Settings())
+        cluster.add_pod(make_pod())
+        result = controller.reconcile()
+        assert len(result.unschedulable) == 1
+        assert cluster.nodes == {}
+
+    def test_provisioner_limits_cap_scaleup(self, env):
+        cluster, provider, controller = env
+        prov = cluster.provisioners["default"]
+        prov.limits = Resources(cpu=4)  # room for only a couple of small nodes
+        cluster.update(prov)
+        for pod in make_pods(200, cpu="500m", memory="512Mi"):
+            cluster.add_pod(pod)
+        result = controller.reconcile()
+        # whatever launched must not blow past the ceiling by more than one node
+        total_cpu = sum(n.capacity["cpu"] for n in cluster.nodes.values())
+        if cluster.nodes:
+            assert total_cpu <= 4 + max(n.capacity["cpu"] for n in cluster.nodes.values())
+        assert result.unschedulable  # the rest stayed pending
+        assert controller.recorder.events("LimitExceeded")
+
+    def test_tainted_provisioner_and_tolerating_pods(self, env):
+        cluster, provider, controller = env
+        cluster.delete_provisioner("default")
+        cluster.add_provisioner(
+            make_provisioner(name="gpu", taints=[Taint(key="accel", value="tpu")])
+        )
+        from karpenter_tpu.api import Toleration
+
+        cluster.add_pod(make_pod(name="plain"))
+        cluster.add_pod(
+            make_pod(name="tol", tolerations=[Toleration(key="accel", operator="Exists")])
+        )
+        result = controller.reconcile()
+        assert "plain" in result.unschedulable
+        assert result.bound.get("tol")
+        node = cluster.nodes[result.bound["tol"]]
+        assert any(t.key == "accel" for t in node.taints)
+
+    def test_ice_offerings_masked_next_cycle(self, env):
+        cluster, provider, controller = env
+        # make every spot offering of the cheapest types ICE so launches fall
+        # through and still succeed (provider-internal fallback)
+        for pod in make_pods(5, cpu="250m"):
+            cluster.add_pod(pod)
+        r1 = controller.reconcile()
+        assert r1.unschedulable == []
+
+    def test_daemonset_overhead_reserved(self, env):
+        cluster, provider, controller = env
+        ds = make_pod(name="log-agent", cpu="200m", memory="256Mi", daemonset=True, owner="DaemonSet")
+        cluster.add_pod(ds)
+        for pod in make_pods(20, cpu="500m", memory="512Mi"):
+            cluster.add_pod(pod)
+        result = controller.reconcile()
+        assert result.unschedulable == []
+        # each node keeps headroom for the daemonset
+        for node in cluster.nodes.values():
+            used = Resources()
+            for p in cluster.pods_on_node(node.name):
+                used = used + p.requests
+            assert (used + ds.requests).fits(node.allocatable)
+
+    def test_greedy_solver_backend_works_too(self):
+        cluster = Cluster()
+        provider = FakeCloudProvider(catalog=generate_catalog(n_types=30))
+        controller = ProvisioningController(
+            cluster, provider, solver=GreedySolver(), settings=Settings()
+        )
+        cluster.add_provisioner(make_provisioner())
+        for pod in make_pods(30, cpu="250m"):
+            cluster.add_pod(pod)
+        result = controller.reconcile()
+        assert result.unschedulable == []
+        assert len(result.bound) == 30
