@@ -29,9 +29,12 @@ from .catalog import generate_catalog
 from .interface import (
     CloudProvider,
     CloudProviderError,
+    Image,
     InsufficientCapacityError,
     Instance,
     MachineNotFoundError,
+    SecurityGroup,
+    Subnet,
 )
 from .types import InstanceType, Offering
 
@@ -54,6 +57,24 @@ class FakeCloudProvider(CloudProvider):
         self.next_errors: List[Exception] = []
         self.instances: Dict[str, Instance] = {}
         self.current_images: Dict[str, str] = {"default": "image-001"}
+        # Network/image inventory resolved by the nodetemplate controller
+        # (reference subnet/securitygroup/ami providers, pkg/providers/{subnet,
+        # securitygroup,amifamily}).
+        zones = sorted({o.zone for it in self.catalog for o in it.offerings})
+        self.subnets: List[Subnet] = [
+            Subnet(id=f"subnet-{z}", zone=z, tags={"karpenter.tpu/discovery": "cluster", "zone": z})
+            for z in zones
+        ]
+        self.security_groups: List[SecurityGroup] = [
+            SecurityGroup(id="sg-default", name="default",
+                          tags={"karpenter.tpu/discovery": "cluster"}),
+            SecurityGroup(id="sg-nodes", name="nodes",
+                          tags={"karpenter.tpu/discovery": "cluster", "role": "node"}),
+        ]
+        self.images: List[Image] = [
+            Image(id="image-001", family="default", created=1.0,
+                  tags={"family": "default"})
+        ]
         self.create_calls: List[Machine] = []
         self.delete_calls: List[str] = []
         self.launch_attempts = 0
@@ -76,7 +97,24 @@ class FakeCloudProvider(CloudProvider):
         current = self.current_images.get(family, "image-000")
         nxt = f"image-{int(current.rsplit('-', 1)[1]) + 1:03d}"
         self.current_images[family] = nxt
+        self.images.append(
+            Image(id=nxt, family=family, created=float(len(self.images) + 1),
+                  tags={"family": family})
+        )
         return nxt
+
+    # -- network/image discovery (selector = tag map; reference subnet.go:213-235,
+    # securitygroup.go:53, ami.go:99-133) ---------------------------------
+    def describe_subnets(self, selector: Dict[str, str]) -> List[Subnet]:
+        return [s for s in self.subnets if _tags_match(s.tags, selector)]
+
+    def describe_security_groups(self, selector: Dict[str, str]) -> List[SecurityGroup]:
+        return [g for g in self.security_groups if _tags_match(g.tags, selector)]
+
+    def describe_images(self, selector: Dict[str, str]) -> List[Image]:
+        out = [i for i in self.images if _tags_match(i.tags, selector)]
+        # newest-by-creation-date first (reference ami.go:236-245)
+        return sorted(out, key=lambda i: -i.created)
 
     # -- CloudProvider -----------------------------------------------------
     @property
@@ -252,3 +290,15 @@ class FakeCloudProvider(CloudProvider):
 
 def _instance_id(provider_id: str) -> str:
     return provider_id.rsplit("/", 1)[-1]
+
+
+def _tags_match(tags: Dict[str, str], selector: Dict[str, str]) -> bool:
+    """Tag selector semantics: every selector entry must match; '*' matches any
+    value; the special key 'id' matches the resource id... handled by callers."""
+    for k, v in selector.items():
+        if v == "*":
+            if k not in tags:
+                return False
+        elif tags.get(k) != v:
+            return False
+    return True

@@ -36,6 +36,30 @@ class MachineNotFoundError(CloudProviderError):
 
 
 @dataclass
+class Subnet:
+    id: str
+    zone: str
+    available_ips: int = 4096
+    tags: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class SecurityGroup:
+    id: str
+    name: str = ""
+    tags: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class Image:
+    id: str
+    family: str = "default"
+    arch: str = "amd64"
+    created: float = 0.0
+    tags: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
 class Instance:
     """A launched cloud instance (fake or real)."""
 

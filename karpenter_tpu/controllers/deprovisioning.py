@@ -1,0 +1,358 @@
+"""Deprovisioning orchestrator: expiration -> drift -> emptiness -> consolidation.
+
+Rebuild of core's deprovisioning controller (reference behavior spec:
+``designs/deprovisioning.md:3-37``, ``designs/consolidation.md``,
+``website/.../concepts/deprovisioning.md:64-95``):
+
+* a single orchestrator runs the deprovisioners in order and takes ONE action per
+  loop (empty nodes delete in parallel as one action);
+* consolidation ranks candidates by disruption cost (fewer pods, pod deletion
+  cost, priority, remaining node lifetime — ``consolidation.md:25-36``);
+* delete is allowed when every pod re-schedules onto remaining capacity; replace
+  additionally allows ONE cheaper new node; **spot nodes are delete-only, never
+  replaced** (``deprovisioning.md:83-85``);
+* every action passes a validation TTL (15s, ``consolidation.md:59-67``): the plan
+  is re-verified after the window and dropped if the cluster moved;
+* blockers: do-not-evict pods, controllerless pods, violated PDBs, the node-level
+  do-not-consolidate annotation (``consolidation.md:44-52``).
+
+The consolidation feasibility check reuses the SAME solver as provisioning — the
+multi-node repack is just ``solve`` with the candidate's pods as pending demand,
+the surviving nodes as existing capacity, and (for replace) the price-bounded
+option set. That solve is the second half of the BASELINE north star.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..api import labels as wk
+from ..api.objects import Node, Pod, Provisioner
+from ..api.resources import Resources, merge
+from ..api.settings import Settings
+from ..cloudprovider.interface import CloudProvider
+from ..cloudprovider.types import InstanceType, Offering
+from ..solver.encode import ExistingNode
+from ..solver.solver import GreedySolver, Solver
+from ..state.cluster import Cluster
+from ..utils import metrics
+from ..utils.cache import Clock
+from ..utils.events import Recorder
+from .provisioning import launch_from_spec
+from .termination import TerminationController
+
+
+@dataclass
+class PlannedAction:
+    reason: str  # expiration | drift | emptiness | consolidation-delete | consolidation-replace
+    nodes: List[str]
+    replacement: Optional[object] = None  # NewNodeSpec
+    created: float = 0.0
+
+
+class DeprovisioningController:
+    def __init__(
+        self,
+        cluster: Cluster,
+        provider: CloudProvider,
+        termination: TerminationController,
+        solver: Optional[Solver] = None,
+        settings: Optional[Settings] = None,
+        recorder: Optional[Recorder] = None,
+        clock: Optional[Clock] = None,
+    ):
+        self.cluster = cluster
+        self.provider = provider
+        self.termination = termination
+        self.solver = solver or GreedySolver()
+        self.settings = settings or Settings()
+        self.recorder = recorder or Recorder()
+        self.clock = clock or Clock()
+        self.pending_action: Optional[PlannedAction] = None
+
+    # ------------------------------------------------------------------
+    def reconcile(self) -> Optional[PlannedAction]:
+        """One orchestrator pass. Returns the action executed this pass (if any)."""
+        if self.pending_action is not None:
+            return self._maybe_execute_pending()
+
+        for method in (self._expiration, self._drift, self._emptiness, self._consolidation):
+            action = method()
+            if action is not None:
+                action.created = self.clock.now()
+                if self.settings.consolidation_validation_ttl > 0 and action.reason.startswith(
+                    "consolidation"
+                ):
+                    # plan now, validate after the TTL window (15s semantics)
+                    self.pending_action = action
+                    self.recorder.publish(
+                        "DeprovisioningPlanned", f"{action.reason}: {action.nodes}",
+                        object_kind="Deprovisioner",
+                    )
+                    return None
+                self._execute(action)
+                return action
+        return None
+
+    def _maybe_execute_pending(self) -> Optional[PlannedAction]:
+        action = self.pending_action
+        if self.clock.now() - action.created < self.settings.consolidation_validation_ttl:
+            return None  # still inside the validation window
+        self.pending_action = None
+        if not self._still_valid(action):
+            self.recorder.publish(
+                "DeprovisioningAborted", f"{action.reason} invalidated during validation window",
+                object_kind="Deprovisioner", type="Warning",
+            )
+            return None
+        self._execute(action)
+        return action
+
+    # -- deprovisioners, in orchestrator order --------------------------
+    def _candidates(self) -> List[Node]:
+        out = []
+        for node in self.cluster.managed_nodes():
+            if node.meta.deletion_timestamp is not None or not node.ready:
+                continue
+            out.append(node)
+        return out
+
+    def _expiration(self) -> Optional[PlannedAction]:
+        now = self.clock.now()
+        for node in self._candidates():
+            prov = self._provisioner_of(node)
+            if prov is None or prov.ttl_seconds_until_expired is None:
+                continue
+            if now - node.meta.creation_timestamp > prov.ttl_seconds_until_expired:
+                return PlannedAction(reason="expiration", nodes=[node.name])
+        return None
+
+    def _drift(self) -> Optional[PlannedAction]:
+        if not self.settings.drift_enabled:
+            return None
+        for node in self._candidates():
+            if node.meta.annotations.get(wk.VOLUNTARY_DISRUPTION_ANNOTATION) == "drifted":
+                return PlannedAction(reason="drift", nodes=[node.name])
+        return None
+
+    def _emptiness(self) -> Optional[PlannedAction]:
+        """ttlSecondsAfterEmpty: stamp empty nodes, delete the ones past TTL —
+        all together, as one parallel action (deprovisioning.md:27-33)."""
+        now = self.clock.now()
+        expired: List[str] = []
+        for node in self._candidates():
+            prov = self._provisioner_of(node)
+            if prov is None or prov.ttl_seconds_after_empty is None:
+                continue
+            workload = [
+                p for p in self.cluster.pods_on_node(node.name) if not p.is_daemonset
+            ]
+            stamp = node.meta.annotations.get(wk.EMPTINESS_TIMESTAMP_ANNOTATION)
+            if workload:
+                if stamp is not None:
+                    del node.meta.annotations[wk.EMPTINESS_TIMESTAMP_ANNOTATION]
+                    self.cluster.update(node)
+                continue
+            if stamp is None:
+                node.meta.annotations[wk.EMPTINESS_TIMESTAMP_ANNOTATION] = str(now)
+                self.cluster.update(node)
+                continue
+            if now - float(stamp) >= prov.ttl_seconds_after_empty:
+                expired.append(node.name)
+        if expired:
+            return PlannedAction(reason="emptiness", nodes=expired)
+        return None
+
+    # -- consolidation ---------------------------------------------------
+    def _consolidation(self) -> Optional[PlannedAction]:
+        if self.cluster.pending_pods():
+            return None  # cluster still provisioning; wait for stability
+        candidates = self._consolidatable()
+        if not candidates:
+            return None
+        candidates.sort(key=self._disruption_cost)
+        # multi-node first (2..N cheapest-to-disrupt prefix), then single
+        multi = self._try_multi_node(candidates)
+        if multi is not None:
+            return multi
+        for node in candidates:
+            action = self._try_single_node(node)
+            if action is not None:
+                return action
+        return None
+
+    def _consolidatable(self) -> List[Node]:
+        out = []
+        for node in self._candidates():
+            prov = self._provisioner_of(node)
+            if prov is None or not prov.consolidation_enabled:
+                continue
+            if node.meta.annotations.get(wk.DO_NOT_CONSOLIDATE_ANNOTATION) == "true":
+                continue
+            pods = [p for p in self.cluster.pods_on_node(node.name) if not p.is_daemonset]
+            blocked = False
+            for pod in pods:
+                if pod.meta.annotations.get(wk.DO_NOT_EVICT_ANNOTATION) == "true":
+                    blocked = True
+                    break
+                if not pod.owned():
+                    blocked = True  # controllerless pods can't be recreated
+                    break
+                if self.termination._pdb_blocks(pod):
+                    blocked = True
+                    break
+            if not blocked:
+                out.append(node)
+        return out
+
+    def _disruption_cost(self, node: Node) -> float:
+        """consolidation.md:25-36 ranking: fewer pods first, then pod-deletion
+        cost, pod priority, and sooner-to-expire nodes first."""
+        pods = [p for p in self.cluster.pods_on_node(node.name) if not p.is_daemonset]
+        cost = float(len(pods))
+        cost += sum(max(p.deletion_cost(), 0.0) for p in pods) / 1000.0
+        cost += sum(max(p.priority, 0) for p in pods) / 1e6
+        prov = self._provisioner_of(node)
+        if prov is not None and prov.ttl_seconds_until_expired:
+            age = self.clock.now() - node.meta.creation_timestamp
+            remaining = max(prov.ttl_seconds_until_expired - age, 0.0)
+            cost *= remaining / prov.ttl_seconds_until_expired
+        return cost
+
+    def _try_single_node(self, node: Node):
+        pods = [p for p in self.cluster.pods_on_node(node.name) if not p.is_daemonset]
+        if not pods:
+            return PlannedAction(reason="consolidation-delete", nodes=[node.name])
+        fits, replacement = self._simulate(pods, exclude=[node.name],
+                                           price_ceiling=self._node_price(node))
+        if not fits:
+            return None
+        if replacement is None:
+            return PlannedAction(reason="consolidation-delete", nodes=[node.name])
+        # replacement required: spot nodes are delete-only (deprovisioning.md:83-85)
+        if node.capacity_type() == wk.CAPACITY_TYPE_SPOT:
+            return None
+        return PlannedAction(
+            reason="consolidation-replace", nodes=[node.name], replacement=replacement
+        )
+
+    def _try_multi_node(self, candidates: List[Node]):
+        """Try deleting the K cheapest-to-disrupt nodes together, allowing one
+        cheaper replacement (designs/deprovisioning.md one-cheaper-replacement)."""
+        best = None
+        for k in range(len(candidates), 1, -1):
+            subset = candidates[:k]
+            if any(n.capacity_type() == wk.CAPACITY_TYPE_SPOT for n in subset):
+                spot_free = [n for n in subset if n.capacity_type() != wk.CAPACITY_TYPE_SPOT]
+                if len(spot_free) < 2:
+                    continue
+                subset = spot_free
+            pods = [
+                p
+                for n in subset
+                for p in self.cluster.pods_on_node(n.name)
+                if not p.is_daemonset
+            ]
+            total_price = sum(self._node_price(n) for n in subset)
+            fits, replacement = self._simulate(
+                pods, exclude=[n.name for n in subset], price_ceiling=total_price
+            )
+            if not fits:
+                continue
+            return PlannedAction(
+                reason="consolidation-replace" if replacement else "consolidation-delete",
+                nodes=[n.name for n in subset],
+                replacement=replacement,
+            )
+        return best
+
+    def _simulate(
+        self, pods: Sequence[Pod], exclude: Sequence[str], price_ceiling: float
+    ) -> Tuple[bool, Optional[object]]:
+        """Re-schedule simulation: can `pods` land on the remaining nodes, plus at
+        most ONE new node strictly cheaper than `price_ceiling`?
+
+        Returns (feasible, replacement_spec_or_None). Conservative: any
+        unschedulable pod or >1 new node means infeasible (never strand a pod).
+        """
+        existing = [
+            e
+            for e in self.cluster.existing_capacity()
+            if e.node.name not in set(exclude)
+        ]
+        provisioners = []
+        for prov in self.cluster.provisioners.values():
+            types = []
+            for it in self.provider.get_instance_types(prov):
+                offerings = [
+                    o
+                    for o in it.offerings
+                    if o.available and o.price < price_ceiling - 1e-9
+                ]
+                if offerings:
+                    types.append(it.with_offerings(offerings))
+            provisioners.append((prov, types))
+        result = self.solver.solve_pods(
+            list(pods), provisioners, existing=existing, daemonsets=self.cluster.daemonsets()
+        )
+        if result.unschedulable:
+            return False, None
+        if len(result.new_nodes) == 0:
+            return True, None
+        if len(result.new_nodes) == 1:
+            return True, result.new_nodes[0]
+        return False, None
+
+    def _still_valid(self, action: PlannedAction) -> bool:
+        nodes = [self.cluster.nodes.get(n) for n in action.nodes]
+        if any(n is None or n.meta.deletion_timestamp is not None for n in nodes):
+            return False
+        if self.cluster.pending_pods():
+            return False
+        pods = [
+            p
+            for n in nodes
+            for p in self.cluster.pods_on_node(n.name)
+            if not p.is_daemonset
+        ]
+        price = sum(self._node_price(n) for n in nodes)
+        fits, replacement = self._simulate(pods, exclude=action.nodes, price_ceiling=price)
+        if not fits:
+            return False
+        if action.replacement is None and replacement is not None:
+            return False  # a delete plan now needs capacity: abort
+        return True
+
+    # -- execution -------------------------------------------------------
+    def _execute(self, action: PlannedAction) -> None:
+        if action.replacement is not None:
+            # launch the replacement BEFORE draining the old nodes, as the
+            # reference does (replacement-node timeout semantics)
+            pods = action.replacement.pod_names
+            requests = merge(
+                [self.cluster.pods[n].requests for n in pods if n in self.cluster.pods]
+            )
+            launch_from_spec(self.cluster, self.provider, action.replacement, requests)
+        for name in action.nodes:
+            self.termination.delete_node(name)
+        self.termination.reconcile()
+        metrics.DEPROVISIONING_ACTIONS.inc({"reason": action.reason})
+        self.recorder.publish(
+            "Deprovisioned", f"{action.reason}: {action.nodes}", object_kind="Deprovisioner"
+        )
+
+    # -- helpers ---------------------------------------------------------
+    def _provisioner_of(self, node: Node) -> Optional[Provisioner]:
+        name = node.provisioner_name()
+        return self.cluster.provisioners.get(name) if name else None
+
+    def _node_price(self, node: Node) -> float:
+        it_name = node.instance_type()
+        for it in self.provider.get_instance_types(None):
+            if it.name == it_name:
+                for o in it.offerings:
+                    if o.zone == node.zone() and o.capacity_type == node.capacity_type():
+                        return o.price
+        return float("inf")

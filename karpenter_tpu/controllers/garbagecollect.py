@@ -1,0 +1,75 @@
+"""Machine garbage collection + orphan adoption (linking).
+
+Reference: ``pkg/controllers/machine/garbagecollect`` deletes cloud instances that
+are ManagedBy-tagged but have no in-cluster Machine and are older than a minute
+(``controller.go:57-111``); ``pkg/controllers/machine/link`` adopts instances
+tagged by a provisioner but not yet represented as Machines
+(``controller.go:64-115``) and deletes orphans whose provisioner is gone.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List, Optional
+
+from ..api import labels as wk
+from ..api.objects import Machine
+from ..cloudprovider.interface import CloudProvider, MachineNotFoundError
+from ..state.cluster import Cluster
+from ..utils.cache import Clock
+from ..utils.events import Recorder
+
+LINK_ANNOTATION = f"{wk.GROUP}/linked"
+MIN_AGE_SECONDS = 60.0
+
+
+class GarbageCollectionController:
+    def __init__(
+        self,
+        cluster: Cluster,
+        provider: CloudProvider,
+        recorder: Optional[Recorder] = None,
+        clock: Optional[Clock] = None,
+    ):
+        self.cluster = cluster
+        self.provider = provider
+        self.recorder = recorder or Recorder()
+        self.clock = clock or Clock()
+
+    def reconcile(self) -> dict:
+        """One GC pass: adopt linkable instances, collect orphaned ones.
+        Returns {"adopted": [...], "collected": [...]}."""
+        adopted: List[str] = []
+        collected: List[str] = []
+        known_ids = {
+            m.status.provider_id for m in self.cluster.machines.values() if m.status.provider_id
+        }
+        for machine in self.provider.list():
+            pid = machine.status.provider_id
+            if pid in known_ids:
+                continue
+            provisioner_name = machine.provisioner_name
+            instance = getattr(self.provider, "instance_for", lambda m: None)(machine)
+            age = self.clock.now() - (instance.created if instance else 0.0)
+            if provisioner_name and provisioner_name in self.cluster.provisioners:
+                # adoption: create the Machine object and mark it linked
+                machine.meta.annotations[LINK_ANNOTATION] = pid
+                self.cluster.add_machine(machine)
+                adopted.append(machine.name)
+                self.recorder.publish("Linked", f"adopted instance {pid}",
+                                      object_name=machine.name, object_kind="Machine")
+                continue
+            if age < MIN_AGE_SECONDS:
+                continue  # too young: launch may still be registering
+            try:
+                self.provider.delete(machine)
+            except MachineNotFoundError:
+                pass
+            # also remove any node object pointing at the dead instance
+            for node in list(self.cluster.nodes.values()):
+                if node.provider_id == pid:
+                    self.cluster.delete_node(node.name)
+            collected.append(machine.name)
+            self.recorder.publish("GarbageCollected", f"deleted orphan instance {pid}",
+                                  object_name=machine.name, object_kind="Machine")
+        return {"adopted": adopted, "collected": collected}

@@ -1,0 +1,222 @@
+"""Interruption controller: queue events -> cordon & drain + ICE feedback.
+
+Rebuild of the reference's SQS-driven interruption handling
+(``/root/reference/pkg/controllers/interruption``): a singleton poll loop receives
+messages (long-poll 20s / 10 msgs, ``sqs.go:86-97``), parses them through a registry
+keyed on (version, source, detail-type) (``parser.go:31-93``), and maps actions
+(``controller.go:261-268``):
+
+* spot-interruption   -> CordonAndDrain + mark the spot offering unavailable
+                          in the ICE cache (``controller.go:186-193``)
+* rebalance-recommendation -> event only
+* scheduled-change (health) -> CordonAndDrain
+* instance state-change (stopping/terminated) -> CordonAndDrain
+* anything else -> noop
+
+CordonAndDrain = delete the node and let the termination finalizer do the
+cordon/drain/terminate work (``controller.go:201-212``).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..api import labels as wk
+from ..state.cluster import Cluster
+from ..utils import metrics
+from ..utils.cache import UnavailableOfferings
+from ..utils.events import Recorder
+from .termination import TerminationController
+
+
+# ---------------------------------------------------------------------------
+# Queue (stands in for SQS; same receive/delete surface)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class QueueMessage:
+    id: str
+    body: str
+    receive_count: int = 0
+
+
+class FakeQueue:
+    """In-memory interruption queue with the SQS receive/delete shape
+    (reference SQSProvider, sqs.go:33-105)."""
+
+    def __init__(self) -> None:
+        self._messages: List[QueueMessage] = []
+        self._lock = threading.Lock()
+        self._counter = 0
+
+    def send(self, body: Dict) -> str:
+        with self._lock:
+            self._counter += 1
+            mid = f"msg-{self._counter}"
+            self._messages.append(QueueMessage(id=mid, body=json.dumps(body)))
+            return mid
+
+    def receive(self, max_messages: int = 10) -> List[QueueMessage]:
+        with self._lock:
+            batch = self._messages[:max_messages]
+            for m in batch:
+                m.receive_count += 1
+            return list(batch)
+
+    def delete(self, message_id: str) -> None:
+        with self._lock:
+            self._messages = [m for m in self._messages if m.id != message_id]
+
+    def __len__(self) -> int:
+        return len(self._messages)
+
+
+# ---------------------------------------------------------------------------
+# Messages + parser registry
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class ParsedMessage:
+    kind: str  # spot-interruption | rebalance | scheduled-change | state-change | noop
+    instance_ids: Tuple[str, ...] = ()
+    detail: str = ""
+
+
+Parser = Callable[[Dict], ParsedMessage]
+
+
+class ParserRegistry:
+    """Keyed on (version, source, detail-type) exactly like the reference's
+    registry (parser.go:53-93); unknown shapes parse to noop."""
+
+    def __init__(self) -> None:
+        self._parsers: Dict[Tuple[str, str, str], Parser] = {}
+        self._register_defaults()
+
+    def register(self, version: str, source: str, detail_type: str, parser: Parser) -> None:
+        self._parsers[(version, source, detail_type)] = parser
+
+    def parse(self, raw: Dict) -> ParsedMessage:
+        key = (
+            str(raw.get("version", "0")),
+            str(raw.get("source", "")),
+            str(raw.get("detail-type", "")),
+        )
+        parser = self._parsers.get(key)
+        if parser is None:
+            return ParsedMessage(kind="noop")
+        return parser(raw)
+
+    def _register_defaults(self) -> None:
+        def ids(raw: Dict) -> Tuple[str, ...]:
+            detail = raw.get("detail", {})
+            if "instance-id" in detail:
+                return (detail["instance-id"],)
+            return tuple(
+                r.rsplit("/", 1)[-1] for r in raw.get("resources", []) if isinstance(r, str)
+            )
+
+        self.register(
+            "0", "cloud.compute", "Spot Instance Interruption Warning",
+            lambda raw: ParsedMessage(kind="spot-interruption", instance_ids=ids(raw)),
+        )
+        self.register(
+            "0", "cloud.compute", "Instance Rebalance Recommendation",
+            lambda raw: ParsedMessage(kind="rebalance", instance_ids=ids(raw)),
+        )
+        self.register(
+            "0", "cloud.health", "Scheduled Change",
+            lambda raw: ParsedMessage(kind="scheduled-change", instance_ids=ids(raw)),
+        )
+        self.register(
+            "0", "cloud.compute", "Instance State-change Notification",
+            lambda raw: ParsedMessage(
+                kind="state-change",
+                instance_ids=ids(raw),
+                detail=str(raw.get("detail", {}).get("state", "")),
+            ),
+        )
+
+
+# ---------------------------------------------------------------------------
+# Controller
+# ---------------------------------------------------------------------------
+
+ACTIONABLE_STATES = {"stopping", "stopped", "shutting-down", "terminated"}
+
+
+class InterruptionController:
+    def __init__(
+        self,
+        cluster: Cluster,
+        queue: FakeQueue,
+        termination: TerminationController,
+        unavailable_offerings: Optional[UnavailableOfferings] = None,
+        recorder: Optional[Recorder] = None,
+    ):
+        self.cluster = cluster
+        self.queue = queue
+        self.termination = termination
+        self.unavailable_offerings = unavailable_offerings or UnavailableOfferings()
+        self.recorder = recorder or Recorder()
+        self.parsers = ParserRegistry()
+
+    def reconcile(self, max_messages: int = 10) -> int:
+        """One poll cycle; returns the number of messages handled."""
+        handled = 0
+        messages = self.queue.receive(max_messages)
+        node_by_instance = self._instance_id_map()
+        for msg in messages:
+            try:
+                parsed = self.parsers.parse(json.loads(msg.body))
+            except (json.JSONDecodeError, TypeError):
+                metrics.INTERRUPTION_MESSAGES.inc({"kind": "unparseable"})
+                self.queue.delete(msg.id)
+                continue
+            self._handle(parsed, node_by_instance)
+            metrics.INTERRUPTION_MESSAGES.inc({"kind": parsed.kind})
+            self.queue.delete(msg.id)
+            handled += 1
+        return handled
+
+    def _instance_id_map(self) -> Dict[str, str]:
+        """instance id -> node name, parsed from providerIDs
+        (makeInstanceIDMap, controller.go:240-259)."""
+        out = {}
+        for node in self.cluster.nodes.values():
+            if node.provider_id:
+                out[node.provider_id.rsplit("/", 1)[-1]] = node.name
+        return out
+
+    def _handle(self, parsed: ParsedMessage, node_by_instance: Dict[str, str]) -> None:
+        if parsed.kind == "noop":
+            return
+        if parsed.kind == "state-change" and parsed.detail not in ACTIONABLE_STATES:
+            return
+        for instance_id in parsed.instance_ids:
+            node_name = node_by_instance.get(instance_id)
+            if node_name is None:
+                continue
+            node = self.cluster.nodes.get(node_name)
+            if node is None:
+                continue
+            self.recorder.publish(
+                parsed.kind, f"interruption event for {instance_id}",
+                object_name=node_name, object_kind="Node", type="Warning",
+            )
+            if parsed.kind == "rebalance":
+                continue  # event only (controller.go:264)
+            if parsed.kind == "spot-interruption":
+                # capacity signal: this spot pool is about to be reclaimed; treat
+                # as unavailable for the ICE window (controller.go:186-193)
+                self.unavailable_offerings.mark_unavailable(
+                    node.instance_type(), node.zone(), wk.CAPACITY_TYPE_SPOT,
+                    reason="spot-interruption",
+                )
+            self.termination.delete_node(node_name)
+        if parsed.kind != "rebalance":
+            self.termination.reconcile()

@@ -183,34 +183,8 @@ class ProvisioningController:
         return result
 
     def _launch(self, spec: NewNodeSpec) -> Tuple[Machine, Node]:
-        option = spec.option
-        prov = option.provisioner
-        name = f"{prov.name}-{next(_machine_ids)}"
-        machine = Machine(
-            meta=ObjectMeta(name=name, labels=dict(prov.labels)),
-            provisioner_name=prov.name,
-            requirements=Requirements(
-                [
-                    Requirement.in_values(wk.INSTANCE_TYPE, [option.instance_type.name]),
-                    Requirement.in_values(wk.ZONE, [option.zone]),
-                    Requirement.in_values(wk.CAPACITY_TYPE, [option.capacity_type]),
-                ]
-            ),
-            requests=merge(
-                [self._pod_requests(n) for n in spec.pod_names]
-            ),
-            taints=list(prov.taints),
-            kubelet=prov.kubelet,
-            node_template_ref=prov.node_template_ref,
-        )
-        t0 = time.perf_counter()
-        machine = self.provider.create(machine)
-        metrics.CLOUDPROVIDER_DURATION.observe(
-            time.perf_counter() - t0, {"method": "create"}
-        )
-        self.cluster.add_machine(machine)
-        node = register_node(self.cluster, machine, prov)
-        return machine, node
+        requests = merge([self._pod_requests(n) for n in spec.pod_names])
+        return launch_from_spec(self.cluster, self.provider, spec, requests)
 
     def _pod_requests(self, pod_name: str) -> Resources:
         pod = self.cluster.pods.get(pod_name)
@@ -219,6 +193,38 @@ class ProvisioningController:
 
 def machineless_name(spec: NewNodeSpec) -> str:
     return f"{spec.option.provisioner.name}/{spec.instance_type_name}"
+
+
+def launch_from_spec(
+    cluster: Cluster, provider: CloudProvider, spec: NewNodeSpec, requests: Resources
+) -> Tuple[Machine, Node]:
+    """Launch one machine for a solver node spec and register its node. Shared by
+    the provisioning loop and consolidation replacements (which the reference also
+    routes through CloudProvider.Create)."""
+    option = spec.option
+    prov = option.provisioner
+    name = f"{prov.name}-{next(_machine_ids)}"
+    machine = Machine(
+        meta=ObjectMeta(name=name, labels=dict(prov.labels)),
+        provisioner_name=prov.name,
+        requirements=Requirements(
+            [
+                Requirement.in_values(wk.INSTANCE_TYPE, [option.instance_type.name]),
+                Requirement.in_values(wk.ZONE, [option.zone]),
+                Requirement.in_values(wk.CAPACITY_TYPE, [option.capacity_type]),
+            ]
+        ),
+        requests=requests,
+        taints=list(prov.taints),
+        kubelet=prov.kubelet,
+        node_template_ref=prov.node_template_ref,
+    )
+    t0 = time.perf_counter()
+    machine = provider.create(machine)
+    metrics.CLOUDPROVIDER_DURATION.observe(time.perf_counter() - t0, {"method": "create"})
+    cluster.add_machine(machine)
+    node = register_node(cluster, machine, prov)
+    return machine, node
 
 
 def register_node(cluster: Cluster, machine: Machine, provisioner: Provisioner) -> Node:

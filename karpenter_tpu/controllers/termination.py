@@ -1,0 +1,126 @@
+"""Node termination finalizer: cordon -> drain -> delete instance -> remove node.
+
+Reference behavior (``website/.../concepts/deprovisioning.md:9-16``, SURVEY §2.2
+termination controller row): every managed node carries a termination finalizer; on
+node deletion the controller cordons, evicts non-daemonset pods respecting PDBs and
+grace, calls ``CloudProvider.Delete``, then removes the node object.
+
+Eviction simulates the kube eviction API: owned pods return to Pending (their
+controller recreates them), unowned pods are deleted outright. PDB-blocked
+evictions defer to the next reconcile, exactly like the eviction queue's retry.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List, Optional
+
+from ..api import labels as wk
+from ..api.objects import Node, Pod
+from ..cloudprovider.interface import CloudProvider, MachineNotFoundError
+from ..state.cluster import Cluster
+from ..utils import metrics
+from ..utils.cache import Clock
+from ..utils.events import Recorder
+
+
+class TerminationController:
+    def __init__(
+        self,
+        cluster: Cluster,
+        provider: CloudProvider,
+        recorder: Optional[Recorder] = None,
+        clock: Optional[Clock] = None,
+    ):
+        self.cluster = cluster
+        self.provider = provider
+        self.recorder = recorder or Recorder()
+        self.clock = clock or Clock()
+
+    def delete_node(self, name: str) -> bool:
+        """Mark a node for deletion (the `kubectl delete node` moment); the
+        finalizer keeps the object alive until drain + instance delete finish."""
+        node = self.cluster.nodes.get(name)
+        if node is None:
+            return False
+        if node.meta.deletion_timestamp is None:
+            node.meta.deletion_timestamp = self.clock.now()
+            self.cluster.update(node)
+        return True
+
+    def reconcile(self) -> List[str]:
+        """Advance every deleting node through the finalizer; returns names of
+        nodes fully removed this pass."""
+        removed = []
+        for node in list(self.cluster.nodes.values()):
+            if node.meta.deletion_timestamp is None:
+                continue
+            if wk.TERMINATION_FINALIZER not in node.meta.finalizers:
+                self.cluster.delete_node(node.name)
+                removed.append(node.name)
+                continue
+            if self._finalize(node):
+                removed.append(node.name)
+        return removed
+
+    # -- finalizer steps ---------------------------------------------------
+    def _finalize(self, node: Node) -> bool:
+        if not node.unschedulable:
+            node.unschedulable = True  # cordon
+            self.cluster.update(node)
+            self.recorder.publish("Cordoned", "cordoned for termination",
+                                  object_name=node.name, object_kind="Node")
+        blocked = self._drain(node)
+        if blocked:
+            return False  # retry next reconcile (eviction queue semantics)
+        # instance teardown
+        machine = self.cluster.machine_for_node(node)
+        if machine is not None:
+            try:
+                self.provider.delete(machine)
+            except MachineNotFoundError:
+                pass  # already gone (interruption etc.)
+            self.cluster.delete_machine(machine.name)
+        node.meta.finalizers = [f for f in node.meta.finalizers if f != wk.TERMINATION_FINALIZER]
+        self.cluster.delete_node(node.name)
+        metrics.NODES_TERMINATED.inc({"provisioner": node.provisioner_name() or ""})
+        self.recorder.publish("Terminated", "node terminated",
+                              object_name=node.name, object_kind="Node")
+        return True
+
+    def _drain(self, node: Node) -> List[Pod]:
+        """Evict all evictable pods; returns pods still blocking the drain."""
+        blocked: List[Pod] = []
+        for pod in self.cluster.pods_on_node(node.name):
+            if pod.is_daemonset:
+                continue  # daemonsets die with the node
+            if self._pdb_blocks(pod):
+                blocked.append(pod)
+                continue
+            self._evict(pod)
+        return blocked
+
+    def _pdb_blocks(self, pod: Pod) -> bool:
+        for pdb in self.cluster.pdbs_for_pod(pod):
+            selected = [
+                p
+                for p in self.cluster.pods.values()
+                if pdb.selects(p) and p.node_name is not None
+            ]
+            healthy = len(selected)
+            if pdb.min_available is not None and healthy - 1 < pdb.min_available:
+                return True
+            if pdb.max_unavailable is not None and pdb.max_unavailable < 1:
+                return True
+        return False
+
+    def _evict(self, pod: Pod) -> None:
+        if pod.owned():
+            # the owning controller recreates it: back to Pending
+            pod.node_name = None
+            pod.phase = "Pending"
+            self.cluster.update(pod)
+        else:
+            self.cluster.delete_pod(pod.name)
+        self.recorder.publish("Evicted", f"evicted from {pod.name}",
+                              object_name=pod.name, object_kind="Pod")

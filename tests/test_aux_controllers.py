@@ -1,0 +1,131 @@
+import time
+
+import pytest
+
+from karpenter_tpu.api import NodeTemplate, ObjectMeta
+from karpenter_tpu.api import labels as wk
+from karpenter_tpu.api.settings import Settings
+from karpenter_tpu.cloudprovider import FakeCloudProvider, generate_catalog
+from karpenter_tpu.controllers import (
+    DriftController,
+    GarbageCollectionController,
+    NodeTemplateController,
+    ProvisioningController,
+)
+from karpenter_tpu.state import Cluster
+from karpenter_tpu.utils.cache import FakeClock
+
+from helpers import make_pods, make_provisioner
+
+
+@pytest.fixture
+def env():
+    cluster = Cluster()
+    provider = FakeCloudProvider(catalog=generate_catalog(n_types=40))
+    ctl = ProvisioningController(
+        cluster, provider, settings=Settings(batch_idle_duration=0, batch_max_duration=0)
+    )
+    cluster.add_provisioner(make_provisioner())
+    for p in make_pods(4, cpu="500m"):
+        cluster.add_pod(p)
+    ctl.reconcile()
+    return cluster, provider, ctl
+
+
+class TestDrift:
+    def test_image_rotation_annotates_nodes(self, env):
+        cluster, provider, ctl = env
+        drift = DriftController(cluster, provider)
+        assert drift.reconcile() == []
+        provider.rotate_image()
+        drifted = drift.reconcile()
+        assert drifted
+        for name in drifted:
+            node = cluster.nodes[name]
+            assert node.meta.annotations[wk.VOLUNTARY_DISRUPTION_ANNOTATION] == "drifted"
+        # idempotent: second pass annotates nothing new
+        assert drift.reconcile() == []
+
+    def test_gate_off(self, env):
+        cluster, provider, ctl = env
+        drift = DriftController(cluster, provider, settings=Settings(drift_enabled=False))
+        provider.rotate_image()
+        assert drift.reconcile() == []
+
+
+class TestGarbageCollect:
+    def test_orphan_instance_collected_after_min_age(self, env):
+        cluster, provider, ctl = env
+        clock = FakeClock(start=time.time() + 3600)
+        gc = GarbageCollectionController(cluster, provider, clock=clock)
+        # fabricate an orphan: instance exists in cloud, no Machine in cluster,
+        # and its provisioner is gone
+        from karpenter_tpu.api import Machine, ObjectMeta, Requirements, Resources
+
+        m = Machine(meta=ObjectMeta(name="stray"), provisioner_name="ghost",
+                    requests=Resources(cpu="100m"))
+        m = provider.create(m)
+        # wipe cluster knowledge of it
+        assert m.name not in cluster.machines
+        result = gc.reconcile()
+        instance_id = m.status.provider_id.rsplit("/", 1)[-1]
+        assert instance_id in result["collected"]
+        assert all(i.id != instance_id for i in provider.instances.values())
+
+    def test_adoptable_instance_linked(self, env):
+        cluster, provider, ctl = env
+        gc = GarbageCollectionController(cluster, provider, clock=FakeClock(start=time.time() + 3600))
+        from karpenter_tpu.api import Machine, ObjectMeta, Resources
+
+        m = Machine(meta=ObjectMeta(name="adoptme"), provisioner_name="default",
+                    requests=Resources(cpu="100m"))
+        m = provider.create(m)
+        instance_id = m.status.provider_id.rsplit("/", 1)[-1]
+        result = gc.reconcile()
+        assert instance_id in result["adopted"]
+        assert instance_id in cluster.machines  # adopted under instance name
+        # second pass: nothing to do
+        result2 = gc.reconcile()
+        assert result2 == {"adopted": [], "collected": []}
+
+    def test_tracked_machines_untouched(self, env):
+        cluster, provider, ctl = env
+        gc = GarbageCollectionController(cluster, provider, clock=FakeClock(start=time.time() + 3600))
+        n = len(provider.instances)
+        result = gc.reconcile()
+        assert result == {"adopted": [], "collected": []}
+        assert len(provider.instances) == n
+
+
+class TestNodeTemplate:
+    def test_selectors_resolve_to_status(self, env):
+        cluster, provider, ctl = env
+        t = NodeTemplate(
+            meta=ObjectMeta(name="default"),
+            subnet_selector={"karpenter.tpu/discovery": "cluster"},
+            security_group_selector={"karpenter.tpu/discovery": "cluster"},
+            image_selector={"family": "default"},
+        )
+        cluster.add_node_template(t)
+        ntc = NodeTemplateController(cluster, provider)
+        updated = ntc.reconcile()
+        assert updated == ["default"]
+        assert len(t.resolved_subnets) == 3  # one per zone
+        assert t.resolved_security_groups == ["sg-default", "sg-nodes"]
+        assert t.resolved_images == ["image-001"]
+        # no changes -> no update
+        assert ntc.reconcile() == []
+        # new image resolves, newest first
+        provider.rotate_image()
+        assert ntc.reconcile() == ["default"]
+        assert t.resolved_images[0] == "image-002"
+
+    def test_zone_restricted_selector(self, env):
+        cluster, provider, ctl = env
+        t = NodeTemplate(
+            meta=ObjectMeta(name="zonal"),
+            subnet_selector={"zone": "zone-b"},
+        )
+        cluster.add_node_template(t)
+        NodeTemplateController(cluster, provider).reconcile()
+        assert t.resolved_subnets == ["subnet-zone-b"]

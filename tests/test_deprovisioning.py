@@ -1,0 +1,197 @@
+import pytest
+
+from karpenter_tpu.api import ObjectMeta, Resources
+from karpenter_tpu.api import labels as wk
+from karpenter_tpu.api.settings import Settings
+from karpenter_tpu.cloudprovider import FakeCloudProvider, generate_catalog
+from karpenter_tpu.controllers import ProvisioningController
+from karpenter_tpu.controllers.deprovisioning import DeprovisioningController
+from karpenter_tpu.controllers.termination import TerminationController
+from karpenter_tpu.state import Cluster
+from karpenter_tpu.utils.cache import FakeClock
+
+from helpers import make_pod, make_pods, make_provisioner
+
+
+def make_env(provisioner=None, validation_ttl=0.0):
+    cluster = Cluster()
+    provider = FakeCloudProvider(catalog=generate_catalog(n_types=40))
+    settings = Settings(
+        batch_idle_duration=0, batch_max_duration=0,
+        consolidation_validation_ttl=validation_ttl,
+    )
+    clock = FakeClock(start=10_000.0)
+    prov_ctl = ProvisioningController(cluster, provider, settings=settings)
+    term = TerminationController(cluster, provider, clock=clock)
+    deprov = DeprovisioningController(
+        cluster, provider, term, settings=settings, clock=clock
+    )
+    cluster.add_provisioner(provisioner or make_provisioner())
+    return cluster, provider, prov_ctl, deprov, clock
+
+
+class TestEmptiness:
+    def test_empty_node_deleted_after_ttl(self):
+        cluster, provider, ctl, deprov, clock = make_env(
+            make_provisioner(ttl_seconds_after_empty=30)
+        )
+        for p in make_pods(5, cpu="500m"):
+            cluster.add_pod(p)
+        ctl.reconcile()
+        node_name = next(iter(cluster.nodes))
+        # empty the node
+        for p in list(cluster.pods.values()):
+            cluster.delete_pod(p.name)
+        assert deprov.reconcile() is None  # first pass stamps emptiness
+        assert wk.EMPTINESS_TIMESTAMP_ANNOTATION in cluster.nodes[node_name].meta.annotations
+        clock.step(31)
+        action = deprov.reconcile()
+        assert action is not None and action.reason == "emptiness"
+        assert node_name not in cluster.nodes
+
+    def test_emptiness_cleared_when_pod_lands(self):
+        cluster, provider, ctl, deprov, clock = make_env(
+            make_provisioner(ttl_seconds_after_empty=30)
+        )
+        for p in make_pods(2, cpu="250m"):
+            cluster.add_pod(p)
+        ctl.reconcile()
+        node_name = next(iter(cluster.nodes))
+        for p in list(cluster.pods.values()):
+            cluster.delete_pod(p.name)
+        deprov.reconcile()  # stamp
+        # pod arrives again before TTL
+        cluster.add_pod(make_pod(name="back", cpu="100m"))
+        ctl.reconcile()
+        clock.step(31)
+        assert deprov.reconcile() is None
+        assert wk.EMPTINESS_TIMESTAMP_ANNOTATION not in cluster.nodes[node_name].meta.annotations
+
+
+class TestExpiration:
+    def test_expired_node_replaced(self):
+        cluster, provider, ctl, deprov, clock = make_env(
+            make_provisioner(ttl_seconds_until_expired=3600)
+        )
+        for p in make_pods(4, cpu="500m"):
+            cluster.add_pod(p)
+        ctl.reconcile()
+        node_name = next(iter(cluster.nodes))
+        cluster.nodes[node_name].meta.creation_timestamp = clock.now() - 3700
+        action = deprov.reconcile()
+        assert action.reason == "expiration"
+        assert node_name not in cluster.nodes
+        # pods return to pending; next provisioning cycle reprovisions
+        assert cluster.pending_pods()
+        ctl.reconcile()
+        assert not cluster.pending_pods()
+
+
+class TestDrift:
+    def test_drifted_node_deprovisioned(self):
+        cluster, provider, ctl, deprov, clock = make_env()
+        for p in make_pods(3, cpu="500m"):
+            cluster.add_pod(p)
+        ctl.reconcile()
+        node_name = next(iter(cluster.nodes))
+        cluster.nodes[node_name].meta.annotations[wk.VOLUNTARY_DISRUPTION_ANNOTATION] = "drifted"
+        action = deprov.reconcile()
+        assert action.reason == "drift"
+        assert node_name not in cluster.nodes
+
+    def test_drift_disabled_by_gate(self):
+        cluster, provider, ctl, deprov, clock = make_env()
+        deprov.settings.drift_enabled = False
+        for p in make_pods(3, cpu="500m"):
+            cluster.add_pod(p)
+        ctl.reconcile()
+        node_name = next(iter(cluster.nodes))
+        cluster.nodes[node_name].meta.annotations[wk.VOLUNTARY_DISRUPTION_ANNOTATION] = "drifted"
+        assert deprov.reconcile() is None
+
+
+class TestConsolidation:
+    def _setup_sparse_cluster(self, validation_ttl=0.0):
+        """Two nodes, each mostly empty -> consolidatable onto one."""
+        cluster, provider, ctl, deprov, clock = make_env(
+            make_provisioner(consolidation_enabled=True), validation_ttl=validation_ttl
+        )
+        # Force two separate nodes by two sequential waves
+        for p in make_pods(6, "a", cpu="500m", memory="1Gi"):
+            cluster.add_pod(p)
+        ctl.reconcile()
+        for p in make_pods(2, "b", cpu="250m", memory="512Mi"):
+            cluster.add_pod(p)
+        ctl.reconcile()
+        # delete most of wave a so capacity frees up
+        for i in range(1, 6):
+            cluster.delete_pod(f"a-{i}")
+        return cluster, provider, ctl, deprov, clock
+
+    def test_consolidation_takes_an_action(self):
+        cluster, provider, ctl, deprov, clock = self._setup_sparse_cluster()
+        n_before = len(cluster.nodes)
+        if n_before < 2:
+            pytest.skip("solver packed both waves onto one node")
+        action = deprov.reconcile()
+        assert action is not None
+        assert action.reason.startswith("consolidation")
+        assert len(cluster.nodes) < n_before + (1 if action.replacement else 0) + 1
+
+    def test_no_consolidation_while_pending(self):
+        cluster, provider, ctl, deprov, clock = self._setup_sparse_cluster()
+        cluster.add_pod(make_pod(name="pending-1", cpu="100m"))
+        assert deprov.reconcile() is None
+
+    def test_do_not_evict_blocks(self):
+        cluster, provider, ctl, deprov, clock = self._setup_sparse_cluster()
+        for p in cluster.pods.values():
+            p.meta.annotations[wk.DO_NOT_EVICT_ANNOTATION] = "true"
+        assert deprov.reconcile() is None
+
+    def test_do_not_consolidate_node_blocks(self):
+        cluster, provider, ctl, deprov, clock = self._setup_sparse_cluster()
+        for n in cluster.nodes.values():
+            n.meta.annotations[wk.DO_NOT_CONSOLIDATE_ANNOTATION] = "true"
+        assert deprov.reconcile() is None
+
+    def test_controllerless_pod_blocks_node(self):
+        cluster, provider, ctl, deprov, clock = make_env(
+            make_provisioner(consolidation_enabled=True)
+        )
+        cluster.add_pod(make_pod(name="orphan", owner=None, cpu="100m"))
+        ctl.reconcile()
+        assert deprov.reconcile() is None
+
+    def test_validation_window_aborts_on_new_pods(self):
+        cluster, provider, ctl, deprov, clock = self._setup_sparse_cluster(validation_ttl=15.0)
+        if len(cluster.nodes) < 2:
+            pytest.skip("solver packed both waves onto one node")
+        assert deprov.reconcile() is None  # planned, inside window
+        assert deprov.pending_action is not None
+        # cluster changes during the window: new pending pods invalidate
+        cluster.add_pod(make_pod(name="burst", cpu="100m"))
+        clock.step(16)
+        assert deprov.reconcile() is None
+        assert deprov.pending_action is None
+        assert deprov.recorder.events("DeprovisioningAborted")
+
+    def test_validation_window_executes_when_stable(self):
+        cluster, provider, ctl, deprov, clock = self._setup_sparse_cluster(validation_ttl=15.0)
+        if len(cluster.nodes) < 2:
+            pytest.skip("solver packed both waves onto one node")
+        n_before = len(cluster.nodes)
+        assert deprov.reconcile() is None  # planned
+        clock.step(16)
+        action = deprov.reconcile()
+        assert action is not None and action.reason.startswith("consolidation")
+
+    def test_all_pods_survive_consolidation(self):
+        cluster, provider, ctl, deprov, clock = self._setup_sparse_cluster()
+        pods_before = set(cluster.pods)
+        for _ in range(5):
+            if deprov.reconcile() is None:
+                ctl.reconcile()  # rebind evicted pods
+        ctl.reconcile()
+        assert set(cluster.pods) == pods_before
+        assert not cluster.pending_pods()

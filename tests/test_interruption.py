@@ -1,0 +1,125 @@
+import json
+
+import pytest
+
+from karpenter_tpu.api import labels as wk
+from karpenter_tpu.api.settings import Settings
+from karpenter_tpu.cloudprovider import FakeCloudProvider, generate_catalog
+from karpenter_tpu.controllers import (
+    FakeQueue,
+    InterruptionController,
+    ProvisioningController,
+    TerminationController,
+)
+from karpenter_tpu.state import Cluster
+from karpenter_tpu.utils import metrics
+
+from helpers import make_pods, make_provisioner
+
+
+@pytest.fixture
+def env():
+    cluster = Cluster()
+    provider = FakeCloudProvider(catalog=generate_catalog(n_types=40))
+    ctl = ProvisioningController(
+        cluster, provider, settings=Settings(batch_idle_duration=0, batch_max_duration=0)
+    )
+    term = TerminationController(cluster, provider)
+    queue = FakeQueue()
+    intr = InterruptionController(
+        cluster, queue, term, unavailable_offerings=provider.unavailable_offerings
+    )
+    cluster.add_provisioner(make_provisioner())
+    for p in make_pods(6, cpu="500m"):
+        cluster.add_pod(p)
+    ctl.reconcile()
+    return cluster, provider, ctl, term, queue, intr
+
+
+def spot_warning(instance_id):
+    return {
+        "version": "0",
+        "source": "cloud.compute",
+        "detail-type": "Spot Instance Interruption Warning",
+        "detail": {"instance-id": instance_id},
+    }
+
+
+class TestInterruption:
+    def test_spot_interruption_drains_and_marks_ice(self, env):
+        cluster, provider, ctl, term, queue, intr = env
+        node = next(iter(cluster.nodes.values()))
+        instance_id = node.provider_id.rsplit("/", 1)[-1]
+        it, zone = node.instance_type(), node.zone()
+        queue.send(spot_warning(instance_id))
+        handled = intr.reconcile()
+        assert handled == 1
+        assert len(queue) == 0
+        assert node.name not in cluster.nodes  # cordon-and-drain deleted it
+        assert provider.unavailable_offerings.is_unavailable(it, zone, "spot")
+        # evicted pods pending again; next cycle reprovisions avoiding the pool
+        assert cluster.pending_pods()
+        ctl.reconcile()
+        assert not cluster.pending_pods()
+
+    def test_rebalance_is_event_only(self, env):
+        cluster, provider, ctl, term, queue, intr = env
+        node = next(iter(cluster.nodes.values()))
+        instance_id = node.provider_id.rsplit("/", 1)[-1]
+        queue.send({
+            "version": "0", "source": "cloud.compute",
+            "detail-type": "Instance Rebalance Recommendation",
+            "detail": {"instance-id": instance_id},
+        })
+        intr.reconcile()
+        assert node.name in cluster.nodes  # not drained
+        assert intr.recorder.events("rebalance")
+
+    def test_state_change_only_for_actionable_states(self, env):
+        cluster, provider, ctl, term, queue, intr = env
+        node = next(iter(cluster.nodes.values()))
+        instance_id = node.provider_id.rsplit("/", 1)[-1]
+        queue.send({
+            "version": "0", "source": "cloud.compute",
+            "detail-type": "Instance State-change Notification",
+            "detail": {"instance-id": instance_id, "state": "running"},
+        })
+        intr.reconcile()
+        assert node.name in cluster.nodes  # running is not actionable
+        queue.send({
+            "version": "0", "source": "cloud.compute",
+            "detail-type": "Instance State-change Notification",
+            "detail": {"instance-id": instance_id, "state": "terminated"},
+        })
+        intr.reconcile()
+        assert node.name not in cluster.nodes
+
+    def test_scheduled_change_drains(self, env):
+        cluster, provider, ctl, term, queue, intr = env
+        node = next(iter(cluster.nodes.values()))
+        instance_id = node.provider_id.rsplit("/", 1)[-1]
+        queue.send({
+            "version": "0", "source": "cloud.health",
+            "detail-type": "Scheduled Change",
+            "resources": [f"arn:::instance/{instance_id}"],
+        })
+        intr.reconcile()
+        assert node.name not in cluster.nodes
+
+    def test_unknown_and_garbage_messages_are_noops(self, env):
+        cluster, provider, ctl, term, queue, intr = env
+        n_nodes = len(cluster.nodes)
+        from karpenter_tpu.controllers.interruption import QueueMessage
+
+        queue.send({"version": "9", "source": "wat", "detail-type": "???"})
+        queue._messages.append(QueueMessage(id="bad", body="not json"))
+        intr.reconcile()
+        assert len(cluster.nodes) == n_nodes
+        assert len(queue) == 0  # both deleted
+
+    def test_message_for_unknown_instance_ignored(self, env):
+        cluster, provider, ctl, term, queue, intr = env
+        n_nodes = len(cluster.nodes)
+        queue.send(spot_warning("i-99999999"))
+        intr.reconcile()
+        assert len(cluster.nodes) == n_nodes
