@@ -48,8 +48,13 @@ from .termination import TerminationController
 class PlannedAction:
     reason: str  # expiration | drift | emptiness | consolidation-delete | consolidation-replace
     nodes: List[str]
-    replacement: Optional[object] = None  # NewNodeSpec
+    replacements: List[object] = field(default_factory=list)  # NewNodeSpec list
     created: float = 0.0
+    savings: float = 0.0  # $/hr reclaimed (consolidation actions)
+
+    @property
+    def replacement(self) -> Optional[object]:
+        return self.replacements[0] if self.replacements else None
 
 
 class DeprovisioningController:
@@ -71,6 +76,14 @@ class DeprovisioningController:
         self.recorder = recorder or Recorder()
         self.clock = clock or Clock()
         self.pending_action: Optional[PlannedAction] = None
+        # Stabilization window (designs/consolidation.md:59-67): consolidation
+        # waits until the node population has been quiet for the whole window.
+        self._last_node_change = float("-inf")
+        cluster.watch(self._on_event)
+
+    def _on_event(self, event: str, obj) -> None:
+        if isinstance(obj, Node) and event in ("ADDED", "DELETED"):
+            self._last_node_change = self.clock.now()
 
     # ------------------------------------------------------------------
     def reconcile(self) -> Optional[PlannedAction]:
@@ -126,7 +139,9 @@ class DeprovisioningController:
             if prov is None or prov.ttl_seconds_until_expired is None:
                 continue
             if now - node.meta.creation_timestamp > prov.ttl_seconds_until_expired:
-                return PlannedAction(reason="expiration", nodes=[node.name])
+                action = self._replace_action("expiration", node)
+                if action is not None:
+                    return action
         return None
 
     def _drift(self) -> Optional[PlannedAction]:
@@ -134,8 +149,36 @@ class DeprovisioningController:
             return None
         for node in self._candidates():
             if node.meta.annotations.get(wk.VOLUNTARY_DISRUPTION_ANNOTATION) == "drifted":
-                return PlannedAction(reason="drift", nodes=[node.name])
+                action = self._replace_action("drift", node)
+                if action is not None:
+                    return action
         return None
+
+    def _replace_action(self, reason: str, node: Node) -> Optional[PlannedAction]:
+        """Drift/expiration action: provision replacement capacity BEFORE the node
+        drains (the reference launches replacement nodes for drifted/expired nodes
+        before terminating) — no price ceiling, as many new nodes as the workload
+        needs. If the pods cannot be rescheduled at all, defer rather than strand."""
+        pods = [p for p in self.cluster.pods_on_node(node.name) if not p.is_daemonset]
+        if not pods:
+            return PlannedAction(reason=reason, nodes=[node.name])
+        # Don't pre-launch paid capacity for a drain that can never complete:
+        # PDB-blocked or do-not-evict pods defer the action instead.
+        for pod in pods:
+            if pod.meta.annotations.get(wk.DO_NOT_EVICT_ANNOTATION) == "true":
+                return None
+            if self.termination._pdb_blocks(pod):
+                return None
+        fits, replacements = self._simulate(
+            pods, exclude=[node.name], price_ceiling=None, max_new=None
+        )
+        if not fits:
+            self.recorder.publish(
+                "DeprovisioningBlocked", f"{reason}: pods cannot be rescheduled",
+                object_name=node.name, object_kind="Node", type="Warning",
+            )
+            return None
+        return PlannedAction(reason=reason, nodes=[node.name], replacements=replacements)
 
     def _emptiness(self) -> Optional[PlannedAction]:
         """ttlSecondsAfterEmpty: stamp empty nodes, delete the ones past TTL —
@@ -169,6 +212,11 @@ class DeprovisioningController:
     def _consolidation(self) -> Optional[PlannedAction]:
         if self.cluster.pending_pods():
             return None  # cluster still provisioning; wait for stability
+        if (
+            self.settings.stabilization_window > 0
+            and self.clock.now() - self._last_node_change < self.settings.stabilization_window
+        ):
+            return None  # node population still settling (consolidation.md:59-67)
         candidates = self._consolidatable()
         if not candidates:
             return None
@@ -224,31 +272,61 @@ class DeprovisioningController:
     def _try_single_node(self, node: Node):
         pods = [p for p in self.cluster.pods_on_node(node.name) if not p.is_daemonset]
         if not pods:
-            return PlannedAction(reason="consolidation-delete", nodes=[node.name])
-        fits, replacement = self._simulate(pods, exclude=[node.name],
-                                           price_ceiling=self._node_price(node))
+            return PlannedAction(
+                reason="consolidation-delete", nodes=[node.name],
+                savings=self._node_price(node),
+            )
+        price = self._node_price(node)
+        fits, replacements = self._simulate(pods, exclude=[node.name], price_ceiling=price)
         if not fits:
             return None
-        if replacement is None:
-            return PlannedAction(reason="consolidation-delete", nodes=[node.name])
+        if not replacements:
+            return PlannedAction(
+                reason="consolidation-delete", nodes=[node.name], savings=price
+            )
         # replacement required: spot nodes are delete-only (deprovisioning.md:83-85)
         if node.capacity_type() == wk.CAPACITY_TYPE_SPOT:
             return None
         return PlannedAction(
-            reason="consolidation-replace", nodes=[node.name], replacement=replacement
+            reason="consolidation-replace", nodes=[node.name],
+            replacements=replacements,
+            savings=price - sum(r.option.price for r in replacements),
         )
 
     def _try_multi_node(self, candidates: List[Node]):
-        """Try deleting the K cheapest-to-disrupt nodes together, allowing one
-        cheaper replacement (designs/deprovisioning.md one-cheaper-replacement)."""
+        """Delete a subset of the cheapest-to-disrupt nodes together, allowing one
+        cheaper replacement (designs/deprovisioning.md one-cheaper-replacement).
+        Every prefix size is evaluated and the MAX-SAVINGS feasible subset wins —
+        not the first feasible one. Spot nodes may be deleted in a subset; they
+        only rule out the replacement variant (deprovisioning.md:83-85)."""
         best = None
         for k in range(len(candidates), 1, -1):
-            subset = candidates[:k]
-            if any(n.capacity_type() == wk.CAPACITY_TYPE_SPOT for n in subset):
-                spot_free = [n for n in subset if n.capacity_type() != wk.CAPACITY_TYPE_SPOT]
-                if len(spot_free) < 2:
-                    continue
-                subset = spot_free
+            action = self._evaluate_subset(candidates[:k])
+            if action is None:
+                continue
+            if best is None or action.savings > best.savings + 1e-9:
+                best = action
+        return best
+
+    def _evaluate_subset(self, subset: List[Node]) -> Optional[PlannedAction]:
+        pods = [
+            p
+            for n in subset
+            for p in self.cluster.pods_on_node(n.name)
+            if not p.is_daemonset
+        ]
+        total_price = sum(self._node_price(n) for n in subset)
+        fits, replacements = self._simulate(
+            pods, exclude=[n.name for n in subset], price_ceiling=total_price
+        )
+        has_spot = any(n.capacity_type() == wk.CAPACITY_TYPE_SPOT for n in subset)
+        if has_spot and (not fits or replacements):
+            # Spot nodes are delete-only: a subset that needs replacement (or is
+            # infeasible because of its spot members' pods) retries without them
+            # — spot-free subsets are not prefixes, so this is a distinct search.
+            subset = [n for n in subset if n.capacity_type() != wk.CAPACITY_TYPE_SPOT]
+            if len(subset) < 2:
+                return None
             pods = [
                 p
                 for n in subset
@@ -256,26 +334,35 @@ class DeprovisioningController:
                 if not p.is_daemonset
             ]
             total_price = sum(self._node_price(n) for n in subset)
-            fits, replacement = self._simulate(
+            fits, replacements = self._simulate(
                 pods, exclude=[n.name for n in subset], price_ceiling=total_price
             )
-            if not fits:
-                continue
-            return PlannedAction(
-                reason="consolidation-replace" if replacement else "consolidation-delete",
-                nodes=[n.name for n in subset],
-                replacement=replacement,
-            )
-        return best
+        if not fits:
+            return None
+        savings = total_price - sum(r.option.price for r in replacements)
+        if savings <= 1e-9:
+            return None
+        return PlannedAction(
+            reason="consolidation-replace" if replacements else "consolidation-delete",
+            nodes=[n.name for n in subset],
+            replacements=replacements,
+            savings=savings,
+        )
 
     def _simulate(
-        self, pods: Sequence[Pod], exclude: Sequence[str], price_ceiling: float
-    ) -> Tuple[bool, Optional[object]]:
+        self,
+        pods: Sequence[Pod],
+        exclude: Sequence[str],
+        price_ceiling: Optional[float] = None,
+        max_new: Optional[int] = 1,
+    ) -> Tuple[bool, List[object]]:
         """Re-schedule simulation: can `pods` land on the remaining nodes, plus at
-        most ONE new node strictly cheaper than `price_ceiling`?
+        most `max_new` new nodes (each strictly cheaper than `price_ceiling`, when
+        one is set)?
 
-        Returns (feasible, replacement_spec_or_None). Conservative: any
-        unschedulable pod or >1 new node means infeasible (never strand a pod).
+        Returns (feasible, replacement_specs). Conservative: any unschedulable pod
+        or more than `max_new` new nodes means infeasible (never strand a pod).
+        `max_new=None` lifts the cap (drift/expiration replacements).
         """
         existing = [
             e
@@ -289,7 +376,8 @@ class DeprovisioningController:
                 offerings = [
                     o
                     for o in it.offerings
-                    if o.available and o.price < price_ceiling - 1e-9
+                    if o.available
+                    and (price_ceiling is None or o.price < price_ceiling - 1e-9)
                 ]
                 if offerings:
                     types.append(it.with_offerings(offerings))
@@ -298,12 +386,10 @@ class DeprovisioningController:
             list(pods), provisioners, existing=existing, daemonsets=self.cluster.daemonsets()
         )
         if result.unschedulable:
-            return False, None
-        if len(result.new_nodes) == 0:
-            return True, None
-        if len(result.new_nodes) == 1:
-            return True, result.new_nodes[0]
-        return False, None
+            return False, []
+        if max_new is not None and len(result.new_nodes) > max_new:
+            return False, []
+        return True, list(result.new_nodes)
 
     def _still_valid(self, action: PlannedAction) -> bool:
         nodes = [self.cluster.nodes.get(n) for n in action.nodes]
@@ -318,23 +404,23 @@ class DeprovisioningController:
             if not p.is_daemonset
         ]
         price = sum(self._node_price(n) for n in nodes)
-        fits, replacement = self._simulate(pods, exclude=action.nodes, price_ceiling=price)
+        fits, replacements = self._simulate(pods, exclude=action.nodes, price_ceiling=price)
         if not fits:
             return False
-        if action.replacement is None and replacement is not None:
+        if not action.replacements and replacements:
             return False  # a delete plan now needs capacity: abort
         return True
 
     # -- execution -------------------------------------------------------
     def _execute(self, action: PlannedAction) -> None:
-        if action.replacement is not None:
-            # launch the replacement BEFORE draining the old nodes, as the
+        for replacement in action.replacements:
+            # launch replacements BEFORE draining the old nodes, as the
             # reference does (replacement-node timeout semantics)
-            pods = action.replacement.pod_names
+            pods = replacement.pod_names
             requests = merge(
                 [self.cluster.pods[n].requests for n in pods if n in self.cluster.pods]
             )
-            launch_from_spec(self.cluster, self.provider, action.replacement, requests)
+            launch_from_spec(self.cluster, self.provider, replacement, requests)
         for name in action.nodes:
             self.termination.delete_node(name)
         self.termination.reconcile()

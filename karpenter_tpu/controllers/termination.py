@@ -101,16 +101,17 @@ class TerminationController:
         return blocked
 
     def _pdb_blocks(self, pod: Pod) -> bool:
+        """Eviction-API accounting: an eviction is allowed only while it keeps the
+        budget satisfied, counting pods ALREADY disrupted (selected but not bound
+        to a node) against maxUnavailable — so draining N nodes at once cannot
+        take every replica of a maxUnavailable=1 budget in one pass."""
         for pdb in self.cluster.pdbs_for_pod(pod):
-            selected = [
-                p
-                for p in self.cluster.pods.values()
-                if pdb.selects(p) and p.node_name is not None
-            ]
-            healthy = len(selected)
+            selected = [p for p in self.cluster.pods.values() if pdb.selects(p)]
+            healthy = sum(1 for p in selected if p.node_name is not None)
+            unavailable = len(selected) - healthy
             if pdb.min_available is not None and healthy - 1 < pdb.min_available:
                 return True
-            if pdb.max_unavailable is not None and pdb.max_unavailable < 1:
+            if pdb.max_unavailable is not None and unavailable + 1 > pdb.max_unavailable:
                 return True
         return False
 

@@ -105,13 +105,15 @@ class Operator:
 
     # -- single synchronous pass over every loop (tests/simulation) --------
     def step(self) -> None:
+        """Deprovisioning runs BEFORE provisioning so pods evicted by a replace
+        action re-bind (onto the pre-launched replacement) in the same pass."""
         if self.interruption is not None:
             self.interruption.reconcile()
         if self.nodetemplate is not None:
             self.nodetemplate.reconcile()
         self.drift.reconcile()
-        self.provisioning.reconcile()
         self.deprovisioning.reconcile()
+        self.provisioning.reconcile()
         self.termination.reconcile()
         self.garbagecollect.reconcile()
 

@@ -125,10 +125,14 @@ class GreedyPacker:
         pod_order.sort(key=lambda t: -t[0])
 
         unschedulable: List[str] = []
-        # cheapest-first option order; larger capacity breaks price ties
-        opt_order = sorted(
-            range(p.O), key=lambda j: (p.price[j], -float(p.alloc[j].sum()))
-        )
+        # Unplaced count per group: opening a node for a pod sizes the node by the
+        # TRUE marginal cost of the group's remaining pods (ceil(remaining/units) x
+        # price), mirroring how the reference packs the batch into a hypothetical
+        # node and then picks the cheapest instance type that holds it — not
+        # "cheapest node that fits one pod", which shreds batches across minimum
+        # nodes (bin-packing.md:16-43).
+        remaining = {gi: g.count for gi, g in enumerate(p.groups)}
+        units_cache: Dict[int, np.ndarray] = {}
         for size, gi, pod in pod_order:
             demand = p.demand[gi].astype(np.float64)
             placed = False
@@ -137,10 +141,29 @@ class GreedyPacker:
                     placed = True
                     break
             if placed:
+                remaining[gi] -= 1
                 continue
+            units = units_cache.get(gi)
+            if units is None:
+                with np.errstate(divide="ignore", invalid="ignore"):
+                    per_axis = np.where(
+                        demand[None, :] > 0,
+                        np.floor(p.alloc / np.maximum(demand[None, :], 1e-30) + 1e-9),
+                        np.inf,
+                    )
+                units = np.min(per_axis, axis=1)
+                units = np.where(np.isfinite(units), units, 0).astype(np.int64)
+                units_cache[gi] = units
+            want = max(remaining[gi], 1)
+            with np.errstate(divide="ignore", invalid="ignore"):
+                total = np.where(units > 0, -(-want // np.maximum(units, 1)) * p.price, np.inf)
+            total = np.where(p.compat[gi], total, np.inf)
+            # cheapest true cost first; larger capacity breaks ties
+            opt_order = sorted(
+                np.flatnonzero(np.isfinite(total)).tolist(),
+                key=lambda j: (total[j], -int(units[j])),
+            )
             for j in opt_order:
-                if not p.compat[gi, j]:
-                    continue
                 node = _SimNode(
                     rem=p.alloc[j].astype(np.float64).copy(),
                     zone=p.options[j].zone,
@@ -152,7 +175,9 @@ class GreedyPacker:
                     placed = True
                     break
                 self.nodes.pop()
-            if not placed:
+            if placed:
+                remaining[gi] -= 1
+            else:
                 unschedulable.append(pod.name)
 
         new_nodes = [

@@ -160,25 +160,73 @@ def _pack_one(
         units_o = jnp.where(opt_ok_any, units_o, 0)
         units_o = jnp.where(coloc, jnp.where(units_o >= cnt, units_o, 0), units_o)
         usable = units_o > 0
-        # Score: price per pod-slot, with a portfolio-varied exponent that trades
-        # "cheapest absolute node" against "cheapest per unit".
-        score = inputs.price / jnp.power(jnp.maximum(units_o, 1).astype(jnp.float32), alpha)
-        score = jnp.where(usable, score, INF)
 
         new_place_acc = jnp.zeros((s_new,), jnp.int32)
 
-        def open_pass(state, zone_restrict, enabled):
+        def open_pass(state, zone_restrict, enabled, full_only):
+            """Open nodes for the group's remainder. Option choice minimizes the
+            TRUE marginal cost (ceil(want/units) x price) — not price per
+            theoretical slot, which over-opens big nodes for small groups.
+            ``full_only`` opens just the completely-filled nodes of the winner so
+            a follow-up pass can right-size the remainder onto a cheaper/smaller
+            option (the mixed sizing a pod-at-a-time greedy gets for free)."""
             new_rem, new_opt, new_active, left, placed_z, new_place_acc = state
             if zone_restrict is None:
-                pass_score = score
+                zone_ok = jnp.ones_like(usable)
                 want_cap = IBIG
             else:
-                pass_score = jnp.where(inputs.opt_zone == zone_restrict, score, INF)
+                zone_ok = inputs.opt_zone == zone_restrict
                 want_cap = jnp.maximum(quota[zone_restrict] - placed_z[zone_restrict], 0)
-            o = jnp.argmin(pass_score)
+            want = jnp.minimum(left, want_cap)
+            safe_c = jnp.maximum(units_o, 1)
+            units_f = units_o.astype(jnp.float32)
+            ok = usable & zone_ok & (want > 0)
+
+            def _argmin_tiebreak(score):
+                # Tie-break within 0.01%: members with alpha >= 1 prefer the
+                # LARGER node (leaves room for later groups), alpha < 1 the
+                # smaller one (less stranded capacity) — the portfolio covers
+                # both endgames.
+                best = jnp.min(score)
+                cand = score <= best * jnp.float32(1.0001)
+                pref = jnp.where(alpha >= 1.0, units_f, -units_f)
+                return jnp.argmax(jnp.where(cand, pref, -INF)), best
+
+            # Lump strategy: one option serves everything, ceil(want/c) nodes.
+            k_all = -(-jnp.maximum(want, 0) // safe_c)
+            lump_score = jnp.where(ok, k_all.astype(jnp.float32) * inputs.price, INF)
+            o_lump, cost_lump = _argmin_tiebreak(lump_score)
+            if full_only:
+                # Mixed strategy: completely-filled nodes of the best-RATE option
+                # (zero waste), remainder right-sized by a later ceil pass.
+                rate = jnp.where(
+                    ok & (units_o <= want), inputs.price / jnp.maximum(units_f, 1.0), INF
+                )
+                o_rate, best_rate = _argmin_tiebreak(rate)
+                c_rate = units_o[o_rate]
+                n_full = want // jnp.maximum(c_rate, 1)
+                rem = want - n_full * c_rate
+                rem_k = -(-jnp.maximum(rem, 0) // safe_c)
+                rem_score = jnp.where(ok, rem_k.astype(jnp.float32) * inputs.price, INF)
+                rem_cost = jnp.where(rem > 0, jnp.min(rem_score), 0.0)
+                cost_mixed = jnp.where(
+                    best_rate < INF,
+                    n_full.astype(jnp.float32) * inputs.price[o_rate] + rem_cost,
+                    INF,
+                )
+                lump = cost_lump <= cost_mixed
+                o = jnp.where(lump, o_lump, o_rate)
+                best_score = jnp.minimum(cost_lump, cost_mixed)
+            else:
+                lump = jnp.bool_(True)
+                o = o_lump
+                best_score = cost_lump
             c = units_o[o]
-            feasible = enabled & (pass_score[o] < INF) & (left > 0)
-            want = jnp.where(feasible, jnp.minimum(left, want_cap), 0)
+            feasible = enabled & (best_score < INF) & (left > 0)
+            want = jnp.where(feasible, want, 0)
+            if full_only:
+                # mixed: stop at the whole nodes; lump: serve everything now
+                want = jnp.where(lump, want, (want // jnp.maximum(c, 1)) * c)
             k = jnp.where(c > 0, -(-want // jnp.maximum(c, 1)), 0)  # ceil
             free_rank = jnp.cumsum((~new_active).astype(jnp.int32)) * (~new_active)
             take = (~new_active) & (free_rank >= 1) & (free_rank <= k)
@@ -198,8 +246,11 @@ def _pack_one(
 
         state = (new_rem, new_opt, new_active, left, placed_z, new_place_acc)
         for z in range(n_zones):  # zone-limited groups: fill zones under quota
-            state = open_pass(state, z, zone_limited)
-        state = open_pass(state, None, ~zone_limited)  # others: one best option
+            state = open_pass(state, z, zone_limited, full_only=True)
+            state = open_pass(state, z, zone_limited, full_only=False)
+        # others: full nodes of the cost-winner, then a right-sized remainder
+        state = open_pass(state, None, ~zone_limited, full_only=True)
+        state = open_pass(state, None, ~zone_limited, full_only=False)
         new_rem, new_opt, new_active, left, placed_z, new_place_acc = state
 
         unplaced = unplaced + left

