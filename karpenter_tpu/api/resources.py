@@ -88,7 +88,7 @@ class Resources:
     Missing names are zero. Supports +, -, scalar *, max, and ``fits``.
     """
 
-    __slots__ = ("_r",)
+    __slots__ = ("_r", "_hash")
 
     def __init__(self, quantities: Mapping[str, Quantity] | None = None, **kw: Quantity):
         r: Dict[str, float] = {}
@@ -165,7 +165,11 @@ class Resources:
         return isinstance(other, Resources) and self._r == other._r
 
     def __hash__(self) -> int:
-        return hash(tuple(sorted(self._r.items())))
+        h = getattr(self, "_hash", None)
+        if h is None:
+            h = hash(tuple(sorted(self._r.items())))
+            object.__setattr__(self, "_hash", h)
+        return h
 
     def __bool__(self) -> bool:
         return bool(self._r)

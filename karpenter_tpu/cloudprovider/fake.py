@@ -81,6 +81,12 @@ class FakeCloudProvider(CloudProvider):
         self.max_instance_types = max_instance_types
         self._id_counter = itertools.count(1)
         self._lock = threading.Lock()
+        # Seqnum-keyed instance-type cache (reference: multi-level cache keyed
+        # on seqnums+hashes, pkg/providers/instancetype/instancetype.go:95-107).
+        # Returning the SAME list object until something changes lets the
+        # encoder's option cache skip re-flattening 400 types x offerings.
+        self.catalog_version = 0
+        self._it_cache: Dict[Optional[str], tuple] = {}
 
     # -- test injection ----------------------------------------------------
     def set_insufficient_capacity(self, instance_type: str, zone: str, capacity_type: str) -> None:
@@ -231,7 +237,20 @@ class FakeCloudProvider(CloudProvider):
     def get_instance_types(self, provisioner: Optional[Provisioner]) -> List[InstanceType]:
         """Catalog filtered to the provisioner's requirements with current
         availability masks applied (GetInstanceTypes + resolveInstanceTypes,
-        cloudprovider.go:155-170,254-273)."""
+        cloudprovider.go:155-170,254-273). Cached per provisioner keyed on the
+        ICE-cache seqnum + catalog version + a 60s staleness bucket (TTL-expired
+        ICE entries come back without a seqnum bump, as in the reference)."""
+        pname = provisioner.name if provisioner is not None else None
+        key = (
+            pname,
+            provisioner.meta.resource_version if provisioner is not None else None,
+            self.unavailable_offerings.seqnum,
+            self.catalog_version,
+            int(time.time() // 60),
+        )
+        cached = self._it_cache.get(pname)
+        if cached is not None and cached[0] == key:
+            return cached[1]
         out: List[InstanceType] = []
         for it in self.catalog:
             if provisioner is not None and not it.requirements.compatible(provisioner.requirements):
@@ -249,6 +268,7 @@ class FakeCloudProvider(CloudProvider):
                 for o in it.offerings
             ]
             out.append(it.with_offerings(offerings))
+        self._it_cache[pname] = (key, out)
         return out
 
     def is_machine_drifted(self, machine: Machine) -> bool:

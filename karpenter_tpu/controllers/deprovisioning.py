@@ -300,7 +300,11 @@ class DeprovisioningController:
         not the first feasible one. Spot nodes may be deleted in a subset; they
         only rule out the replacement variant (deprovisioning.md:83-85)."""
         best = None
-        for k in range(len(candidates), 1, -1):
+        # heuristic subset cap (the reference consolidates over a bounded
+        # candidate subset, designs/consolidation.md): each prefix is a full
+        # reschedule simulation, so the search is capped at the 25
+        # cheapest-to-disrupt nodes
+        for k in range(min(len(candidates), 25), 1, -1):
             action = self._evaluate_subset(candidates[:k])
             if action is None:
                 continue

@@ -1,3 +1,4 @@
+from .bounds import best_lower_bound, fractional_lower_bound, lp_lower_bound
 from .encode import EncodedProblem, ExistingNode, LaunchOption, PodGroup, build_options, encode, group_pods
 from .greedy import GreedyPacker
 from .result import NewNodeSpec, SolveResult
@@ -19,5 +20,8 @@ __all__ = [
     "Solver",
     "TPUSolver",
     "lower_bound",
+    "best_lower_bound",
+    "fractional_lower_bound",
+    "lp_lower_bound",
     "validate",
 ]

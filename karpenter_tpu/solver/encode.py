@@ -58,14 +58,21 @@ class PodGroup:
 
 
 def _signature(pod: Pod) -> tuple:
-    terms = tuple(
+    """Scheduling-identity key, built from raw fields (no Requirements objects —
+    that construction cost dominates 50k-pod encodes) and cached on the pod, so
+    re-encoding the same pods across reconcile cycles is near-free."""
+    cached = pod.__dict__.get("_sched_sig")
+    if cached is not None:
+        return cached
+    req_terms = tuple(
         tuple(sorted((r.key, r.complement, tuple(sorted(r.values)), r.greater_than, r.less_than)
                      for r in term))
-        for term in pod.scheduling_requirement_terms()
+        for term in pod.required_affinity_terms
     )
-    return (
-        pod.requests,
-        terms,
+    sig = (
+        tuple(sorted(pod.requests.items())),  # plain tuple: cheap dict hashing
+        tuple(sorted(pod.node_selector.items())),
+        req_terms,
         tuple(sorted((t.key, t.operator, t.value, t.effect) for t in pod.tolerations)),
         tuple(sorted((c.max_skew, c.topology_key, c.when_unsatisfiable,
                       tuple(sorted(c.label_selector.items()))) for c in pod.topology_spread)),
@@ -73,6 +80,8 @@ def _signature(pod: Pod) -> tuple:
                      for t in pod.affinity_terms)),
         tuple(sorted(pod.meta.labels.items())),
     )
+    pod.__dict__["_sched_sig"] = sig
+    return sig
 
 
 def group_pods(pods: Sequence[Pod]) -> List[PodGroup]:
@@ -119,7 +128,7 @@ def group_pods(pods: Sequence[Pod]) -> List[PodGroup]:
             PodGroup(
                 pods=members,
                 requests=pod.requests,
-                terms=pod.scheduling_requirement_terms(),
+                terms=pod.scheduling_requirement_terms(),  # representative only
                 tolerations=tuple(pod.tolerations),
                 node_cap=node_cap,
                 zone_cap=zone_cap,
@@ -148,6 +157,22 @@ class LaunchOption:
     allocatable: Resources  # after daemonset overhead
 
 
+_options_cache: Dict[tuple, tuple] = {}
+_table_cache: Dict[int, tuple] = {}
+
+
+def _get_option_table(options: List[LaunchOption]) -> "_ReqTable":
+    """Requirement table for an option list, cached by list identity (the
+    options cache returns the same list object until inputs change)."""
+    entry = _table_cache.get(id(options))
+    if entry is not None and entry[0] is options:
+        return entry[1]
+    table = _ReqTable([o.node_requirements for o in options])
+    _table_cache.clear()
+    _table_cache[id(options)] = (options, table)
+    return table
+
+
 def build_options(
     provisioners: Sequence[Tuple[Provisioner, Sequence[InstanceType]]],
     daemonsets: Sequence[Pod] = (),
@@ -157,7 +182,27 @@ def build_options(
     The daemonset overhead of each option is subtracted up front, mirroring how the
     reference's scheduler accounts daemonset resources per candidate node
     (designs/bin-packing.md; website concepts/scheduling.md 'daemonsets').
+
+    Results are cached per (provisioner identity, instance-type list identity,
+    daemonset identity) — the analogue of the reference's seqnum-keyed
+    instance-type caches (``pkg/providers/instancetype/instancetype.go:95-107``):
+    providers return the SAME list object until something changes, so warm
+    reconcile cycles skip the whole flatten.
     """
+    key = (
+        tuple(
+            (id(p), p.meta.resource_version, id(types))
+            for p, types in provisioners
+        ),
+        tuple(id(d) for d in daemonsets),
+    )
+    cached = _options_cache.get(key)
+    if cached is not None and all(
+        co[0] is p and co[1] is t
+        for co, (p, t) in zip(cached[0], provisioners)
+    ):
+        return cached[1]
+
     options: List[LaunchOption] = []
     for provisioner, instance_types in provisioners:
         prov_reqs = provisioner.requirements.intersect(
@@ -168,12 +213,15 @@ def build_options(
             merged = it.requirements.intersect(prov_reqs)
             if merged.is_empty_any():
                 continue
+            alloc = it.allocatable()
+            zone_req = merged.get(wk.ZONE)
+            ct_req = merged.get(wk.CAPACITY_TYPE)
             for offering in it.offerings:
                 if not offering.available:
                     continue
-                if not merged.get(wk.ZONE).has(offering.zone):
+                if not zone_req.has(offering.zone):
                     continue
-                if not merged.get(wk.CAPACITY_TYPE).has(offering.capacity_type):
+                if not ct_req.has(offering.capacity_type):
                     continue
                 node_reqs = merged.intersect(
                     Requirements(
@@ -184,7 +232,6 @@ def build_options(
                         ]
                     )
                 )
-                alloc = it.allocatable()
                 ds = _daemonset_overhead(daemonsets, node_reqs, taints, alloc)
                 options.append(
                     LaunchOption(
@@ -198,6 +245,11 @@ def build_options(
                         allocatable=(alloc - ds).clamp_min_zero(),
                     )
                 )
+    _options_cache.clear()  # hold one generation; stale keys pin dead objects
+    _options_cache[key] = (
+        [(p, t) for p, t in provisioners],
+        options,
+    )
     return options
 
 
@@ -214,6 +266,99 @@ def _daemonset_overhead(
             continue
         total = total + ds.requests + Resources(pods=1)
     return total
+
+
+# ---------------------------------------------------------------------------
+# Vectorized requirement evaluation
+# ---------------------------------------------------------------------------
+
+_VOCAB: Dict[str, int] = {}  # process-wide string->code table for label values
+
+
+def _code(value: str) -> int:
+    c = _VOCAB.get(value)
+    if c is None:
+        c = len(_VOCAB)
+        _VOCAB[value] = c
+    return c
+
+
+class _ReqTable:
+    """Column-oriented view of N requirement surfaces (launch options or nodes)
+    for vectorized compatibility checks.
+
+    Per label key: ``has[N]`` (key defined), ``codes[N]`` (singleton-In value
+    code, -1 otherwise), ``nums[N]`` (numeric value for Gt/Lt, NaN otherwise),
+    ``cplx[N]`` (defined but not a singleton In — NotIn/multi-value sets fall
+    back to the exact set-algebra per entry). Replaces N x G python
+    ``Requirements.compatible`` calls with a handful of numpy ops per group.
+    """
+
+    def __init__(self, surfaces: Sequence[Requirements]):
+        self.n = len(surfaces)
+        self.surfaces = list(surfaces)
+        self.keys: Dict[str, tuple] = {}
+        per_key: Dict[str, list] = {}
+        for i, reqs in enumerate(surfaces):
+            for r in reqs:
+                per_key.setdefault(r.key, []).append((i, r))
+        for key, entries in per_key.items():
+            has = np.zeros(self.n, bool)
+            codes = np.full(self.n, -1, np.int64)
+            nums = np.full(self.n, np.nan)
+            cplx = np.zeros(self.n, bool)
+            for i, r in entries:
+                has[i] = True
+                v = r.single_value()
+                if v is None:
+                    cplx[i] = True
+                else:
+                    codes[i] = _code(v)
+                    try:
+                        nums[i] = float(int(v))
+                    except ValueError:
+                        pass
+            self.keys[key] = (has, codes, nums, cplx)
+
+    def eval_requirement(self, r: Requirement) -> np.ndarray:
+        """ok[N]: can an entry's surface co-exist with requirement ``r``?"""
+        entry = self.keys.get(r.key)
+        if entry is None:
+            return np.full(self.n, r.tolerates_absence())
+        has, codes, nums, cplx = entry
+        out = np.full(self.n, r.tolerates_absence())
+        value_codes = np.array(
+            [_VOCAB[v] for v in r.values if v in _VOCAB], dtype=np.int64
+        )
+        base = np.isin(codes, value_codes)
+        if r.complement:
+            base = ~base
+            if r.greater_than != float("-inf") or r.less_than != float("inf"):
+                with np.errstate(invalid="ignore"):
+                    base &= (nums > r.greater_than) & (nums < r.less_than)
+        sel = has & ~cplx
+        out[sel] = base[sel]
+        if cplx.any():
+            for i in np.flatnonzero(cplx):
+                ours = self.surfaces[i].get(r.key)
+                out[i] = not ours.intersect(r).is_empty()
+        return out
+
+    def eval_terms(self, terms: Sequence[Requirements]) -> np.ndarray:
+        """ok[N]: OR over terms of AND over each term's requirements."""
+        if not terms:
+            return np.ones(self.n, bool)
+        out = np.zeros(self.n, bool)
+        for term in terms:
+            ok = np.ones(self.n, bool)
+            for r in term:
+                ok &= self.eval_requirement(r)
+                if not ok.any():
+                    break
+            out |= ok
+            if out.all():
+                break
+        return out
 
 
 # ---------------------------------------------------------------------------
@@ -325,35 +470,50 @@ def encode(
         price[j] = o.price
         opt_zone[j] = zone_index[o.zone]
 
+    # -- compat masks, vectorized over the option/node axis ------------------
+    # taints come from the provisioner, so distinct taint tuples are few: one
+    # tolerates_all() call per (group, taint-set) instead of per (group, option)
+    opt_table = _get_option_table(options)
+    taint_groups: Dict[tuple, np.ndarray] = {}
+    for j, o in enumerate(options):
+        taint_groups.setdefault(o.taints, []).append(j)
+    taint_groups = {t: np.asarray(idx) for t, idx in taint_groups.items()}
+
     compat = np.zeros((G, O), dtype=bool)
     for i, g in enumerate(groups):
+        if O == 0:
+            break
+        tol_ok = np.zeros(O, bool)
+        tols = list(g.tolerations)
+        for taints, idx in taint_groups.items():
+            if tolerates_all(tols, taints):
+                tol_ok[idx] = True
+        req_ok = opt_table.eval_terms(g.terms)
         per_pod = _vector(g.requests, axes, pods=1.0)
-        for j, o in enumerate(options):
-            if not tolerates_all(list(g.tolerations), o.taints):
-                continue
-            if not any(o.node_requirements.compatible(term) for term in g.terms):
-                continue
-            if np.any(per_pod > alloc[j] + 1e-9):
-                continue
-            compat[i, j] = True
+        cap_ok = ~np.any(per_pod[None, :] > alloc + 1e-9, axis=1)
+        compat[i] = tol_ok & req_ok & cap_ok
 
     ex_rem = np.zeros((E, R), dtype=np.float64)
     ex_zone = np.zeros((E,), dtype=np.int32)
     ex_compat = np.zeros((G, E), dtype=bool)
-    for k, e in enumerate(existing):
-        ex_rem[k] = _vector(e.remaining, axes)
-        ex_zone[k] = zone_index.get(e.node.zone(), 0)
-        node_reqs = Requirements.from_labels(e.node.labels)
+    if E:
+        for k, e in enumerate(existing):
+            ex_rem[k] = _vector(e.remaining, axes)
+            ex_zone[k] = zone_index.get(e.node.zone(), 0)
+        ex_table = _ReqTable([Requirements.from_labels(e.node.labels) for e in existing])
+        schedulable = np.array([not e.node.unschedulable for e in existing])
+        ex_taint_groups: Dict[tuple, list] = {}
+        for k, e in enumerate(existing):
+            ex_taint_groups.setdefault(tuple(e.node.taints), []).append(k)
         for i, g in enumerate(groups):
-            if e.node.unschedulable:
-                continue
-            if not tolerates_all(list(g.tolerations), e.node.taints):
-                continue
-            if not any(node_reqs.compatible(term) for term in g.terms):
-                continue
-            if np.any(demand[i] > ex_rem[k] + 1e-9):
-                continue
-            ex_compat[i, k] = True
+            tol_ok = np.zeros(E, bool)
+            tols = list(g.tolerations)
+            for taints, idx in ex_taint_groups.items():
+                if tolerates_all(tols, taints):
+                    tol_ok[np.asarray(idx)] = True
+            req_ok = ex_table.eval_terms(g.terms)
+            cap_ok = ~np.any(demand[i][None, :] > ex_rem + 1e-9, axis=1)
+            ex_compat[i] = schedulable & tol_ok & req_ok & cap_ok
 
     return EncodedProblem(
         groups=groups,

@@ -117,23 +117,13 @@ class TestConsolidation:
         cluster, provider, ctl, deprov, clock = make_env(
             make_provisioner(consolidation_enabled=True), validation_ttl=validation_ttl
         )
-        # Force two separate nodes by two sequential waves
-        for p in make_pods(6, "a", cpu="500m", memory="1Gi"):
-            cluster.add_pod(p)
-        ctl.reconcile()
-        for p in make_pods(2, "b", cpu="250m", memory="512Mi"):
-            cluster.add_pod(p)
-        ctl.reconcile()
-        # delete most of wave a so capacity frees up
-        for i in range(1, 6):
-            cluster.delete_pod(f"a-{i}")
+        _sparse_two_nodes(cluster, provider)
         return cluster, provider, ctl, deprov, clock
 
     def test_consolidation_takes_an_action(self):
         cluster, provider, ctl, deprov, clock = self._setup_sparse_cluster()
         n_before = len(cluster.nodes)
-        if n_before < 2:
-            pytest.skip("solver packed both waves onto one node")
+        assert n_before == 2
         action = deprov.reconcile()
         assert action is not None
         assert action.reason.startswith("consolidation")
@@ -166,8 +156,7 @@ class TestConsolidation:
 
     def test_validation_window_aborts_on_new_pods(self):
         cluster, provider, ctl, deprov, clock = self._setup_sparse_cluster(validation_ttl=15.0)
-        if len(cluster.nodes) < 2:
-            pytest.skip("solver packed both waves onto one node")
+        assert len(cluster.nodes) == 2
         assert deprov.reconcile() is None  # planned, inside window
         assert deprov.pending_action is not None
         # cluster changes during the window: new pending pods invalidate
@@ -179,8 +168,7 @@ class TestConsolidation:
 
     def test_validation_window_executes_when_stable(self):
         cluster, provider, ctl, deprov, clock = self._setup_sparse_cluster(validation_ttl=15.0)
-        if len(cluster.nodes) < 2:
-            pytest.skip("solver packed both waves onto one node")
+        assert len(cluster.nodes) == 2
         n_before = len(cluster.nodes)
         assert deprov.reconcile() is None  # planned
         clock.step(16)
@@ -218,6 +206,39 @@ class TestDriftReplacement:
         assert not cluster.pending_pods()
 
 
+def _sparse_two_nodes(cluster, provider, n_pods_a=1, n_pods_b=2):
+    """Deterministic sparse fixture: two mid-size nodes built directly through
+    the provider (provisioning now packs too tightly to leave reliable slack),
+    each holding a few small pods — consolidatable onto one."""
+    from karpenter_tpu.api import Machine, Requirement, Requirements
+    from karpenter_tpu.controllers.provisioning import register_node
+    from helpers import make_pod
+
+    prov = next(iter(cluster.provisioners.values()))
+    mids = [it for it in provider.catalog if 3 <= it.capacity["cpu"] <= 6]
+    it = mids[0]
+    nodes = []
+    for i, n_pods in enumerate((n_pods_a, n_pods_b)):
+        machine = Machine(
+            meta=ObjectMeta(name=f"sparse-{i}", labels=dict(prov.labels)),
+            provisioner_name=prov.name,
+            requirements=Requirements([
+                Requirement.in_values(wk.INSTANCE_TYPE, [it.name]),
+                Requirement.in_values(wk.ZONE, ["zone-a"]),
+                Requirement.in_values(wk.CAPACITY_TYPE, [wk.CAPACITY_TYPE_ON_DEMAND]),
+            ]),
+            requests=Resources(cpu="500m"),
+        )
+        machine = provider.create(machine)
+        cluster.add_machine(machine)
+        node = register_node(cluster, machine, prov)
+        for j in range(n_pods):
+            pod = cluster.add_pod(make_pod(name=f"sp-{i}-{j}", cpu="250m", memory="256Mi"))
+            cluster.bind_pod(pod.name, node.name)
+        nodes.append(node)
+    return nodes
+
+
 class TestStabilizationWindow:
     def test_consolidation_waits_for_stability(self):
         cluster, provider, ctl, deprov, clock = make_env(
@@ -227,16 +248,7 @@ class TestStabilizationWindow:
             batch_idle_duration=0, batch_max_duration=0,
             consolidation_validation_ttl=0, stabilization_window=300.0,
         )
-        for p in make_pods(6, "a", cpu="500m", memory="1Gi"):
-            cluster.add_pod(p)
-        ctl.reconcile()
-        for p in make_pods(2, "b", cpu="250m", memory="512Mi"):
-            cluster.add_pod(p)
-        ctl.reconcile()
-        for i in range(1, 6):
-            cluster.delete_pod(f"a-{i}")
-        if len(cluster.nodes) < 2:
-            pytest.skip("solver packed both waves onto one node")
+        _sparse_two_nodes(cluster, provider)
         # nodes were just added: inside the stabilization window -> no action
         assert deprov.reconcile() is None
         clock.step(301)
@@ -251,16 +263,7 @@ class TestMultiNodeFidelity:
         cluster, provider, ctl, deprov, clock = make_env(
             make_provisioner(consolidation_enabled=True)
         )
-        for p in make_pods(6, "a", cpu="500m", memory="1Gi"):
-            cluster.add_pod(p)
-        ctl.reconcile()
-        for p in make_pods(2, "b", cpu="250m", memory="512Mi"):
-            cluster.add_pod(p)
-        ctl.reconcile()
-        for i in range(1, 6):
-            cluster.delete_pod(f"a-{i}")
-        if len(cluster.nodes) < 2:
-            pytest.skip("solver packed both waves onto one node")
+        _sparse_two_nodes(cluster, provider)
         action = deprov._consolidation()
         assert action is not None
         assert action.savings > 0
@@ -271,19 +274,8 @@ class TestMultiNodeFidelity:
         cluster, provider, ctl, deprov, clock = make_env(
             make_provisioner(consolidation_enabled=True)
         )
-        # Build two nodes then hand-mark them spot: empty-ish spot nodes should
-        # still be deletable together.
-        for p in make_pods(4, "a", cpu="500m", memory="1Gi"):
-            cluster.add_pod(p)
-        ctl.reconcile()
-        for p in make_pods(2, "b", cpu="250m", memory="512Mi"):
-            cluster.add_pod(p)
-        ctl.reconcile()
-        for p in list(cluster.pods.values()):
-            cluster.delete_pod(p.name)
-        if len(cluster.nodes) < 2:
-            pytest.skip("solver packed both waves onto one node")
-        for n in cluster.nodes.values():
+        nodes = _sparse_two_nodes(cluster, provider, n_pods_a=0, n_pods_b=0)
+        for n in nodes:
             n.meta.labels[wk.CAPACITY_TYPE] = wk.CAPACITY_TYPE_SPOT
         action = deprov._consolidation()
         assert action is not None

@@ -121,7 +121,9 @@ class TestTPUSolver:
     def test_matches_greedy_on_simple(self, provs):
         pods = make_pods(200, cpu="250m", memory="512Mi")
         problem = encode(pods, provs)
-        tpu = TPUSolver().solve(problem)
+        # generous budget: this test asserts QUALITY (host-vs-kernel race must
+        # engage even on a cold first solve), not latency
+        tpu = TPUSolver(latency_budget_s=10.0).solve(problem)
         greedy = GreedySolver().solve(problem)
         assert_feasible_and_complete(problem, tpu, 200)
         assert tpu.unschedulable == []
@@ -255,3 +257,40 @@ class TestTPUSolver:
         assert result.cost >= lb - 1e-9
         # portfolio FFD should land within 30% of the fractional bound on this easy mix
         assert result.cost <= lb * 1.3
+
+
+class TestMeshSharding:
+    def test_mesh_sharded_matches_single_device(self):
+        """The production kernel shards its portfolio axis over the mesh; the
+        result must be identical to the single-device solve (conftest provides
+        the 8-device virtual CPU mesh)."""
+        import jax
+        import pytest as _pytest
+
+        from karpenter_tpu.api import ObjectMeta, Pod, Resources, TopologySpreadConstraint
+        from karpenter_tpu.api import labels as wk
+
+        if len(jax.devices()) < 2:
+            _pytest.skip("needs a multi-device mesh")
+        pods = [
+            Pod(
+                meta=ObjectMeta(name=f"p-{i}", labels={"app": f"a{i % 2}"}),
+                requests=Resources(cpu=[0.25, 0.5][i % 2], memory="512Mi"),
+                topology_spread=[
+                    TopologySpreadConstraint(
+                        max_skew=1, topology_key=wk.ZONE, label_selector={"app": f"a{i % 2}"}
+                    )
+                ],
+            )
+            for i in range(40)
+        ]
+        problem = encode(pods, setup())
+        multi = TPUSolver(portfolio=8).solve(problem)  # auto-mesh over all devices
+        single = TPUSolver(portfolio=8, auto_mesh=False).solve(problem)
+        assert multi.stats.get("backend") == 1.0
+        assert single.stats.get("backend") == 1.0
+        assert multi.cost == pytest.approx(single.cost, rel=1e-5)
+        assert sorted(len(s.pod_names) for s in multi.new_nodes) == sorted(
+            len(s.pod_names) for s in single.new_nodes
+        )
+        assert_feasible_and_complete(problem, multi, 40)
