@@ -10,17 +10,27 @@ redesign:
 * Each step is fully vectorized over node slots and launch options (MXU/VPU
   friendly, no data-dependent Python control flow — ``lax.scan`` only).
 * A **portfolio** of packing strategies (group orderings × option-scoring
-  exponents) runs under ``vmap``; the cheapest feasible member wins. This is the
-  embarrassingly-parallel search SURVEY §7.3 calls for, and the axis that shards
-  across TPU cores (see ``karpenter_tpu.parallel``).
-* Solving is two-phase: phase 1 evaluates the whole portfolio returning cost only;
-  phase 2 re-runs the single winning member emitting per-slot assignments. This
-  keeps peak memory at O(S) instead of O(K·G·S).
+  exponents × lookahead scoring) runs under ``vmap``; the cheapest feasible
+  member wins. This is the embarrassingly-parallel search SURVEY §7.3 calls
+  for, and the axis that shards across TPU cores (see ``karpenter_tpu.parallel``).
+* Everything that does not depend on the evolving packing state is hoisted out
+  of the scan into a shared precompute: per-(group, option) unit counts, zone
+  quotas, best-rate options, and the **lookahead value table** (below). The scan
+  step itself is a small, fixed set of vectorized ops — sequential op-dispatch
+  latency, not FLOPs, is the cost model for a latency-bound kernel.
+* **Lookahead scoring** (per-member flag): when opening nodes for a group, the
+  option score is ``price - value of the residual capacity to groups later in
+  the order`` (capped at a fraction of price). This recovers the cross-group
+  mixing a per-group greedy strands — e.g. anti-affinity singleton pods get
+  nodes sized so later small pods fill the leftover — which is how the
+  portfolio approaches the LP bound on topology-constrained problems. Because
+  the portfolio argmin compares TRUE final costs, a lookahead member can only
+  ever improve the returned packing.
 
 Topology constraints enter as per-group caps computed by the encoder: ``node_cap``
 (hostname spread / anti-affinity), ``zone_skew`` (zone spread quotas), ``colocate``
-(self pod-affinity). Zone quotas are enforced with per-zone prefix sums (zones are
-a small static axis, unrolled).
+(self pod-affinity). Zone quotas are enforced with per-zone prefix sums, batched
+over the small static zone axis.
 """
 
 from __future__ import annotations
@@ -36,6 +46,12 @@ from jax import lax
 INF = jnp.float32(1e30)
 IBIG = jnp.int32(1 << 30)
 UNPLACED_PENALTY = jnp.float32(1e6)  # per-pod cost penalty for infeasible members
+
+# Lookahead members discount an option's price by at most this fraction of the
+# residual-capacity value (guards against farming residual value that later
+# groups double-claim), and never below this floor fraction of the true price.
+LOOKAHEAD_DISCOUNT = jnp.float32(0.9)
+LOOKAHEAD_FLOOR = jnp.float32(0.25)
 
 
 class PackInputs(NamedTuple):
@@ -56,6 +72,18 @@ class PackInputs(NamedTuple):
     ex_valid: jax.Array  # [E] bool
 
 
+class _Shared(NamedTuple):
+    """Order-independent precompute, shared by every portfolio member."""
+
+    units: jax.Array  # [G, O] i32 pods-per-fresh-node (node_cap/coloc/compat applied)
+    lam: jax.Array  # [G] f32 cheapest per-pod rate of each group
+    quota: jax.Array  # [G, Z] i32 per-zone placement quota (IBIG when unlimited)
+    zone_limited: jax.Array  # [G] bool
+    val_pair: jax.Array  # [G, O, G'] f32 residual value of (g,o) nodes to group g'
+    exok_pad: jax.Array  # [G, E+S] bool existing-slot compat padded to slot axis
+    is_new: jax.Array  # [E+S] bool
+
+
 def _units(rem: jax.Array, d: jax.Array) -> jax.Array:
     """How many whole pods of per-pod demand d fit in each remaining vector."""
     # Epsilon is biased toward PLACING: overcounting by float noise is caught by
@@ -72,258 +100,291 @@ def _greedy_fill(fit: jax.Array, want: jax.Array) -> jax.Array:
     return jnp.clip(want - before, 0, fit)
 
 
-def _apply_zone_quota(
-    fit: jax.Array, zone: jax.Array, quota: jax.Array, n_zones: int, enabled: jax.Array
-) -> jax.Array:
-    """Cap per-zone cumulative placement at ``quota[z]``."""
-    out = fit
-    for z in range(n_zones):  # static unroll; Z is small
-        mask = zone == z
-        zfit = jnp.where(mask, out, 0)
-        before = jnp.cumsum(zfit) - zfit
-        allow = jnp.clip(quota[z] - before, 0, out)
-        out = jnp.where(mask & enabled, jnp.minimum(out, allow), out)
-    return out
-
-
-def _pack_one(
-    inputs: PackInputs,
-    order: jax.Array,  # [G] permutation of group indices
-    alpha: jax.Array,  # scalar: option score exponent
-    s_new: int,
-    n_zones: int,
-    with_assignments: bool,
-):
+def _shared_precompute(inputs: PackInputs, s_new: int, n_zones: int) -> _Shared:
     G, R = inputs.demand.shape
     O = inputs.price.shape[0]
     E = inputs.ex_rem.shape[0]
+    d = inputs.demand  # [G, R]
+    cnt = inputs.count
 
-    new_rem0 = jnp.zeros((s_new, R), jnp.float32)
-    new_opt0 = jnp.full((s_new,), -1, jnp.int32)
-    new_active0 = jnp.zeros((s_new,), bool)
+    # units[g, o]: whole pods per fresh node, capped by per-node topology caps.
+    safe = jnp.where(d[:, None, :] > 0, inputs.alloc[None, :, :] / jnp.maximum(d[:, None, :], 1e-30), INF)
+    units = jnp.clip(jnp.floor(jnp.min(safe, axis=-1) + 1e-4), 0, IBIG).astype(jnp.int32)
+    units = jnp.minimum(units, inputs.node_cap[:, None])
+    ok = inputs.compat & inputs.opt_valid[None, :]
+    units = jnp.where(ok, units, 0)
+    units = jnp.where(
+        inputs.colocate[:, None], jnp.where(units >= cnt[:, None], units, 0), units
+    )
 
-    def step(carry, g):
-        ex_rem, new_rem, new_opt, new_active, unplaced, exhausted = carry
+    units_f = units.astype(jnp.float32)
+    rate = jnp.where(units > 0, inputs.price[None, :] / jnp.maximum(units_f, 1.0), INF)
+    lam_raw = jnp.min(rate, axis=1)
+    lam = jnp.where(lam_raw < INF, lam_raw, 0.0)  # [G]
+
+    # Zone availability → equal-split quotas for spread groups.
+    zidx = jnp.arange(n_zones, dtype=jnp.int32)
+    zoneh_opt = inputs.opt_zone[None, :] == zidx[:, None]  # [Z, O]
+    avail_opt = jnp.any(ok[:, None, :] & zoneh_opt[None, :, :], axis=-1)  # [G, Z]
+    ex_ok = inputs.ex_compat & inputs.ex_valid[None, :]  # [G, E]
+    zoneh_ex = inputs.ex_zone[None, :] == zidx[:, None]  # [Z, E]
+    avail_ex = jnp.any(ex_ok[:, None, :] & zoneh_ex[None, :, :], axis=-1)  # [G, Z]
+    zones_avail = avail_opt | avail_ex
+    n_avail = jnp.maximum(jnp.sum(zones_avail.astype(jnp.int32), axis=1), 1)  # [G]
+    rank = jnp.cumsum(zones_avail.astype(jnp.int32), axis=1) - 1
+    # Exact equal split: first (cnt % n) available zones take ceil(cnt/n).
+    eq = cnt[:, None] // n_avail[:, None] + (rank < (cnt % n_avail)[:, None]).astype(jnp.int32)
+    eq = jnp.where(zones_avail, eq, 0)
+    spread = inputs.zone_skew > 0
+    quota = jnp.where(spread[:, None], eq, IBIG)
+    quota = jnp.minimum(quota, inputs.zone_cap[:, None])  # [G, Z]
+    zone_limited = spread | (inputs.zone_cap < IBIG)
+
+    # Lookahead value table: val_pair[g, o, g'] = value of one (g,o) node's
+    # residual capacity to group g' — pods of g' it can absorb × g''s cheapest
+    # per-pod rate. R is looped (static, small) to keep peak memory at [G,O,G'].
+    resid = inputs.alloc[None, :, :] - units_f[:, :, None] * d[:, None, :]  # [G, O, R]
+    u2 = None
+    for r in range(R):
+        dr = d[:, r]  # [G'] per-pod demand on axis r
+        ur = jnp.where(
+            dr[None, None, :] > 0,
+            jnp.floor(resid[:, :, r : r + 1] / jnp.maximum(dr[None, None, :], 1e-30) + 1e-4),
+            INF,
+        )
+        u2 = ur if u2 is None else jnp.minimum(u2, ur)
+    u2 = jnp.clip(u2, 0, IBIG)  # [G, O, G']
+    u2 = jnp.minimum(u2, inputs.node_cap[None, None, :].astype(jnp.float32))
+    ok2 = ok.T[None, :, :]  # [1, O, G'] — g' must be compatible with option o
+    val_pair = jnp.where(ok2 & (u2 > 0), u2 * lam[None, None, :], 0.0)
+
+    exok_pad = jnp.concatenate(
+        [ex_ok, jnp.zeros((G, s_new), bool)], axis=1
+    )  # [G, E+S]
+    is_new = jnp.arange(E + s_new) >= E
+    return _Shared(
+        units=units,
+        lam=lam,
+        quota=quota,
+        zone_limited=zone_limited,
+        val_pair=val_pair,
+        exok_pad=exok_pad,
+        is_new=is_new,
+    )
+
+
+def _argmin_tiebreak(score: jax.Array, units_f: jax.Array, alpha: jax.Array):
+    """Row-wise argmin over the option axis with the portfolio tiebreak: within
+    0.01% of the best score, alpha >= 1 members prefer the LARGER node (leaves
+    room for later groups), alpha < 1 the smaller one (less stranded capacity)."""
+    best = jnp.min(score, axis=-1, keepdims=True)
+    cand = score <= best * jnp.float32(1.0001)
+    pref = jnp.where(alpha >= 1.0, units_f, -units_f)
+    idx = jnp.argmax(jnp.where(cand, pref[None, :], -INF), axis=-1)
+    return idx, best[..., 0]
+
+
+def _pack_member(
+    inputs: PackInputs,
+    shared: _Shared,
+    order: jax.Array,  # [T] permutation of group indices
+    alpha: jax.Array,  # scalar: tiebreak preference
+    look: jax.Array,  # scalar bool: lookahead scoring on
+    s_new: int,
+    n_zones: int,
+):
+    """One portfolio member: grouped FFD over ``order`` with bucketed node opening.
+
+    Returns (cost, unplaced, exhausted, new_opt, new_active, ys[T, E+S]).
+    """
+    G, R = inputs.demand.shape
+    O = inputs.price.shape[0]
+    E = inputs.ex_rem.shape[0]
+    NS = E + s_new
+    T = order.shape[0]
+    Zb = n_zones + 1  # zone buckets + one unrestricted bucket
+
+    # Per-position effective prices: price - discounted residual value to LATER
+    # groups in this member's order (lookahead members only).
+    pos = jnp.zeros((G,), jnp.int32).at[order].set(jnp.arange(T, dtype=jnp.int32))
+    later = pos[None, :] > jnp.arange(T, dtype=jnp.int32)[:, None]  # [T, G']
+    vp = shared.val_pair[order]  # [T, O, G']
+    val_t = jnp.max(jnp.where(later[:, None, :], vp, 0.0), axis=-1)  # [T, O]
+    price_eff = jnp.maximum(
+        inputs.price[None, :] - LOOKAHEAD_DISCOUNT * val_t,
+        LOOKAHEAD_FLOOR * inputs.price[None, :],
+    )
+    price_t = jnp.where(look, price_eff, inputs.price[None, :])  # [T, O]
+
+    # Static bucket structure: bucket z < Z restricts to zone z; bucket Z is
+    # unrestricted (used by non-zone-limited groups).
+    zidx = jnp.arange(n_zones, dtype=jnp.int32)
+    opt_bucket_ok = jnp.concatenate(
+        [inputs.opt_zone[None, :] == zidx[:, None], jnp.ones((1, O), bool)], axis=0
+    )  # [Zb, O]
+
+    slot_rem0 = jnp.concatenate(
+        [inputs.ex_rem, jnp.zeros((s_new, R), jnp.float32)], axis=0
+    )
+    slot_opt0 = jnp.full((NS,), -1, jnp.int32)
+    slot_zone0 = jnp.concatenate(
+        [inputs.ex_zone, jnp.zeros((s_new,), jnp.int32)], axis=0
+    )
+    slot_active0 = jnp.concatenate(
+        [inputs.ex_valid, jnp.zeros((s_new,), bool)], axis=0
+    )
+
+    def step(carry, t):
+        slot_rem, slot_opt, slot_zone, slot_active, unplaced, exhausted = carry
+        g = order[t]
         d = inputs.demand[g]
         cnt = inputs.count[g]
         cap = inputs.node_cap[g]
-        zcap = inputs.zone_cap[g]
-        skew = inputs.zone_skew[g]
         coloc = inputs.colocate[g]
-        spread = skew > 0
-        zone_limited = spread | (zcap < IBIG)
+        zl = shared.zone_limited[g]
+        q = shared.quota[g]  # [Z]
+        u = shared.units[g]  # [O]
+        pe = price_t[t]  # [O] effective price for scoring only
 
-        # Zones that could host this group at all (for the quota denominator).
-        zones_avail = jnp.zeros((n_zones,), bool)
-        opt_ok_any = inputs.opt_valid & inputs.compat[g]
-        for z in range(n_zones):
-            has_opt = jnp.any(opt_ok_any & (inputs.opt_zone == z))
-            has_ex = jnp.any(inputs.ex_valid & inputs.ex_compat[g] & (inputs.ex_zone == z))
-            zones_avail = zones_avail.at[z].set(has_opt | has_ex)
-        n_avail = jnp.maximum(jnp.sum(zones_avail.astype(jnp.int32)), 1)
-        # Exact equal split across available zones: the first (cnt % n) zones take
-        # ceil(cnt/n), the rest floor(cnt/n) — |max-min| <= 1 <= any maxSkew.
-        rank = jnp.cumsum(zones_avail.astype(jnp.int32)) - 1  # [Z]
-        equal_quota = cnt // n_avail + (rank < (cnt % n_avail)).astype(jnp.int32)
-        equal_quota = jnp.where(zones_avail, equal_quota, 0)
-        quota = jnp.where(spread, equal_quota, IBIG)
-        quota = jnp.minimum(quota, zcap)  # zone anti-affinity cap
-
-        # ---- capacity of already-open slots (existing first, then new) ----
-        fit_e = _units(ex_rem, d)
-        ok_e = inputs.ex_valid & inputs.ex_compat[g]
-        fit_e = jnp.where(ok_e, jnp.minimum(fit_e, cap), 0)
-
-        opt_idx = jnp.clip(new_opt, 0, O - 1)
-        ok_n = new_active & inputs.compat[g, opt_idx] & (new_opt >= 0)
-        fit_n = jnp.where(ok_n, jnp.minimum(_units(new_rem, d), cap), 0)
-
-        all_fit = jnp.concatenate([fit_e, fit_n])
-        new_zone = inputs.opt_zone[opt_idx]
-        all_zone = jnp.concatenate([inputs.ex_zone, new_zone])
-        all_fit = _apply_zone_quota(all_fit, all_zone, quota, n_zones, zone_limited)
-        # Colocation: the whole group must land on one node.
-        all_fit = jnp.where(coloc, jnp.where(all_fit >= cnt, cnt, 0), all_fit)
-
-        place = _greedy_fill(all_fit, cnt)
+        # ---- fill open capacity (existing nodes first, then opened slots) ----
+        opt_c = jnp.clip(slot_opt, 0, O - 1)
+        comp = jnp.where(
+            shared.is_new,
+            inputs.compat[g, opt_c] & (slot_opt >= 0) & slot_active,
+            shared.exok_pad[g],
+        )
+        fit = jnp.where(comp, jnp.minimum(_units(slot_rem, d), cap), 0)
+        # zone quotas, batched over the zone axis
+        zmask = slot_zone[None, :] == zidx[:, None]  # [Z, NS]
+        zfit = jnp.where(zmask, fit[None, :], 0)
+        before_z = jnp.cumsum(zfit, axis=1) - zfit
+        allow = jnp.clip(q[:, None] - before_z, 0, None)
+        fit_q = jnp.sum(jnp.where(zmask, jnp.minimum(fit[None, :], allow), 0), axis=0)
+        fit = jnp.where(zl, fit_q, fit)
+        fit = jnp.where(coloc, jnp.where(fit >= cnt, cnt, 0), fit)
+        place = _greedy_fill(fit, cnt)
         left = cnt - jnp.sum(place)
-        place_e, place_n = place[:E], place[E:]
-        ex_rem = ex_rem - place_e[:, None].astype(jnp.float32) * d
-        new_rem = new_rem - place_n[:, None].astype(jnp.float32) * d
-        placed_z = jnp.zeros((n_zones,), jnp.int32)
-        for z in range(n_zones):
-            placed_z = placed_z.at[z].set(jnp.sum(jnp.where(all_zone == z, place, 0)))
+        slot_rem = slot_rem - place[:, None].astype(jnp.float32) * d
+        placed_z = jnp.sum(jnp.where(zmask, place[None, :], 0), axis=1)  # [Z]
 
-        # ---- open fresh nodes ------------------------------------------
-        units_o = _units(inputs.alloc, d)
-        units_o = jnp.minimum(units_o, cap)
-        units_o = jnp.where(opt_ok_any, units_o, 0)
-        units_o = jnp.where(coloc, jnp.where(units_o >= cnt, units_o, 0), units_o)
-        usable = units_o > 0
+        # ---- bucket wants -------------------------------------------------
+        want_z = jnp.clip(q - placed_z, 0, None)
+        before_w = jnp.cumsum(want_z) - want_z
+        want_z = jnp.clip(jnp.minimum(want_z, left - before_w), 0, None)
+        want = jnp.where(
+            zl,
+            jnp.concatenate([want_z, jnp.zeros((1,), jnp.int32)]),
+            jnp.concatenate([jnp.zeros((n_zones,), jnp.int32), left[None]]),
+        )  # [Zb]
 
-        new_place_acc = jnp.zeros((s_new,), jnp.int32)
+        # ---- per-bucket option choice: lump vs mixed ----------------------
+        safe_u = jnp.maximum(u, 1)
+        units_f = u.astype(jnp.float32)
+        okb = opt_bucket_ok & (u > 0)[None, :]  # [Zb, O]
+        wb = want[:, None]
+        k_all = -(-wb // safe_u[None, :])  # ceil
+        lump_score = jnp.where(okb & (wb > 0), k_all.astype(jnp.float32) * pe[None, :], INF)
+        o_lump, cost_lump = _argmin_tiebreak(lump_score, units_f, alpha)
+        rate = jnp.where(okb, pe[None, :] / jnp.maximum(units_f, 1.0)[None, :], INF)
+        o_rate, best_rate = _argmin_tiebreak(rate, units_f, alpha)
+        c_rate = u[o_rate]  # [Zb]
+        n_full = want // jnp.maximum(c_rate, 1)
+        rem = want - n_full * c_rate
+        rem_k = -(-rem[:, None] // safe_u[None, :])
+        rem_score = jnp.where(
+            okb & (rem[:, None] > 0), rem_k.astype(jnp.float32) * pe[None, :], INF
+        )
+        o_tail, tail_best = _argmin_tiebreak(rem_score, units_f, alpha)
+        tail_cost = jnp.where(rem > 0, tail_best, 0.0)
+        cost_mixed = jnp.where(
+            best_rate < INF, n_full.astype(jnp.float32) * pe[o_rate] + tail_cost, INF
+        )
+        lump = cost_lump <= cost_mixed
+        feasible = (want > 0) & (jnp.minimum(cost_lump, cost_mixed) < INF)
 
-        def open_pass(state, zone_restrict, enabled, full_only):
-            """Open nodes for the group's remainder. Option choice minimizes the
-            TRUE marginal cost (ceil(want/units) x price) — not price per
-            theoretical slot, which over-opens big nodes for small groups.
-            ``full_only`` opens just the completely-filled nodes of the winner so
-            a follow-up pass can right-size the remainder onto a cheaper/smaller
-            option (the mixed sizing a pod-at-a-time greedy gets for free)."""
-            new_rem, new_opt, new_active, left, placed_z, new_place_acc = state
-            if zone_restrict is None:
-                zone_ok = jnp.ones_like(usable)
-                want_cap = IBIG
-            else:
-                zone_ok = inputs.opt_zone == zone_restrict
-                want_cap = jnp.maximum(quota[zone_restrict] - placed_z[zone_restrict], 0)
-            want = jnp.minimum(left, want_cap)
-            safe_c = jnp.maximum(units_o, 1)
-            units_f = units_o.astype(jnp.float32)
-            ok = usable & zone_ok & (want > 0)
+        # ---- segments: (full/lump) + tail per bucket ----------------------
+        segA_opt = jnp.where(lump, o_lump, o_rate)
+        segA_c = jnp.maximum(u[segA_opt], 1)
+        segA_want = jnp.where(feasible, jnp.where(lump, want, n_full * c_rate), 0)
+        segA_n = -(-segA_want // segA_c)
+        segB_opt = o_tail
+        segB_c = jnp.maximum(u[o_tail], 1)
+        segB_want = jnp.where(feasible & ~lump, rem, 0)
+        segB_n = -(-segB_want // segB_c)
+        seg_opt = jnp.concatenate([segA_opt, segB_opt])  # [2Zb]
+        seg_c = jnp.concatenate([segA_c, segB_c])
+        seg_want = jnp.concatenate([segA_want, segB_want])
+        seg_n = jnp.concatenate([segA_n, segB_n])
+        seg_start = jnp.cumsum(seg_n) - seg_n
+        total_open = jnp.sum(seg_n)
 
-            def _argmin_tiebreak(score):
-                # Tie-break within 0.01%: members with alpha >= 1 prefer the
-                # LARGER node (leaves room for later groups), alpha < 1 the
-                # smaller one (less stranded capacity) — the portfolio covers
-                # both endgames.
-                best = jnp.min(score)
-                cand = score <= best * jnp.float32(1.0001)
-                pref = jnp.where(alpha >= 1.0, units_f, -units_f)
-                return jnp.argmax(jnp.where(cand, pref, -INF)), best
-
-            # Lump strategy: one option serves everything, ceil(want/c) nodes.
-            k_all = -(-jnp.maximum(want, 0) // safe_c)
-            lump_score = jnp.where(ok, k_all.astype(jnp.float32) * inputs.price, INF)
-            o_lump, cost_lump = _argmin_tiebreak(lump_score)
-            if full_only:
-                # Mixed strategy: completely-filled nodes of the best-RATE option
-                # (zero waste), remainder right-sized by a later ceil pass.
-                rate = jnp.where(
-                    ok & (units_o <= want), inputs.price / jnp.maximum(units_f, 1.0), INF
-                )
-                o_rate, best_rate = _argmin_tiebreak(rate)
-                c_rate = units_o[o_rate]
-                n_full = want // jnp.maximum(c_rate, 1)
-                rem = want - n_full * c_rate
-                rem_k = -(-jnp.maximum(rem, 0) // safe_c)
-                rem_score = jnp.where(ok, rem_k.astype(jnp.float32) * inputs.price, INF)
-                rem_cost = jnp.where(rem > 0, jnp.min(rem_score), 0.0)
-                cost_mixed = jnp.where(
-                    best_rate < INF,
-                    n_full.astype(jnp.float32) * inputs.price[o_rate] + rem_cost,
-                    INF,
-                )
-                lump = cost_lump <= cost_mixed
-                o = jnp.where(lump, o_lump, o_rate)
-                best_score = jnp.minimum(cost_lump, cost_mixed)
-            else:
-                lump = jnp.bool_(True)
-                o = o_lump
-                best_score = cost_lump
-            c = units_o[o]
-            feasible = enabled & (best_score < INF) & (left > 0)
-            want = jnp.where(feasible, want, 0)
-            if full_only:
-                # mixed: stop at the whole nodes; lump: serve everything now
-                want = jnp.where(lump, want, (want // jnp.maximum(c, 1)) * c)
-            k = jnp.where(c > 0, -(-want // jnp.maximum(c, 1)), 0)  # ceil
-            free_rank = jnp.cumsum((~new_active).astype(jnp.int32)) * (~new_active)
-            take = (~new_active) & (free_rank >= 1) & (free_rank <= k)
-            idx = jnp.maximum(free_rank - 1, 0)
-            per_slot = jnp.clip(want - idx * c, 0, c) * take
-            new_rem = jnp.where(
-                take[:, None], inputs.alloc[o] - per_slot[:, None].astype(jnp.float32) * d, new_rem
-            )
-            new_opt = jnp.where(take, o, new_opt)
-            new_active = new_active | take
-            opened_total = jnp.sum(per_slot)
-            left = left - opened_total
-            if zone_restrict is not None:
-                placed_z = placed_z.at[zone_restrict].add(opened_total)
-            new_place_acc = new_place_acc + per_slot
-            return (new_rem, new_opt, new_active, left, placed_z, new_place_acc)
-
-        state = (new_rem, new_opt, new_active, left, placed_z, new_place_acc)
-        for z in range(n_zones):  # zone-limited groups: fill zones under quota
-            state = open_pass(state, z, zone_limited, full_only=True)
-            state = open_pass(state, z, zone_limited, full_only=False)
-        # others: full nodes of the cost-winner, then a right-sized remainder
-        state = open_pass(state, None, ~zone_limited, full_only=True)
-        state = open_pass(state, None, ~zone_limited, full_only=False)
-        new_rem, new_opt, new_active, left, placed_z, new_place_acc = state
-
+        # ---- allocate free slots to segments ------------------------------
+        free = shared.is_new & ~slot_active
+        fr = jnp.cumsum(free.astype(jnp.int32))  # 1-based rank among free slots
+        take = free & (fr <= total_open)
+        r0 = fr - 1
+        sid = jnp.sum(r0[:, None] >= seg_start[None, :], axis=1) - 1
+        sid = jnp.clip(sid, 0, 2 * Zb - 1)
+        o_i = seg_opt[sid]
+        c_i = seg_c[sid]
+        pos_i = r0 - seg_start[sid]
+        fill = jnp.where(take, jnp.clip(seg_want[sid] - pos_i * c_i, 0, c_i), 0)
+        opened = jnp.sum(fill)
+        slot_rem = jnp.where(
+            take[:, None], inputs.alloc[o_i] - fill[:, None].astype(jnp.float32) * d, slot_rem
+        )
+        slot_opt = jnp.where(take, o_i, slot_opt)
+        slot_zone = jnp.where(take, inputs.opt_zone[o_i], slot_zone)
+        slot_active = slot_active | take
+        left = left - opened
         unplaced = unplaced + left
-        # Leftover with every slot in use = slot exhaustion (host grows S and
-        # retries); leftover with free slots = genuine infeasibility.
-        exhausted = exhausted | ((left > 0) & jnp.all(new_active))
-        carry = (ex_rem, new_rem, new_opt, new_active, unplaced, exhausted)
-        if with_assignments:
-            ys = jnp.concatenate([place_e, place_n + new_place_acc])
-        else:
-            ys = left
-        return carry, ys
+        exhausted = exhausted | ((left > 0) & (total_open > jnp.sum(free.astype(jnp.int32))))
+        ys = place + fill
+        return (slot_rem, slot_opt, slot_zone, slot_active, unplaced, exhausted), ys
 
-    carry0 = (inputs.ex_rem, new_rem0, new_opt0, new_active0, jnp.int32(0), jnp.bool_(False))
-    carry, ys = lax.scan(step, carry0, order)
-    ex_rem, new_rem, new_opt, new_active, unplaced, exhausted = carry
+    carry0 = (slot_rem0, slot_opt0, slot_zone0, slot_active0, jnp.int32(0), jnp.bool_(False))
+    carry, ys = lax.scan(step, carry0, jnp.arange(T, dtype=jnp.int32))
+    slot_rem, slot_opt, slot_zone, slot_active, unplaced, exhausted = carry
+    new_opt = slot_opt[E:]
+    new_active = slot_active[E:] & (new_opt >= 0)
     node_prices = jnp.where(new_active, inputs.price[jnp.clip(new_opt, 0, O - 1)], 0.0)
     cost = jnp.sum(node_prices) + unplaced.astype(jnp.float32) * UNPLACED_PENALTY
-    if with_assignments:
-        return cost, unplaced, new_opt, new_active, ys  # ys: [G, E+S] in scan order
-    return cost, unplaced, exhausted
-
-
-@functools.partial(jax.jit, static_argnames=("s_new", "n_zones"))
-def pack_portfolio_cost(
-    inputs: PackInputs, orders: jax.Array, alphas: jax.Array, s_new: int, n_zones: int
-):
-    """Phase 1: run every member, return (costs[K], unplaced[K], exhausted[K])."""
-    fn = functools.partial(
-        _pack_one, s_new=s_new, n_zones=n_zones, with_assignments=False
-    )
-    return jax.vmap(lambda o, a: fn(inputs, o, a))(orders, alphas)
-
-
-@functools.partial(jax.jit, static_argnames=("s_new", "n_zones"))
-def pack_single_assign(
-    inputs: PackInputs, order: jax.Array, alpha: jax.Array, s_new: int, n_zones: int
-):
-    """Phase 2: re-run the winning member emitting assignments."""
-    return _pack_one(inputs, order, alpha, s_new, n_zones, with_assignments=True)
+    return cost, unplaced, exhausted, new_opt, new_active, ys
 
 
 @functools.partial(jax.jit, static_argnames=("s_new", "n_zones"))
 def pack_solve_fused(
-    inputs: PackInputs, orders: jax.Array, alphas: jax.Array, s_new: int, n_zones: int
+    inputs: PackInputs,
+    orders: jax.Array,
+    alphas: jax.Array,
+    looks: jax.Array,
+    s_new: int,
+    n_zones: int,
 ) -> jax.Array:
-    """Full solve in ONE device call: evaluate the portfolio, argmin the winner on
-    device, re-run it with assignments, and pack everything into a single int32
-    buffer so the host pays exactly one transfer round-trip.
+    """Full solve in ONE device call: every member emits assignments, the winner
+    reduces with an on-device argmin, and everything the host needs lands in a
+    single int32 buffer so the host pays exactly one transfer round-trip.
 
-    Layout of the returned [2 + K + K + S + S + G*(E+S)] int32 vector:
+    Layout of the returned [2 + K + K + S + S + T*(E+S)] int32 vector:
       [0] best member index        [1] unplaced count of the winner
       [2:2+K] member costs (f32 bitcast)   [2+K:2+2K] member slot-exhaustion flags
       [.. S] new_opt   [.. S] new_active
-      [..] ys assignment counts, row-major [G, E+S] in the winner's scan order.
-    The winner's order row is gathered on device; the host recovers group identity
-    from its own copy of `orders`.
+      [..] ys assignment counts, row-major [T, E+S] in the winner's scan order.
+    The host recovers group identity from its own copy of `orders`.
     """
-    costs, unplaced, exhausted = jax.vmap(
-        lambda o, a: _pack_one(inputs, o, a, s_new, n_zones, with_assignments=False)
-    )(orders, alphas)
+    shared = _shared_precompute(inputs, s_new, n_zones)
+    costs, unplaced, exhausted, new_opt, new_active, ys = jax.vmap(
+        lambda o, a, l: _pack_member(inputs, shared, o, a, l, s_new, n_zones)
+    )(orders, alphas, looks)
     best = jnp.argmin(costs).astype(jnp.int32)
-    _, left, new_opt, new_active, ys = _pack_one(
-        inputs, orders[best], alphas[best], s_new, n_zones, with_assignments=True
-    )
     return jnp.concatenate(
         [
-            jnp.stack([best, left]),
+            jnp.stack([best, unplaced[best]]),
             _bitcast_f32_i32(costs),
             exhausted.astype(jnp.int32),
-            new_opt,
-            new_active.astype(jnp.int32),
-            ys.reshape(-1),
+            new_opt[best],
+            new_active[best].astype(jnp.int32),
+            ys[best].reshape(-1),
         ]
     )
 
@@ -351,25 +412,28 @@ def unpack_solve_fused(buf: np.ndarray, k: int, s_new: int, g: int, e_pad: int):
 
 def make_orders(
     sizes: np.ndarray, count: np.ndarray, k: int, seed: int = 0
-) -> Tuple[np.ndarray, np.ndarray]:
-    """Portfolio construction: K group orderings × option-score exponents.
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Portfolio construction: K × (group ordering, tiebreak exponent, lookahead).
 
-    Member 0 is plain FFD (size-descending). Other members perturb the ordering
-    with multiplicative noise and sweep the score exponent, covering
-    cheapest-per-unit (alpha=1) through cheapest-absolute (alpha->0) strategies.
+    Member 0 is plain FFD (size-descending), no lookahead — the
+    reference-semantics anchor. Member 1 is FFD with lookahead. Other members
+    perturb the ordering with multiplicative noise, sweep the tiebreak
+    preference, and alternate lookahead scoring.
     """
     g = sizes.shape[0]
     rng = np.random.default_rng(seed)
     orders = np.empty((k, g), dtype=np.int32)
     alphas = np.empty((k,), dtype=np.float32)
-    base_alphas = [1.0, 0.85, 1.0, 0.7, 1.15, 1.0, 0.9, 1.05]
+    looks = np.zeros((k,), dtype=bool)
+    base_alphas = [1.0, 1.0, 0.85, 0.85, 1.15, 0.7, 1.0, 0.9]
     for i in range(k):
-        if i == 0:
+        if i in (0, 1):
             key = -sizes
-        elif i == 1:
+        elif i in (2, 3):
             key = -sizes * count  # total-footprint descending
         else:
             key = -sizes * rng.uniform(0.6, 1.4, size=g)
         orders[i] = np.argsort(key, kind="stable").astype(np.int32)
         alphas[i] = base_alphas[i % len(base_alphas)]
-    return orders, alphas
+        looks[i] = i % 2 == 1
+    return orders, alphas, looks
