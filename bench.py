@@ -290,8 +290,12 @@ def bench_config(name, make, repeats=REPEATS):
     # settle background warm compiles before timing: the p50 measures
     # steady-state solving, not CPU contention with a one-off trace
     from karpenter_tpu.solver.solver import _join_warm_threads
+    from karpenter_tpu.utils.gctuning import freeze_long_lived
 
     _join_warm_threads()
+    # what the operator does at startup: freeze the long-lived heap so gen-2
+    # GC scans of 10^5 pod objects don't land as ~200ms mid-solve pauses
+    freeze_long_lived()
     times = []
     for _ in range(repeats):
         t0 = time.perf_counter()

@@ -45,12 +45,18 @@ class PodBatcher:
         self.max_duration = max_duration
         self._first: Optional[float] = None
         self._last: Optional[float] = None
+        # monotonically increasing arrival counter: reconcile snapshots it
+        # before reading pending pods, and reset(gen) is a no-op if pods
+        # arrived after the snapshot — those were NOT in the solved batch and
+        # must keep their window armed.
+        self.generation = 0
 
     def note_arrival(self, now: Optional[float] = None) -> None:
         now = time.monotonic() if now is None else now
         if self._first is None:
             self._first = now
         self._last = now
+        self.generation += 1
 
     def ready(self, now: Optional[float] = None) -> bool:
         if self._first is None:
@@ -58,7 +64,9 @@ class PodBatcher:
         now = time.monotonic() if now is None else now
         return (now - self._last) >= self.idle or (now - self._first) >= self.max_duration
 
-    def reset(self) -> None:
+    def reset(self, upto_generation: Optional[int] = None) -> None:
+        if upto_generation is not None and self.generation != upto_generation:
+            return  # arrivals landed mid-reconcile; keep the window armed
         self._first = None
         self._last = None
 
@@ -92,15 +100,26 @@ class ProvisioningController:
         cluster.watch(self._on_event)
 
     def _on_event(self, event: str, obj) -> None:
-        if isinstance(obj, Pod) and event == "ADDED" and obj.is_pending() and not obj.is_daemonset:
+        # ADDED covers fresh pods; MODIFIED covers pods that became pending
+        # again (drain evictions unbind them) so the batch window — not a
+        # pending-pods poll — is the single trigger for provisioning
+        # (reference: pod controller -> provisioner.Trigger, SURVEY §3.2).
+        if (
+            isinstance(obj, Pod)
+            and event in ("ADDED", "MODIFIED")
+            and obj.is_pending()
+            and not obj.is_daemonset
+        ):
             self.batcher.note_arrival()
 
     # -- the reconcile loop body -------------------------------------------
     def reconcile(self) -> ProvisioningResult:
         t0 = time.perf_counter()
+        batch_gen = self.batcher.generation
         pods = self.cluster.pending_pods()
         result = ProvisioningResult(machines=[], nodes=[], bound={}, unschedulable=[])
         if not pods:
+            self.batcher.reset(upto_generation=batch_gen)
             return result
 
         provisioners = sorted(
@@ -109,6 +128,7 @@ class ProvisioningController:
         if not provisioners:
             result.unschedulable = [p.name for p in pods]
             metrics.PODS_UNSCHEDULABLE.set(len(result.unschedulable))
+            self.batcher.reset(upto_generation=batch_gen)
             return result
 
         provs = [(p, self.provider.get_instance_types(p)) for p in provisioners]
@@ -179,7 +199,7 @@ class ProvisioningController:
             )
         metrics.PODS_UNSCHEDULABLE.set(float(len(result.unschedulable)))
         metrics.PROVISIONING_DURATION.observe(time.perf_counter() - t0)
-        self.batcher.reset()
+        self.batcher.reset(upto_generation=batch_gen)
         return result
 
     def _launch(self, spec: NewNodeSpec) -> Tuple[Machine, Node]:

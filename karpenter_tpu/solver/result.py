@@ -3,10 +3,59 @@
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Sequence as TSequence
 
 from ..api.objects import Pod
 from .encode import EncodedProblem, LaunchOption
+
+
+class NameSlice(TSequence):
+    """Lazy view over slices of per-group pod-name lists.
+
+    The host decoder assigns contiguous runs of each group's (identical) pods to
+    nodes; copying 50k name strings into per-node lists is pure overhead on the
+    solve's critical path when most results are consolidation candidates that
+    are never bound. This view holds (namelist, start, count) segments and
+    materializes once, on first element access. len() never materializes.
+    """
+
+    __slots__ = ("_segments", "_names")
+
+    def __init__(self, segments):
+        self._segments = segments  # list of (namelist, start, count)
+        self._names: Optional[List[str]] = None
+
+    def _materialize(self) -> List[str]:
+        if self._names is None:
+            out: List[str] = []
+            for namelist, start, count in self._segments:
+                out.extend(namelist[start : start + count])
+            self._names = out
+        return self._names
+
+    def __len__(self) -> int:
+        if self._names is not None:
+            return len(self._names)
+        return sum(c for _, _, c in self._segments)
+
+    def __iter__(self):
+        return iter(self._materialize())
+
+    def __getitem__(self, i):
+        return self._materialize()[i]
+
+    def __contains__(self, item) -> bool:
+        return item in self._materialize()
+
+    def __eq__(self, other) -> bool:
+        if isinstance(other, NameSlice):
+            return self._materialize() == other._materialize()
+        if isinstance(other, list):
+            return self._materialize() == other
+        return NotImplemented
+
+    def __repr__(self) -> str:
+        return f"NameSlice({self._materialize()!r})"
 
 
 @dataclass
@@ -14,7 +63,7 @@ class NewNodeSpec:
     """A node the solver decided to launch, with its pod placement."""
 
     option: LaunchOption
-    pod_names: List[str] = field(default_factory=list)
+    pod_names: TSequence = field(default_factory=list)
     option_index: Optional[int] = None  # index into EncodedProblem.options, if known
 
     @property

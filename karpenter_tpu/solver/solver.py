@@ -32,8 +32,8 @@ from .jax_solver import (
     pack_solve_fused,
     unpack_solve_fused,
 )
-from .result import NewNodeSpec, SolveResult
-from .validate import validate
+from .result import NameSlice, NewNodeSpec, SolveResult
+from .validate import validate, validate_counts
 
 
 def _next_pow2(n: int, floor: int = 8) -> int:
@@ -185,19 +185,23 @@ class TPUSolver(Solver):
 
     @classmethod
     def device_rtt(cls) -> float:
-        """Measured round-trip of a minimal device call (compile excluded,
-        median of 3 — a tunneled chip occasionally returns one fast RTT)."""
+        """Measured dispatch->host-result round-trip of a minimal device call
+        (compile excluded, median of 3). The probe fetches the result to host:
+        on remote-tunneled platforms ``block_until_ready`` can return before
+        the value is actually materializable, so only a real device->host read
+        measures what a solve pays."""
         if cls._device_rtt_s is None:
             import jax
             import jax.numpy as jnp
 
             try:
                 fn = jax.jit(lambda x: x + 1)
-                fn(jnp.zeros((8,), jnp.int32)).block_until_ready()  # compile
+                x = jnp.zeros((8,), jnp.int32)
+                np.asarray(fn(x))  # compile + first fetch
                 samples = []
                 for _ in range(3):
                     t0 = time.perf_counter()
-                    fn(jnp.zeros((8,), jnp.int32)).block_until_ready()
+                    np.asarray(fn(x))
                     samples.append(time.perf_counter() - t0)
                 samples.sort()
                 cls._device_rtt_s = samples[1]
@@ -223,10 +227,13 @@ class TPUSolver(Solver):
 
         quality = self.latency_budget_s > 1.0
         dispatched = None
-        if lp_safe(problem) and not quality:
+        if lp_safe(problem) and not quality and self.device_rtt() < self.latency_budget_s:
             # Fire the kernel at the device BEFORE the host path runs: the
             # dispatch is non-blocking, so the TPU computes concurrently with
             # the host LP and the poll below only pays the leftover wait.
+            # Skipped when the measured device round-trip alone exceeds the
+            # latency budget (a tunneled chip at ~120ms RTT can never answer a
+            # sub-100ms race; the host path owns that link).
             dispatched = self._dispatch_async(problem)
         host_result = None
         try:
@@ -332,11 +339,12 @@ class TPUSolver(Solver):
             )
             if unplaced > 0 or costs[best] >= host_cost:
                 return None  # decode + validation would be wasted host time
+            if validate_counts(problem, orders[best], new_opt, new_active, ys):
+                return None
             result = self._decode(problem, orders[best], new_opt, new_active, ys)
             result.stats["backend"] = 1.0
             result.stats["portfolio_best"] = float(best)
-            if validate(problem, result):
-                return None
+            result.stats["validated_counts"] = 1.0
             return result
         except Exception:
             return None
@@ -367,15 +375,19 @@ class TPUSolver(Solver):
                 continue
             break
         t_solve = time.perf_counter() - t0
-        result = self._decode(problem, orders[best], new_opt, new_active, ys)
-        result.stats["solve_s"] = t_solve
-        result.stats["backend"] = 1.0
-        result.stats["portfolio_best"] = float(best)
-        violations = validate(problem, result)
+        # Count-level validation on the raw kernel output: same invariants as
+        # the name-level validator, no 10k-pod name expansion on the hot path.
+        violations = validate_counts(problem, orders[best], new_opt, new_active, ys)
         if violations:
             result = self._fallback.solve(problem)
             result.stats["fallback"] = 1.0
             result.stats["tpu_violations"] = float(len(violations))
+            return result
+        result = self._decode(problem, orders[best], new_opt, new_active, ys)
+        result.stats["solve_s"] = t_solve
+        result.stats["backend"] = 1.0
+        result.stats["portfolio_best"] = float(best)
+        result.stats["validated_counts"] = 1.0
         return result
 
     def _device_inputs(self, problem: EncodedProblem):
@@ -535,9 +547,13 @@ class TPUSolver(Solver):
         E = problem.E
         Ep = max(E, 1)
         s_new = new_opt.shape[0]
-        # slot -> list of pod names
-        new_pods: List[List[str]] = [[] for _ in range(s_new)]
-        existing_assignments = {}
+        group_names = problem.__dict__.get("_group_names")
+        if group_names is None:
+            group_names = [[p.name for p in g.pods] for g in problem.groups]
+            problem.__dict__["_group_names"] = group_names
+        # slot -> name segments (lazy NameSlice views; no per-pod string copies)
+        new_segs: List[List[tuple]] = [[] for _ in range(s_new)]
+        ex_segs: dict = {}
         unschedulable: List[str] = []
         # Only walk nonzero placements — ys is [T, Ep+S] and mostly zeros.
         rows, cols = np.nonzero(ys)
@@ -548,35 +564,37 @@ class TPUSolver(Solver):
             g = int(order[t])
             if g >= problem.G:
                 continue
-            group = problem.groups[g]
+            names_g = group_names[g]
             cursor = 0
             for s in sorted(slots):
                 n = int(ys[t, s])
-                names = [p.name for p in group.pods[cursor : cursor + n]]
+                seg = (names_g, cursor, n)
                 cursor += n
                 if s < Ep:
                     if s < E:
-                        key = problem.existing[s].name
-                        existing_assignments.setdefault(key, []).extend(names)
+                        ex_segs.setdefault(problem.existing[s].name, []).append(seg)
                 else:
-                    new_pods[s - Ep].extend(names)
-            if cursor < group.count:
-                unschedulable.extend(p.name for p in group.pods[cursor:])
+                    new_segs[s - Ep].append(seg)
+            if cursor < problem.groups[g].count:
+                unschedulable.extend(names_g[cursor:])
         # groups with zero placements anywhere are wholly unschedulable
         placed_rows = set(placements_by_row)
         for t in range(ys.shape[0]):
             g = int(order[t])
             if g < problem.G and t not in placed_rows:
-                unschedulable.extend(p.name for p in problem.groups[g].pods)
+                unschedulable.extend(group_names[g])
 
+        existing_assignments = {k: NameSlice(v) for k, v in ex_segs.items()}
         new_nodes = []
         cost = 0.0
         for s in range(s_new):
-            if not new_active[s] or not new_pods[s]:
+            if not new_active[s] or not new_segs[s]:
                 continue
             j = int(new_opt[s])
             option = problem.options[j]
-            new_nodes.append(NewNodeSpec(option=option, pod_names=new_pods[s], option_index=j))
+            new_nodes.append(
+                NewNodeSpec(option=option, pod_names=NameSlice(new_segs[s]), option_index=j)
+            )
             cost += option.price
         return SolveResult(
             new_nodes=new_nodes,

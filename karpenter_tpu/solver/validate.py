@@ -106,6 +106,17 @@ def validate(problem: EncodedProblem, result: SolveResult) -> List[str]:
     agg: Dict[tuple, int] = defaultdict(int)  # (gi, host, zone) -> count
     for host, zone, gi, _ in placements:
         agg[(gi, host, zone or "")] += 1
+    violations.extend(check_topology(problem, agg))
+    return violations
+
+
+def check_topology(problem: EncodedProblem, agg: Dict[tuple, int]) -> List[str]:
+    """Topology constraint checks over (group, host, zone) -> count aggregates.
+
+    Shared by the name-level validator above and the count-level kernel-path
+    validator below; selector matching only depends on group labels, so the
+    aggregate view is exact."""
+    violations: List[str] = []
     reps = [g.pods[0] for g in problem.groups]
     for gi, g in enumerate(problem.groups):
         rep = reps[gi]
@@ -151,6 +162,79 @@ def validate(problem: EncodedProblem, result: SolveResult) -> List[str]:
                 violations.append(
                     f"group {gi} required self-affinity split across {len(my_domains)}"
                 )
+    return violations
+
+
+def validate_counts(
+    problem: EncodedProblem,
+    order: np.ndarray,
+    new_opt: np.ndarray,
+    new_active: np.ndarray,
+    ys: np.ndarray,
+) -> List[str]:
+    """Count-level feasibility gate for the kernel's raw output — the same
+    invariants as ``validate`` (capacity, compat, completeness, topology)
+    checked on the [T, E+S] assignment-count matrix before any name decode.
+    Name expansion of 10k+ pods costs more than the solve's device round-trip;
+    the decode is a deterministic slicing of these counts (the name-level
+    validator cross-checks it in tests)."""
+    violations: List[str] = []
+    G, E = problem.G, problem.E
+    Ep = max(E, 1)
+    T = ys.shape[0]
+    d = problem.demand.astype(np.float64)
+
+    # counts[g, slot]: scan rows mapped back to group ids (padding rows dropped)
+    gidx = np.asarray(order[:T], dtype=np.int64)
+    real = gidx < G
+    counts = np.zeros((G, ys.shape[1]), np.int64)
+    np.add.at(counts, gidx[real], ys[real])
+
+    placed = counts.sum(axis=1)
+    if np.any(placed > problem.count):
+        violations.append("group placed more pods than demanded")
+
+    # existing nodes: remaining capacity + compat
+    if E:
+        ex_counts = counts[:, :E]
+        used = ex_counts.T.astype(np.float64) @ d  # [E, R]
+        if np.any(used > problem.ex_rem * (1 + CAP_RTOL) + 1e-6):
+            violations.append("existing node over remaining capacity")
+        if np.any(ex_counts[~problem.ex_compat.astype(bool)] != 0):
+            violations.append("incompatible placement on existing node")
+
+    # new slots: capacity + compat against each slot's option
+    new_counts = counts[:, Ep:]
+    active = np.asarray(new_active, bool) & (new_counts.sum(axis=0) > 0)
+    if np.any(new_counts[:, ~np.asarray(new_active, bool)] != 0):
+        violations.append("pods assigned to an inactive slot")
+    if np.any(active):
+        raw_opts = np.asarray(new_opt, np.int64)[active]
+        if np.any((raw_opts < 0) | (raw_opts >= problem.O)):
+            violations.append("active slot references an unknown launch option")
+            return violations
+        opts = raw_opts
+        load = new_counts[:, active].T.astype(np.float64) @ d  # [S', R]
+        if np.any(load > problem.alloc[opts] * (1 + CAP_RTOL) + 1e-6):
+            violations.append("new node over capacity")
+        if np.any((new_counts[:, active] > 0) & ~problem.compat[:, opts]):
+            violations.append("incompatible group on new node")
+
+    # topology aggregates without name expansion
+    agg: Dict[tuple, int] = {}
+    gs, ss = np.nonzero(counts)
+    for g, s in zip(gs.tolist(), ss.tolist()):
+        if s < Ep:
+            if s >= E:
+                continue
+            host = problem.existing[s].name
+            zone = problem.existing[s].node.zone() or ""
+        else:
+            host = f"new-{s - Ep}"
+            j = int(new_opt[s - Ep])
+            zone = problem.options[j].zone if 0 <= j < problem.O else ""
+        agg[(g, host, zone)] = int(counts[g, s])
+    violations.extend(check_topology(problem, agg))
     return violations
 
 
