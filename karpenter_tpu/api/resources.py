@@ -112,6 +112,11 @@ class Resources:
     def items(self):
         return self._r.items()
 
+    def items_mapping(self):
+        """The raw backing dict (read-only by convention) — lets hot paths use
+        len()/items() without the method-call-per-item cost."""
+        return self._r
+
     def to_dict(self) -> Dict[str, float]:
         return dict(self._r)
 

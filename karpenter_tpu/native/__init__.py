@@ -1,0 +1,66 @@
+"""Native (C) runtime components, built on demand with the system toolchain.
+
+The compute path of this framework is JAX/XLA; the control-plane runtime hot
+loops (pod signature hashing + group bucketing for the encoder) are C, the way
+the reference's whole scheduler is compiled Go. The extension builds lazily at
+first import with the baked-in compiler and caches the shared object next to
+the source; any failure (no compiler, exotic platform) falls back to the pure
+Python implementations transparently.
+
+``load_encoder()`` returns the compiled module or None.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import subprocess
+import sysconfig
+import threading
+from typing import Optional
+
+_lock = threading.Lock()
+_encoder = None
+_tried = False
+
+
+def _build_and_load():
+    src_dir = os.path.dirname(os.path.abspath(__file__))
+    src = os.path.join(src_dir, "encoder.c")
+    ext_suffix = sysconfig.get_config_var("EXT_SUFFIX") or ".so"
+    so = os.path.join(src_dir, "_encoder" + ext_suffix)
+    if (not os.path.exists(so)) or os.path.getmtime(so) < os.path.getmtime(src):
+        cc = sysconfig.get_config_var("CC") or "cc"
+        include = sysconfig.get_paths()["include"]
+        cmd = cc.split() + [
+            "-O2",
+            "-shared",
+            "-fPIC",
+            f"-I{include}",
+            src,
+            "-o",
+            so,
+        ]
+        subprocess.run(
+            cmd, check=True, capture_output=True, timeout=120, cwd=src_dir
+        )
+    spec = importlib.util.spec_from_file_location("karpenter_tpu.native._encoder", so)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def load_encoder():
+    """The compiled encoder module, or None when it cannot be built here."""
+    global _encoder, _tried
+    if _tried:
+        return _encoder
+    with _lock:
+        if _tried:
+            return _encoder
+        try:
+            _encoder = _build_and_load()
+        except Exception:
+            _encoder = None
+        _tried = True
+    return _encoder

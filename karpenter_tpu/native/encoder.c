@@ -1,0 +1,259 @@
+/* Native encoder hot loop: pod signature + group bucketing.
+ *
+ * The solver's cold-start budget at 50k pods is dominated by computing each
+ * pod's scheduling-identity signature and bucketing pods into groups —
+ * ~300ms of pure CPython attribute traversal and small-tuple churn
+ * (karpenter_tpu/solver/encode.py:_signature / group_pods). This module does
+ * the same walk with the C API: one pass, no bytecode dispatch, no
+ * intermediate lists. The reference keeps its scheduler entirely in compiled
+ * Go (bin-packing.md:16-43); this is the analogous native runtime component
+ * for the Python control plane.
+ *
+ * Semantics contract (kept in lockstep with encode._signature):
+ *   - the signature tuple layout is (requests_items, node_selector_items,
+ *     req_terms, tolerations, spread, affinity, labels_items)
+ *   - pods with any "complex" field non-empty (required_affinity_terms,
+ *     tolerations, topology_spread, affinity_terms) are signed by calling
+ *     back into the Python _signature — only the dominant simple shape is
+ *     specialized here
+ *   - items tuples are insertion-ordered (see encode._items_t for why that
+ *     is safe for grouping)
+ *   - the computed signature is cached on pod.__dict__["_sched_sig"] with
+ *     the exact same key the Python path uses, so the two implementations
+ *     interoperate on warm pods
+ *
+ * Exposed API:
+ *   group_pods(pods, py_signature) -> list[list[pod]]
+ */
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+static PyObject *sig_key = NULL; /* interned "_sched_sig" */
+static PyObject *s_required_affinity_terms, *s_tolerations, *s_topology_spread,
+    *s_affinity_terms, *s_requests, *s_r, *s_node_selector, *s_meta, *s_labels;
+
+/* tuple(d.items()) for a dict; () for empty/non-dict (caller validates). */
+static PyObject *
+items_tuple(PyObject *d)
+{
+    Py_ssize_t n, pos = 0, i = 0;
+    PyObject *out, *k, *v;
+
+    if (d == NULL || !PyDict_Check(d) || (n = PyDict_Size(d)) == 0)
+        return PyTuple_New(0);
+    out = PyTuple_New(n);
+    if (out == NULL)
+        return NULL;
+    while (PyDict_Next(d, &pos, &k, &v)) {
+        PyObject *pair = PyTuple_Pack(2, k, v);
+        if (pair == NULL) {
+            Py_DECREF(out);
+            return NULL;
+        }
+        PyTuple_SET_ITEM(out, i++, pair);
+    }
+    return out;
+}
+
+/* True when attr is a non-empty sequence (list). -1 on error. */
+static int
+nonempty_list_attr(PyObject *obj, PyObject *name)
+{
+    PyObject *a = PyObject_GetAttr(obj, name);
+    Py_ssize_t n;
+    if (a == NULL)
+        return -1;
+    n = PyList_CheckExact(a) ? PyList_GET_SIZE(a) : PyObject_Length(a);
+    Py_DECREF(a);
+    if (n < 0)
+        return -1;
+    return n > 0;
+}
+
+static PyObject *
+signature_for(PyObject *pod, PyObject *py_signature)
+{
+    PyObject *dict, *sig, *meta = NULL, *labels = NULL, *requests = NULL,
+             *r_map = NULL, *nodesel = NULL, *req_items = NULL,
+             *sel_items = NULL, *lab_items = NULL, *empty;
+    int complex_shape;
+
+    /* cached? (written by either implementation) */
+    dict = PyObject_GenericGetDict(pod, NULL);
+    if (dict == NULL)
+        return NULL;
+    sig = PyDict_GetItemWithError(dict, sig_key);
+    if (sig != NULL) {
+        Py_INCREF(sig);
+        Py_DECREF(dict);
+        return sig;
+    }
+    if (PyErr_Occurred()) {
+        Py_DECREF(dict);
+        return NULL;
+    }
+
+    complex_shape = nonempty_list_attr(pod, s_required_affinity_terms);
+    if (complex_shape == 0)
+        complex_shape = nonempty_list_attr(pod, s_tolerations);
+    if (complex_shape == 0)
+        complex_shape = nonempty_list_attr(pod, s_topology_spread);
+    if (complex_shape == 0)
+        complex_shape = nonempty_list_attr(pod, s_affinity_terms);
+    if (complex_shape < 0) {
+        Py_DECREF(dict);
+        return NULL;
+    }
+    if (complex_shape) {
+        /* rare shape: defer to the Python implementation (it caches too) */
+        Py_DECREF(dict);
+        return PyObject_CallFunctionObjArgs(py_signature, pod, NULL);
+    }
+
+    requests = PyObject_GetAttr(pod, s_requests);
+    if (requests == NULL)
+        goto fail;
+    r_map = PyObject_GetAttr(requests, s_r);
+    if (r_map == NULL)
+        goto fail;
+    nodesel = PyObject_GetAttr(pod, s_node_selector);
+    if (nodesel == NULL)
+        goto fail;
+    meta = PyObject_GetAttr(pod, s_meta);
+    if (meta == NULL)
+        goto fail;
+    labels = PyObject_GetAttr(meta, s_labels);
+    if (labels == NULL)
+        goto fail;
+
+    req_items = items_tuple(r_map);
+    sel_items = items_tuple(nodesel);
+    lab_items = items_tuple(labels);
+    if (req_items == NULL || sel_items == NULL || lab_items == NULL)
+        goto fail;
+
+    empty = PyTuple_New(0);
+    if (empty == NULL)
+        goto fail;
+    /* (requests, node_selector, (), (), (), (), labels) */
+    sig = PyTuple_Pack(7, req_items, sel_items, empty, empty, empty, empty,
+                       lab_items);
+    Py_DECREF(empty);
+    if (sig == NULL)
+        goto fail;
+
+    if (PyDict_SetItem(dict, sig_key, sig) < 0) {
+        Py_DECREF(sig);
+        goto fail;
+    }
+    Py_DECREF(req_items);
+    Py_DECREF(sel_items);
+    Py_DECREF(lab_items);
+    Py_DECREF(labels);
+    Py_DECREF(meta);
+    Py_DECREF(nodesel);
+    Py_DECREF(r_map);
+    Py_DECREF(requests);
+    Py_DECREF(dict);
+    return sig;
+
+fail:
+    Py_XDECREF(req_items);
+    Py_XDECREF(sel_items);
+    Py_XDECREF(lab_items);
+    Py_XDECREF(labels);
+    Py_XDECREF(meta);
+    Py_XDECREF(nodesel);
+    Py_XDECREF(r_map);
+    Py_XDECREF(requests);
+    Py_DECREF(dict);
+    return NULL;
+}
+
+/* group_pods(pods, py_signature) -> list of lists of pods, in first-seen
+ * signature order. */
+static PyObject *
+group_pods_c(PyObject *self, PyObject *args)
+{
+    PyObject *pods, *py_signature, *buckets = NULL, *order = NULL, *seq = NULL;
+    Py_ssize_t n, i;
+
+    if (!PyArg_ParseTuple(args, "OO", &pods, &py_signature))
+        return NULL;
+    seq = PySequence_Fast(pods, "pods must be a sequence");
+    if (seq == NULL)
+        return NULL;
+    n = PySequence_Fast_GET_SIZE(seq);
+    buckets = PyDict_New();  /* sig -> list[pod] */
+    order = PyList_New(0);   /* list[list[pod]] in first-seen order */
+    if (buckets == NULL || order == NULL)
+        goto fail;
+
+    for (i = 0; i < n; i++) {
+        PyObject *pod = PySequence_Fast_GET_ITEM(seq, i); /* borrowed */
+        PyObject *sig = signature_for(pod, py_signature);
+        PyObject *members;
+        if (sig == NULL)
+            goto fail;
+        members = PyDict_GetItemWithError(buckets, sig); /* borrowed */
+        if (members == NULL) {
+            if (PyErr_Occurred()) {
+                Py_DECREF(sig);
+                goto fail;
+            }
+            members = PyList_New(0);
+            if (members == NULL || PyDict_SetItem(buckets, sig, members) < 0 ||
+                PyList_Append(order, members) < 0) {
+                Py_XDECREF(members);
+                Py_DECREF(sig);
+                goto fail;
+            }
+            Py_DECREF(members); /* owned by buckets + order now */
+        }
+        Py_DECREF(sig);
+        if (PyList_Append(members, pod) < 0)
+            goto fail;
+    }
+    Py_DECREF(buckets);
+    Py_DECREF(seq);
+    return order;
+
+fail:
+    Py_XDECREF(buckets);
+    Py_XDECREF(order);
+    Py_XDECREF(seq);
+    return NULL;
+}
+
+static PyMethodDef methods[] = {
+    {"group_pods", group_pods_c, METH_VARARGS,
+     "group_pods(pods, py_signature) -> list[list[pod]] bucketed by "
+     "scheduling signature, first-seen order"},
+    {NULL, NULL, 0, NULL},
+};
+
+static struct PyModuleDef moduledef = {
+    PyModuleDef_HEAD_INIT, "_encoder", NULL, -1, methods,
+};
+
+PyMODINIT_FUNC
+PyInit__encoder(void)
+{
+    sig_key = PyUnicode_InternFromString("_sched_sig");
+    s_required_affinity_terms = PyUnicode_InternFromString("required_affinity_terms");
+    s_tolerations = PyUnicode_InternFromString("tolerations");
+    s_topology_spread = PyUnicode_InternFromString("topology_spread");
+    s_affinity_terms = PyUnicode_InternFromString("affinity_terms");
+    s_requests = PyUnicode_InternFromString("requests");
+    s_r = PyUnicode_InternFromString("_r");
+    s_node_selector = PyUnicode_InternFromString("node_selector");
+    s_meta = PyUnicode_InternFromString("meta");
+    s_labels = PyUnicode_InternFromString("labels");
+    if (sig_key == NULL || s_required_affinity_terms == NULL ||
+        s_tolerations == NULL || s_topology_spread == NULL ||
+        s_affinity_terms == NULL || s_requests == NULL || s_r == NULL ||
+        s_node_selector == NULL || s_meta == NULL || s_labels == NULL)
+        return NULL;
+    return PyModule_Create(&moduledef);
+}

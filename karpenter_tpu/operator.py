@@ -139,10 +139,14 @@ class Operator:
             # batch already fired but could not be placed (launch failures,
             # ICE, no provisioner yet) — no watch event ever re-arms those
             # (reference analogue: workqueue requeue-with-backoff).
-            retry_due = now - last_retry >= 5.0 and bool(self.cluster.pending_pods())
+            retry_due = False
+            if now - last_retry >= 5.0:
+                last_retry = now  # pace the pending_pods scan itself, not
+                # just successful reconciles — it walks every pod under the
+                # cluster lock
+                retry_due = bool(self.cluster.pending_pods())
             if self.provisioning.batcher.ready() or retry_due:
                 self.provisioning.reconcile()
-                last_retry = now
                 if not frozen:
                     # freeze AFTER the first reconcile built the long-lived
                     # state (pods, nodes, encoder caches) so gen-2 GC scans

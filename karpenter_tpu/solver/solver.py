@@ -567,12 +567,15 @@ class TPUSolver(Solver):
             names_g = group_names[g]
             cursor = 0
             for s in sorted(slots):
+                if s < Ep and s >= E:
+                    # padding slot (E==0): don't consume pods into the void —
+                    # leaving cursor put reports them unschedulable below
+                    continue
                 n = int(ys[t, s])
                 seg = (names_g, cursor, n)
                 cursor += n
                 if s < Ep:
-                    if s < E:
-                        ex_segs.setdefault(problem.existing[s].name, []).append(seg)
+                    ex_segs.setdefault(problem.existing[s].name, []).append(seg)
                 else:
                     new_segs[s - Ep].append(seg)
             if cursor < problem.groups[g].count:

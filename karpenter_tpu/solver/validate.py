@@ -193,6 +193,12 @@ def validate_counts(
     placed = counts.sum(axis=1)
     if np.any(placed > problem.count):
         violations.append("group placed more pods than demanded")
+    if E == 0 and np.any(counts[:, :Ep]):
+        # E==0 pads one existing-slot column; pods assigned there would be
+        # dropped by decode (cursor advances, nothing emitted) — the
+        # completeness hole the name-level validator catches as "neither
+        # placed nor reported unschedulable"
+        violations.append("pods assigned to the existing-node padding slot")
 
     # existing nodes: remaining capacity + compat
     if E:
