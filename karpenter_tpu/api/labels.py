@@ -22,6 +22,7 @@ DO_NOT_EVICT_ANNOTATION = f"{GROUP}/do-not-evict"
 DO_NOT_CONSOLIDATE_ANNOTATION = f"{GROUP}/do-not-consolidate"
 VOLUNTARY_DISRUPTION_ANNOTATION = f"{GROUP}/voluntary-disruption"  # value: "drifted"
 EMPTINESS_TIMESTAMP_ANNOTATION = f"{GROUP}/emptiness-timestamp"
+LAUNCH_TEMPLATE_ANNOTATION = f"{GROUP}/launch-template"  # resolved config name
 TERMINATION_FINALIZER = f"{GROUP}/termination"
 
 # Instance-type detail labels (reference: karpenter.k8s.aws/instance-*,

@@ -65,6 +65,9 @@ class FakeCloudProvider(CloudProvider):
             Subnet(id=f"subnet-{z}", zone=z, tags={"karpenter.tpu/discovery": "cluster", "zone": z})
             for z in zones
         ]
+        from .subnet import SubnetProvider
+
+        self.subnet_provider = SubnetProvider(self.subnets)
         self.security_groups: List[SecurityGroup] = [
             SecurityGroup(id="sg-default", name="default",
                           tags={"karpenter.tpu/discovery": "cluster"}),
@@ -75,6 +78,24 @@ class FakeCloudProvider(CloudProvider):
             Image(id="image-001", family="default", created=1.0,
                   tags={"family": "default"})
         ]
+        # Per-(family, variant) image inventory + current pointers, the
+        # analogue of SSM default-AMI parameters per family
+        # (reference amifamily/{al2,bottlerocket,ubuntu}.go DefaultAMIs).
+        for fam in ("al2", "ubuntu", "bottlerocket"):
+            for variant in ("standard", "accelerator"):
+                img = f"img-{fam}-{variant}-001"
+                self.images.append(
+                    Image(id=img, family=fam, created=1.0,
+                          tags={"family": fam, "variant": variant})
+                )
+                self.current_images[f"{fam}/{variant}"] = img
+        # Provider-side launch templates (hash-named; see launchtemplate.py)
+        self.launch_templates: Dict[str, object] = {}
+        # Wired by the operator: NodeTemplate name -> NodeTemplate, so create()
+        # can resolve launch configs the way the reference cloudprovider fetches
+        # the AWSNodeTemplate by ref inside Create.
+        self.node_template_lookup: Optional[Callable[[str], object]] = None
+        self._lt_provider = None  # lazy LaunchTemplateProvider
         self.create_calls: List[Machine] = []
         self.delete_calls: List[str] = []
         self.launch_attempts = 0
@@ -87,6 +108,23 @@ class FakeCloudProvider(CloudProvider):
         # encoder's option cache skip re-flattening 400 types x offerings.
         self.catalog_version = 0
         self._it_cache: Dict[Optional[str], tuple] = {}
+        # Live pricing over the catalog's static anchors (pricing.go:85);
+        # get_instance_types serves offerings at current prices and its cache
+        # key includes pricing.version, so a refresh invalidates consumers.
+        from .pricing import PricingProvider
+
+        self.pricing = PricingProvider(self.catalog)
+        # CreateFleet-style batcher: concurrent create() calls with the same
+        # launch shape coalesce into one fleet call (createfleet.go:33-110,
+        # windows batcher.go:29-35 — 35ms idle / 1s max / 1000 items).
+        from ..utils.batcher import Batcher, BatcherOptions
+
+        self.create_fleet_calls = 0
+        self._fleet_batcher = Batcher(
+            request_hasher=_fleet_hash,
+            batch_executor=self._execute_fleet,
+            options=BatcherOptions(idle_timeout=0.035, max_timeout=1.0, max_items=1000),
+        )
 
     # -- test injection ----------------------------------------------------
     def set_insufficient_capacity(self, instance_type: str, zone: str, capacity_type: str) -> None:
@@ -98,16 +136,47 @@ class FakeCloudProvider(CloudProvider):
     def inject_next_error(self, error: Exception) -> None:
         self.next_errors.append(error)
 
-    def rotate_image(self, family: str = "default") -> str:
-        """Advance the current image, making previously launched machines drifted."""
-        current = self.current_images.get(family, "image-000")
-        nxt = f"image-{int(current.rsplit('-', 1)[1]) + 1:03d}"
-        self.current_images[family] = nxt
+    def rotate_image(self, family: str = "default", variant: Optional[str] = None) -> str:
+        """Advance the current image for (family, variant), making previously
+        launched machines of that personality drifted."""
+        key = family if variant is None else f"{family}/{variant}"
+        current = self.current_images.get(key, "image-000")
+        stem, n = current.rsplit("-", 1)
+        nxt = f"{stem}-{int(n) + 1:03d}"
+        self.current_images[key] = nxt
+        tags = {"family": family}
+        if variant is not None:
+            tags["variant"] = variant
         self.images.append(
-            Image(id=nxt, family=family, created=float(len(self.images) + 1),
-                  tags={"family": family})
+            Image(id=nxt, family=family, created=float(len(self.images) + 1), tags=tags)
         )
         return nxt
+
+    # -- launch-template store (reference EC2 launch-template API surface,
+    # used by launchtemplate.LaunchTemplateProvider) ------------------------
+    def create_launch_template(self, config) -> None:
+        self.launch_templates[config.name] = config
+
+    def delete_launch_template(self, name: str) -> None:
+        self.launch_templates.pop(name, None)
+
+    def list_launch_templates(self) -> List[object]:
+        return list(self.launch_templates.values())
+
+    def list_images(self, family: str) -> List[Image]:
+        """Image source for the resolver: images of one family, any variant."""
+        return [i for i in self.images if i.tags.get("family") == family]
+
+    @property
+    def launch_template_provider(self):
+        if self._lt_provider is None:
+            from .imagefamily import ImageResolver
+            from .launchtemplate import LaunchTemplateProvider
+
+            self._lt_provider = LaunchTemplateProvider(
+                store=self, resolver=ImageResolver(self)
+            )
+        return self._lt_provider
 
     # -- network/image discovery (selector = tag map; reference subnet.go:213-235,
     # securitygroup.go:53, ami.go:99-133) ---------------------------------
@@ -126,6 +195,27 @@ class FakeCloudProvider(CloudProvider):
     @property
     def name(self) -> str:
         return "fake"
+
+    def create_batched(self, machine: Machine) -> Machine:
+        """create() through the fleet batcher: blocks until the machine's
+        window executes; concurrent callers with the same launch shape share
+        ONE fleet call. Per-machine failures come back as that caller's
+        exception, exactly like the reference's per-instance CreateFleet
+        errors (createfleet.go:68-89)."""
+        result = self._fleet_batcher.add(machine)
+        if isinstance(result, BaseException):
+            raise result
+        return result
+
+    def _execute_fleet(self, machines: Sequence[Machine]) -> List[object]:
+        self.create_fleet_calls += 1
+        out: List[object] = []
+        for m in machines:
+            try:
+                out.append(self.create(m))
+            except Exception as e:
+                out.append(e)
+        return out
 
     def create(self, machine: Machine) -> Machine:
         with self._lock:
@@ -147,7 +237,17 @@ class FakeCloudProvider(CloudProvider):
                     self.unavailable_offerings.mark_unavailable(*key, reason="ICE")
                     attempted.append(key)
                     continue
-                return self._launch(machine, it, offering)
+                try:
+                    return self._launch(machine, it, offering)
+                except InsufficientCapacityError:
+                    # subnet IP exhaustion in this zone: mask the offering so
+                    # the next solve routes around it, and try the next
+                    # candidate (same treatment as an ICE, instance.go:400-406)
+                    self.unavailable_offerings.mark_unavailable(
+                        *key, reason="ip-exhaustion"
+                    )
+                    attempted.append(key)
+                    continue
             raise InsufficientCapacityError(
                 f"all offerings exhausted for machine {machine.name}", offerings=attempted
             )
@@ -171,7 +271,7 @@ class FakeCloudProvider(CloudProvider):
         )
         chosen_ct = wk.CAPACITY_TYPE_SPOT if use_spot else wk.CAPACITY_TYPE_ON_DEMAND
         zone_req = reqs.get(wk.ZONE)
-        pairs: List[Tuple[InstanceType, Offering]] = []
+        priced: List[Tuple[float, InstanceType, Offering]] = []
         for it in types:
             for o in it.offerings:
                 if not o.available or o.capacity_type != chosen_ct:
@@ -180,15 +280,63 @@ class FakeCloudProvider(CloudProvider):
                     continue
                 if self.unavailable_offerings.is_unavailable(it.name, o.zone, o.capacity_type):
                     continue
-                pairs.append((it, o))
-        pairs.sort(key=lambda p: p[1].price)
+                # order by LIVE price (pricing.go feeds instance.go's
+                # price-ordered launch list), not the catalog anchor
+                price = self.pricing.price(it.name, o.zone, o.capacity_type)
+                priced.append((price if price is not None else o.price, it, o))
+        priced.sort(key=lambda p: p[0])
         # Reference truncates the launch request to the cheapest 60 types
         # (instance.go:55,90-92); we bound offerings similarly.
-        return pairs[: self.max_instance_types]
+        return [(it, o) for _, it, o in priced[: self.max_instance_types]]
+
+    def _resolve_launch_config(self, machine: Machine, it: InstanceType):
+        """NodeTemplate -> resolved launch config for this machine+type, or None
+        when no template is referenced (legacy default-image path). Mirrors the
+        reference cloudprovider fetching the AWSNodeTemplate by ref and running
+        EnsureAll inside Create (launchtemplate.go:89-135)."""
+        if self.node_template_lookup is None or not machine.node_template_ref:
+            return None
+        nt = self.node_template_lookup(machine.node_template_ref)
+        if nt is None:
+            return None
+        cfgs = self.launch_template_provider.ensure_all(
+            nt,
+            [it],
+            taints=tuple(machine.taints),
+            labels=_bootstrap_labels(machine.meta.labels),
+            kubelet=machine.kubelet,
+        )
+        for cfg in cfgs:
+            if cfg.covers(it.name):
+                return cfg
+        return cfgs[0] if cfgs else None
 
     def _launch(self, machine: Machine, it: InstanceType, offering: Offering) -> Machine:
+        # zonal subnet by free IPs, with in-flight reservation (subnet.go:90,
+        # :129); eligible subnets narrow to the template's resolved set
+        eligible = None
+        if self.node_template_lookup is not None and machine.node_template_ref:
+            nt = self.node_template_lookup(machine.node_template_ref)
+            if nt is not None and nt.resolved_subnets:
+                eligible = nt.resolved_subnets
+        subnet = self.subnet_provider.zonal_subnet_for_launch(
+            offering.zone, eligible_ids=eligible
+        )
+        try:
+            return self._launch_in_subnet(machine, it, offering, subnet)
+        except Exception:
+            self.subnet_provider.release_inflight(subnet.id)
+            raise
+
+    def _launch_in_subnet(
+        self, machine: Machine, it: InstanceType, offering: Offering, subnet: Subnet
+    ) -> Machine:
         instance_id = f"i-{next(self._id_counter):08d}"
-        image = self.current_images.get("default", "image-001")
+        cfg = self._resolve_launch_config(machine, it)
+        if cfg is not None:
+            image = cfg.image_id
+        else:
+            image = self.current_images.get("default", "image-001")
         instance = Instance(
             id=instance_id,
             instance_type=it.name,
@@ -197,7 +345,12 @@ class FakeCloudProvider(CloudProvider):
             image_id=image,
             tags={wk.MANAGED_BY: "karpenter-tpu", wk.PROVISIONER_NAME: machine.provisioner_name},
             created=time.time(),
+            launch_template=cfg.name if cfg is not None else "",
+            image_family=cfg.family if cfg is not None else "",
+            image_variant=cfg.variant if cfg is not None else "",
         )
+        instance.tags["subnet"] = subnet.id
+        self.subnet_provider.commit(subnet.id)
         self.instances[instance_id] = instance
         machine.status = MachineStatus(
             provider_id=f"fake:///{offering.zone}/{instance_id}",
@@ -212,6 +365,8 @@ class FakeCloudProvider(CloudProvider):
         machine.meta.labels[wk.ZONE] = offering.zone
         machine.meta.labels[wk.CAPACITY_TYPE] = offering.capacity_type
         machine.meta.labels[wk.PROVISIONER_NAME] = machine.provisioner_name
+        if cfg is not None:
+            machine.meta.annotations[wk.LAUNCH_TEMPLATE_ANNOTATION] = cfg.name
         return machine
 
     def delete(self, machine: Machine) -> None:
@@ -220,7 +375,11 @@ class FakeCloudProvider(CloudProvider):
             self.delete_calls.append(instance_id)
             if instance_id not in self.instances:
                 raise MachineNotFoundError(f"instance {instance_id} not found")
-            self.instances[instance_id].state = "terminated"
+            instance = self.instances[instance_id]
+            instance.state = "terminated"
+            subnet_id = instance.tags.get("subnet")
+            if subnet_id:
+                self.subnet_provider.release_ip(subnet_id)
             del self.instances[instance_id]
 
     def get(self, provider_id: str) -> Machine:
@@ -246,6 +405,7 @@ class FakeCloudProvider(CloudProvider):
             provisioner.meta.resource_version if provisioner is not None else None,
             self.unavailable_offerings.seqnum,
             self.catalog_version,
+            self.pricing.version,
             int(time.time() // 60),
         )
         cached = self._it_cache.get(pname)
@@ -259,7 +419,7 @@ class FakeCloudProvider(CloudProvider):
                 Offering(
                     zone=o.zone,
                     capacity_type=o.capacity_type,
-                    price=o.price,
+                    price=self.pricing.price(it.name, o.zone, o.capacity_type) or o.price,
                     available=o.available
                     and not self.unavailable_offerings.is_unavailable(
                         it.name, o.zone, o.capacity_type
@@ -272,12 +432,37 @@ class FakeCloudProvider(CloudProvider):
         return out
 
     def is_machine_drifted(self, machine: Machine) -> bool:
-        """AMI drift: machine's image no longer the resolved image for its type
-        (isAMIDrifted, cloudprovider.go:207-236)."""
+        """Drift = the machine's launch personality is no longer what its
+        NodeTemplate resolves to (isAMIDrifted + launch-template hash drift,
+        cloudprovider.go:207-236): per-(family, variant) image comparison for
+        template-launched machines, plus a full launch-config re-resolution —
+        a userdata/block-device/SG change produces a new content-hash name.
+        Machines launched without a template fall back to the single default
+        image pointer."""
         instance = self.instances.get(_instance_id(machine.status.provider_id))
         if instance is None:
             return False
-        return instance.image_id != self.current_images.get("default", "image-001")
+        if not instance.launch_template:
+            return instance.image_id != self.current_images.get("default", "image-001")
+        expected_img = self.current_images.get(
+            f"{instance.image_family}/{instance.image_variant}"
+        )
+        if expected_img is not None and instance.image_id != expected_img:
+            return True
+        if self.node_template_lookup is not None and machine.node_template_ref:
+            nt = self.node_template_lookup(machine.node_template_ref)
+            it = self._by_name.get(instance.instance_type)
+            if nt is not None and it is not None:
+                cfgs = self.launch_template_provider.ensure_all(
+                    nt,
+                    [it],
+                    taints=tuple(machine.taints),
+                    labels=_bootstrap_labels(machine.meta.labels),
+                    kubelet=machine.kubelet,
+                )
+                if cfgs and all(c.name != instance.launch_template for c in cfgs):
+                    return True
+        return False
 
     def instance_for(self, machine: Machine) -> Optional[Instance]:
         return self.instances.get(_instance_id(machine.status.provider_id))
@@ -310,6 +495,35 @@ class FakeCloudProvider(CloudProvider):
 
 def _instance_id(provider_id: str) -> str:
     return provider_id.rsplit("/", 1)[-1]
+
+
+def _fleet_hash(machine: Machine) -> tuple:
+    """Launch-shape bucket key: machines that could ride one CreateFleet call
+    (same provisioner, template, and requirement surface — the reference
+    hashes the CreateFleetInput, createfleet.go:97-110)."""
+    reqs = tuple(
+        sorted(
+            (r.key, r.complement, tuple(sorted(r.values)), r.greater_than, r.less_than)
+            for r in machine.requirements
+        )
+    )
+    return (machine.provisioner_name, machine.node_template_ref, reqs)
+
+
+def _bootstrap_labels(labels: Dict[str, str]) -> Dict[str, str]:
+    """User-facing labels for bootstrap userdata: well-known/stamped domains
+    (kubernetes.io and any karpenter domain, including instance.karpenter.*)
+    excluded so the launch-config content hash is stable across the
+    launch-time (pre-stamp) and drift-time (post-stamp) label surfaces."""
+    out = {}
+    for k, v in labels.items():
+        domain = k.split("/", 1)[0] if "/" in k else ""
+        if domain == "kubernetes.io" or domain.endswith(".kubernetes.io"):
+            continue
+        if "karpenter" in domain:
+            continue
+        out[k] = v
+    return out
 
 
 def _tags_match(tags: Dict[str, str], selector: Dict[str, str]) -> bool:

@@ -29,9 +29,9 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from ..api.objects import BlockDeviceMapping, KubeletConfiguration, NodeTemplate, Taint
-from ..api.resources import Resources
+from ..api.resources import GPU_NVIDIA, GPU_TPU, Resources
 
-ACCELERATOR_RESOURCES = ("tpu", "gpu", "nvidia.com/gpu", "accelerator")
+ACCELERATOR_RESOURCES = ("tpu", "gpu", GPU_TPU, GPU_NVIDIA, "accelerator")
 
 
 @dataclass

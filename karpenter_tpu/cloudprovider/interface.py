@@ -71,6 +71,11 @@ class Instance:
     state: str = "running"
     tags: Dict[str, str] = field(default_factory=dict)
     created: float = 0.0
+    # launch-config provenance (reference: instances carry their launch
+    # template name + resolved AMI; drift keys on both)
+    launch_template: str = ""
+    image_family: str = ""
+    image_variant: str = ""
 
 
 class CloudProvider(abc.ABC):

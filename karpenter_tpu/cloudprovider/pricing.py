@@ -1,0 +1,144 @@
+"""Pricing provider: live per-(type, zone) spot prices with static fallback.
+
+Rebuild of the reference's pricing subsystem
+(``/root/reference/pkg/providers/pricing/pricing.go``): on-demand prices from
+the pricing API refreshed slowly (``:177-283``, 12h), spot prices per
+(instance type, zone) refreshed fast (``:381-437``, 1h), and a generated
+static price table as the fallback when the API is unreachable
+(``zz_generated.pricing.go``, loaded at ``pricing.go:85``).
+
+The fake backend has no pricing API; refreshes advance a deterministic
+random walk per (type, zone) — enough to drive everything the reference's
+live prices drive: price-ordered launch choices, consolidation-on-price-drop,
+and cache invalidation through a monotonically increasing ``version`` seqnum
+(the analogue of the reference's cache-key seqnums).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import math
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..api import labels as wk
+from .types import InstanceType
+
+SPOT_REFRESH_INTERVAL = 3600.0  # pricing.go:64 spot updates hourly
+ON_DEMAND_REFRESH_INTERVAL = 12 * 3600.0  # on-demand updates 12-hourly
+
+
+def _walk(name: str, zone: str, tick: int) -> float:
+    """Deterministic multiplicative drift in [0.75, 1.25] for a given tick —
+    the fake's stand-in for the spot market moving between refreshes."""
+    h = hashlib.blake2s(f"{name}|{zone}|{tick}".encode(), digest_size=8).digest()
+    u = int.from_bytes(h, "big") / float(1 << 64)
+    return 0.75 + 0.5 * u
+
+
+class PricingProvider:
+    """Price book over a catalog: static fallback + refreshable live prices."""
+
+    def __init__(self, catalog: Sequence[InstanceType]):
+        self._lock = threading.Lock()
+        # static fallback tables, captured from the catalog the way the
+        # reference bakes zz_generated.pricing.go at codegen time
+        self._fallback_od: Dict[str, float] = {}
+        self._fallback_spot: Dict[Tuple[str, str], float] = {}
+        for it in catalog:
+            for o in it.offerings:
+                if o.capacity_type == wk.CAPACITY_TYPE_ON_DEMAND:
+                    self._fallback_od[it.name] = o.price
+                else:
+                    self._fallback_spot[(it.name, o.zone)] = o.price
+        self._od: Dict[str, float] = dict(self._fallback_od)
+        self._spot: Dict[Tuple[str, str], float] = dict(self._fallback_spot)
+        self._tick = 0
+        self.version = 0  # seqnum: bumps on every successful refresh
+        self.api_available = True  # fake outage switch
+        self.last_spot_update: float = 0.0
+        self.last_od_update: float = 0.0
+
+    # -- lookups (pricing.go OnDemandPrice/SpotPrice) -----------------------
+    def on_demand_price(self, instance_type: str) -> Optional[float]:
+        with self._lock:
+            return self._od.get(instance_type, self._fallback_od.get(instance_type))
+
+    def spot_price(self, instance_type: str, zone: str) -> Optional[float]:
+        with self._lock:
+            key = (instance_type, zone)
+            return self._spot.get(key, self._fallback_spot.get(key))
+
+    def price(self, instance_type: str, zone: str, capacity_type: str) -> Optional[float]:
+        if capacity_type == wk.CAPACITY_TYPE_SPOT:
+            return self.spot_price(instance_type, zone)
+        return self.on_demand_price(instance_type)
+
+    # -- refresh loops (pricing.go:177-283 od, :381-437 spot) ---------------
+    def update_spot_prices(self, now: float = 0.0) -> bool:
+        """One spot refresh: every (type, zone) pair re-quotes around its
+        fallback anchor. Returns False (prices untouched — the fallback/last
+        table keeps serving) when the pricing API is down, as the reference
+        does on DescribeSpotPriceHistory errors."""
+        if not self.api_available:
+            return False
+        with self._lock:
+            self._tick += 1
+            for key, anchor in self._fallback_spot.items():
+                self._spot[key] = round(anchor * _walk(key[0], key[1], self._tick), 6)
+            self.version += 1
+            self.last_spot_update = now
+        return True
+
+    def update_on_demand_prices(self, now: float = 0.0) -> bool:
+        if not self.api_available:
+            return False
+        with self._lock:
+            # on-demand moves far less than spot: +-2% around the anchor
+            for name, anchor in self._fallback_od.items():
+                drift = _walk(name, "", self._tick)
+                self._od[name] = round(anchor * (0.98 + 0.04 * (drift - 0.75) / 0.5), 6)
+            self.version += 1
+            self.last_od_update = now
+        return True
+
+    def set_spot_price(self, instance_type: str, zone: str, price: float) -> None:
+        """Test/injection hook: pin one spot price (and invalidate caches)."""
+        with self._lock:
+            self._spot[(instance_type, zone)] = price
+            self.version += 1
+
+    def set_on_demand_price(self, instance_type: str, price: float) -> None:
+        """Test/injection hook: pin one on-demand price (and invalidate caches)."""
+        with self._lock:
+            self._od[instance_type] = price
+            self.version += 1
+
+    def reset_to_fallback(self) -> None:
+        with self._lock:
+            self._od = dict(self._fallback_od)
+            self._spot = dict(self._fallback_spot)
+            self.version += 1
+
+
+class PricingController:
+    """Refresh cadence driver (the reference runs pricing.Provider's
+    updateSpotPricing/updateOnDemandPricing on tickers inside its controller
+    manager; here the operator's slow loop calls reconcile)."""
+
+    def __init__(self, pricing: PricingProvider, clock=None):
+        import time as _time
+
+        self.pricing = pricing
+        self._now = clock or (lambda: _time.monotonic())
+
+    def reconcile(self) -> List[str]:
+        now = self._now() if callable(self._now) else self._now.now()
+        updated = []
+        if now - self.pricing.last_spot_update >= SPOT_REFRESH_INTERVAL:
+            if self.pricing.update_spot_prices(now):
+                updated.append("spot")
+        if now - self.pricing.last_od_update >= ON_DEMAND_REFRESH_INTERVAL:
+            if self.pricing.update_on_demand_prices(now):
+                updated.append("on-demand")
+        return updated

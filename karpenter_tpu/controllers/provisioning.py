@@ -97,6 +97,7 @@ class ProvisioningController:
         self.batcher = PodBatcher(
             idle=self.settings.batch_idle_duration, max_duration=self.settings.batch_max_duration
         )
+        self._pending_seen: set = set()
         cluster.watch(self._on_event)
 
     def _on_event(self, event: str, obj) -> None:
@@ -104,13 +105,21 @@ class ProvisioningController:
         # again (drain evictions unbind them) so the batch window — not a
         # pending-pods poll — is the single trigger for provisioning
         # (reference: pod controller -> provisioner.Trigger, SURVEY §3.2).
-        if (
-            isinstance(obj, Pod)
-            and event in ("ADDED", "MODIFIED")
-            and obj.is_pending()
-            and not obj.is_daemonset
-        ):
-            self.batcher.note_arrival()
+        # Only the TRANSITION into pending arms the window: status-only
+        # MODIFIED heartbeats on an already-pending pod must not bump the
+        # batch generation (that would void reset() and busy-loop reconciles).
+        if not isinstance(obj, Pod) or obj.is_daemonset:
+            return
+        if event == "DELETED":
+            self._pending_seen.discard(obj.name)
+            return
+        if event in ("ADDED", "MODIFIED"):
+            if obj.is_pending():
+                if obj.name not in self._pending_seen:
+                    self._pending_seen.add(obj.name)
+                    self.batcher.note_arrival()
+            else:
+                self._pending_seen.discard(obj.name)
 
     # -- the reconcile loop body -------------------------------------------
     def reconcile(self) -> ProvisioningResult:
@@ -146,8 +155,10 @@ class ProvisioningController:
                 result.bound[pod_name] = node_name
                 metrics.PODS_SCHEDULED.inc()
 
-        # launch new nodes, honoring provisioner limits
+        # launch new nodes, honoring provisioner limits (serial phase: limit
+        # accounting is order-dependent)
         usage: Dict[str, Resources] = {}
+        launchable: List[NewNodeSpec] = []
         for spec in solve.new_nodes:
             prov = spec.option.provisioner
             if prov.limits is not None:
@@ -166,23 +177,30 @@ class ProvisioningController:
                     result.unschedulable.extend(spec.pod_names)
                     continue
                 usage[prov.name] = projected
-            try:
-                machine, node = self._launch(spec)
-            except InsufficientCapacityError:
+            launchable.append(spec)
+
+        # launch phase: concurrent workers feed the provider's CreateFleet
+        # batcher, so same-shape machines coalesce into one cloud call
+        # (reference: parallel machine launches + createfleet.go batching)
+        outcomes = self._launch_all(launchable)
+        for spec, outcome in zip(launchable, outcomes):
+            prov = spec.option.provisioner
+            if isinstance(outcome, InsufficientCapacityError):
                 # offerings exhausted even after in-provider fallback: pods stay
                 # pending; the ICE cache masks these offerings next cycle
                 # (instance.go:400-406)
                 result.unschedulable.extend(spec.pod_names)
                 continue
-            except Exception as e:
+            if isinstance(outcome, BaseException):
                 # Any launch failure (cloud API outage, throttling, SDK error) is
                 # retryable next cycle — it must not abort the rest of the batch.
                 metrics.CLOUDPROVIDER_ERRORS.inc()
                 self.recorder.publish(
-                    "LaunchFailed", str(e), object_name=machineless_name(spec), type="Warning"
+                    "LaunchFailed", str(outcome), object_name=machineless_name(spec), type="Warning"
                 )
                 result.unschedulable.extend(spec.pod_names)
                 continue
+            machine, node = outcome
             result.machines.append(machine)
             result.nodes.append(node)
             metrics.NODES_CREATED.inc({"provisioner": prov.name})
@@ -202,9 +220,34 @@ class ProvisioningController:
         self.batcher.reset(upto_generation=batch_gen)
         return result
 
-    def _launch(self, spec: NewNodeSpec) -> Tuple[Machine, Node]:
+    def _launch(self, spec: NewNodeSpec, create_fn=None) -> Tuple[Machine, Node]:
         requests = merge([self._pod_requests(n) for n in spec.pod_names])
-        return launch_from_spec(self.cluster, self.provider, spec, requests)
+        return launch_from_spec(
+            self.cluster, self.provider, spec, requests, create_fn=create_fn
+        )
+
+    def _launch_all(self, specs: List[NewNodeSpec]) -> List[object]:
+        """Launch every spec, returning (machine, node) or the exception per
+        spec. Multiple specs launch on a worker pool through the provider's
+        batched-create path when it has one; a single spec (or a provider
+        without batching) launches inline."""
+        if not specs:
+            return []
+        create_fn = getattr(self.provider, "create_batched", None)
+
+        def one(spec: NewNodeSpec, fn=None) -> object:
+            try:
+                return self._launch(spec, create_fn=fn)
+            except Exception as e:
+                return e
+
+        if len(specs) == 1 or create_fn is None:
+            return [one(spec) for spec in specs]
+
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor(max_workers=min(10, len(specs))) as pool:
+            return list(pool.map(lambda s: one(s, create_fn), specs))
 
     def _pod_requests(self, pod_name: str) -> Resources:
         pod = self.cluster.pods.get(pod_name)
@@ -216,7 +259,11 @@ def machineless_name(spec: NewNodeSpec) -> str:
 
 
 def launch_from_spec(
-    cluster: Cluster, provider: CloudProvider, spec: NewNodeSpec, requests: Resources
+    cluster: Cluster,
+    provider: CloudProvider,
+    spec: NewNodeSpec,
+    requests: Resources,
+    create_fn=None,
 ) -> Tuple[Machine, Node]:
     """Launch one machine for a solver node spec and register its node. Shared by
     the provisioning loop and consolidation replacements (which the reference also
@@ -240,7 +287,7 @@ def launch_from_spec(
         node_template_ref=prov.node_template_ref,
     )
     t0 = time.perf_counter()
-    machine = provider.create(machine)
+    machine = (create_fn or provider.create)(machine)
     metrics.CLOUDPROVIDER_DURATION.observe(time.perf_counter() - t0, {"method": "create"})
     cluster.add_machine(machine)
     node = register_node(cluster, machine, prov)

@@ -25,11 +25,18 @@ _tried = False
 
 
 def _build_and_load():
+    import hashlib
+
     src_dir = os.path.dirname(os.path.abspath(__file__))
     src = os.path.join(src_dir, "encoder.c")
     ext_suffix = sysconfig.get_config_var("EXT_SUFFIX") or ".so"
-    so = os.path.join(src_dir, "_encoder" + ext_suffix)
-    if (not os.path.exists(so)) or os.path.getmtime(so) < os.path.getmtime(src):
+    # The source CONTENT hash is part of the binary name: a stale .so (git
+    # checkouts don't preserve mtimes) can never be loaded against newer
+    # semantics — it simply isn't the file being looked for.
+    with open(src, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()[:12]
+    so = os.path.join(src_dir, f"_encoder_{digest}" + ext_suffix)
+    if not os.path.exists(so):
         cc = sysconfig.get_config_var("CC") or "cc"
         include = sysconfig.get_paths()["include"]
         cmd = cc.split() + [

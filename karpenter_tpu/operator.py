@@ -47,6 +47,7 @@ class Operator:
     nodetemplate: Optional[NodeTemplateController]
     drift: DriftController
     garbagecollect: GarbageCollectionController
+    pricing: Optional[object] = None
     clock: Clock = field(default_factory=Clock)
 
     @staticmethod
@@ -62,6 +63,10 @@ class Operator:
         clock = clock or Clock()
         cluster = Cluster()
         provider = provider or FakeCloudProvider()
+        if getattr(provider, "node_template_lookup", "absent") is None:
+            # let the cloud provider resolve NodeTemplate refs at launch time
+            # (the reference fetches the AWSNodeTemplate by ref inside Create)
+            provider.node_template_lookup = cluster.node_templates.get
         recorder = Recorder()
         solver = solver or TPUSolver()
         provisioning = ProvisioningController(
@@ -84,6 +89,11 @@ class Operator:
             if isinstance(provider, FakeCloudProvider)
             else None
         )
+        pricing = None
+        if getattr(provider, "pricing", None) is not None:
+            from .cloudprovider.pricing import PricingController
+
+            pricing = PricingController(provider.pricing, clock=clock)
         drift = DriftController(cluster, provider, settings=settings, recorder=recorder)
         garbagecollect = GarbageCollectionController(
             cluster, provider, recorder=recorder, clock=clock
@@ -100,6 +110,7 @@ class Operator:
             nodetemplate=nodetemplate,
             drift=drift,
             garbagecollect=garbagecollect,
+            pricing=pricing,
             clock=clock,
         )
 
@@ -111,6 +122,8 @@ class Operator:
             self.interruption.reconcile()
         if self.nodetemplate is not None:
             self.nodetemplate.reconcile()
+        if self.pricing is not None:
+            self.pricing.reconcile()
         self.drift.reconcile()
         self.deprovisioning.reconcile()
         self.provisioning.reconcile()
@@ -158,6 +171,8 @@ class Operator:
             if now - last_slow > 300.0:
                 if self.nodetemplate is not None:
                     self.nodetemplate.reconcile()
+                if self.pricing is not None:
+                    self.pricing.reconcile()
                 self.drift.reconcile()
                 self.garbagecollect.reconcile()
                 last_slow = now

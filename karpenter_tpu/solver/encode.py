@@ -467,6 +467,12 @@ class _ReqTable:
 class ExistingNode:
     node: Node
     remaining: Resources  # allocatable minus bound pod requests (incl. daemonsets)
+    # Pods already bound to the node: they seed topology domain counts (zone
+    # spread levels, hostname anti-affinity occupancy) so a second
+    # provisioning cycle can't violate DoNotSchedule constraints the first
+    # cycle satisfied. The reference's scheduler seeds its topology tracker
+    # from the cluster the same way.
+    pods: Tuple[Pod, ...] = ()
 
     @property
     def name(self) -> str:
@@ -498,6 +504,13 @@ class EncodedProblem:
     ex_rem: np.ndarray  # [E, R] float32
     ex_zone: np.ndarray  # [E] int32
     ex_compat: np.ndarray  # [G, E] bool
+    # Cluster-wide topology seeds from already-bound pods (None when E==0 or
+    # no group carries topology constraints): spread domain counts, zone
+    # anti-affinity occupancy, and the raw (host, zone, pod) list the
+    # validator re-checks constraints against.
+    zone_seed: Optional[np.ndarray] = None  # [G, Z] int32 spread-selector matches
+    zone_occupied: Optional[np.ndarray] = None  # [G, Z] int32 anti-selector matches
+    seed_pods: List[tuple] = field(default_factory=list)  # (host, zone, Pod)
 
     @property
     def G(self) -> int:
@@ -602,10 +615,31 @@ def encode(
             ex_rem[k] = _vector(e.remaining, axes)
             ex_zone[k] = zone_index.get(e.node.zone(), 0)
         ex_table = _ReqTable([Requirements.from_labels(e.node.labels) for e in existing])
-        schedulable = np.array([not e.node.unschedulable for e in existing])
+        schedulable = np.array(
+            [
+                not e.node.unschedulable and e.node.meta.deletion_timestamp is None
+                for e in existing
+            ]
+        )
+        # Startup taints are ignored in scheduling simulation (the reference
+        # scheduler's taint filter, website concepts/scheduling.md "startup
+        # taints"): a workload daemon strips them after bootstrap, so treating
+        # them as permanent would exclude non-tolerating pods from this
+        # capacity forever and drive perpetual scale-up.
+        startup_by_prov: Dict[str, set] = {
+            p.name: {(t.key, t.value, t.effect) for t in p.startup_taints}
+            for p, _ in provisioners
+            if p.startup_taints
+        }
         ex_taint_groups: Dict[tuple, list] = {}
         for k, e in enumerate(existing):
-            ex_taint_groups.setdefault(tuple(e.node.taints), []).append(k)
+            taints = tuple(e.node.taints)
+            startup = startup_by_prov.get(e.node.provisioner_name() or "")
+            if startup:
+                taints = tuple(
+                    t for t in taints if (t.key, t.value, t.effect) not in startup
+                )
+            ex_taint_groups.setdefault(taints, []).append(k)
         for i, g in enumerate(groups):
             tol_ok = np.zeros(E, bool)
             tols = list(g.tolerations)
@@ -615,6 +649,10 @@ def encode(
             req_ok = ex_table.eval_terms(g.terms)
             cap_ok = ~np.any(demand[i][None, :] > ex_rem + 1e-9, axis=1)
             ex_compat[i] = schedulable & tol_ok & req_ok & cap_ok
+
+    zone_seed, zone_occupied, seed_pods = _topology_seeds(
+        groups, existing, zone_index, ex_compat, compat
+    )
 
     return EncodedProblem(
         groups=groups,
@@ -635,4 +673,96 @@ def encode(
         ex_rem=ex_rem.astype(np.float32),
         ex_zone=ex_zone,
         ex_compat=ex_compat,
+        zone_seed=zone_seed,
+        zone_occupied=zone_occupied,
+        seed_pods=seed_pods,
     )
+
+
+def _topology_seeds(
+    groups: Sequence[PodGroup],
+    existing: Sequence[ExistingNode],
+    zone_index: Dict[str, int],
+    ex_compat: np.ndarray,
+    compat: np.ndarray,
+):
+    """Seed topology constraints from pods already bound in the cluster.
+
+    Three effects, mirroring how the reference scheduler's topology tracker
+    counts existing cluster pods (website concepts/scheduling.md topology):
+
+    * zone spread: per-zone counts of selector-matching bound pods feed the
+      solver's zone quotas (water-filled so new pods level the domains);
+    * hostname spread / anti-affinity: an existing node already hosting a
+      selector-matching pod is masked incompatible (conservative — the node
+      may have residual skew headroom, but a mask can never violate);
+    * required self-affinity (colocate): once matching pods exist, the group
+      is pinned to their nodes — no new node may open for it.
+
+    Returns (zone_seed [G, Z] | None, zone_occupied [G, Z] | None,
+    seed_pods [(host, zone, Pod)]). MUTATES ex_compat/compat masks in place.
+    """
+    G = len(groups)
+    Z = max(len(zone_index), 1)
+    topo = [
+        i
+        for i, g in enumerate(groups)
+        if g.zone_skew > 0 or g.node_cap < BIG_CAP or g.zone_cap < BIG_CAP or g.colocate
+    ]
+    if not existing or not topo:
+        return None, None, []
+    seed_pods = [
+        (e.name, e.node.zone() or "", p) for e in existing for p in e.pods
+    ]
+    if not seed_pods:
+        return None, None, []
+    zone_seed = np.zeros((G, Z), np.int32)
+    zone_occupied = np.zeros((G, Z), np.int32)
+    for i in topo:
+        rep = groups[i].pods[0]
+        # per-zone spread seeds (first DoNotSchedule zone constraint drives
+        # the quota; the validator checks every constraint independently)
+        for c in rep.topology_spread:
+            if (
+                c.when_unsatisfiable == "DoNotSchedule"
+                and c.topology_key == wk.ZONE
+                and c.selects(rep)
+            ):
+                for _, zone, p in seed_pods:
+                    zi = zone_index.get(zone)
+                    if zi is not None and c.selects(p):
+                        zone_seed[i, zi] += 1
+                break
+        # hostname-capped groups: occupied nodes are off-limits
+        host_sels = [
+            c.selects
+            for c in rep.topology_spread
+            if c.when_unsatisfiable == "DoNotSchedule"
+            and c.topology_key == wk.HOSTNAME
+            and c.selects(rep)
+        ]
+        colocate_sel = None
+        for t in rep.affinity_terms:
+            if not t.selects(rep):
+                continue
+            if t.anti and t.topology_key == wk.HOSTNAME:
+                host_sels.append(t.selects)
+            elif t.anti and t.topology_key == wk.ZONE:
+                for _, zone, p in seed_pods:
+                    zi = zone_index.get(zone)
+                    if zi is not None and t.selects(p):
+                        zone_occupied[i, zi] += 1
+            elif not t.anti and t.topology_key == wk.HOSTNAME:
+                colocate_sel = t.selects
+        if host_sels:
+            for k, e in enumerate(existing):
+                if any(sel(p) for p in e.pods for sel in host_sels):
+                    ex_compat[i, k] = False
+        if colocate_sel is not None:
+            hosting = np.array(
+                [any(colocate_sel(p) for p in e.pods) for e in existing], bool
+            )
+            if hosting.any():
+                ex_compat[i] &= hosting
+                compat[i, :] = False  # pinned to the existing domain
+    return zone_seed, zone_occupied, seed_pods

@@ -43,11 +43,15 @@ def _dominant_size(demand_row: np.ndarray, norm: np.ndarray) -> float:
 class GreedyPacker:
     def __init__(self, problem: EncodedProblem):
         self.p = problem
+        # Existing nodes start WITH their bound pods, so spread/affinity checks
+        # count cluster-wide domain occupancy, not just the in-batch placements
+        # (their resources are already excluded from ex_rem).
         self.nodes: List[_SimNode] = [
             _SimNode(rem=problem.ex_rem[i].astype(np.float64).copy(), zone=e.node.zone() or "",
-                     existing_name=e.name)
+                     existing_name=e.name, pods=list(e.pods))
             for i, e in enumerate(problem.existing)
         ]
+        self._seed_counts = [len(e.pods) for e in problem.existing]
         self.n_existing = len(self.nodes)
 
     # -- constraint checks against the evolving assignment ------------------
@@ -186,9 +190,9 @@ class GreedyPacker:
             if n.pods
         ]
         existing_assignments = {
-            n.existing_name: [q.name for q in n.pods]
-            for n in self.nodes[: self.n_existing]
-            if n.pods
+            n.existing_name: [q.name for q in n.pods[self._seed_counts[i]:]]
+            for i, n in enumerate(self.nodes[: self.n_existing])
+            if len(n.pods) > self._seed_counts[i]
         }
         cost = float(sum(s.price for s in new_nodes))
         return SolveResult(

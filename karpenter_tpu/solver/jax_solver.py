@@ -62,8 +62,10 @@ class PackInputs(NamedTuple):
     demand: jax.Array  # [G, R] f32 per-pod demand (normalized)
     count: jax.Array  # [G] i32
     node_cap: jax.Array  # [G] i32
-    zone_cap: jax.Array  # [G] i32
-    zone_skew: jax.Array  # [G] i32
+    # Per-(group, zone) NEW-pod quotas, host-computed: water-filled spread
+    # targets over cluster-wide seed counts, minus anti-affinity occupancy.
+    # IBIG = unlimited; a group is zone-limited iff any entry < IBIG.
+    quota: jax.Array  # [G, Z] i32
     colocate: jax.Array  # [G] bool
     compat: jax.Array  # [G, O] bool
     alloc: jax.Array  # [O, R] f32 (normalized)
@@ -126,23 +128,11 @@ def _shared_precompute(inputs: PackInputs, s_new: int, n_zones: int) -> _Shared:
     lam_raw = jnp.min(rate, axis=1)
     lam = jnp.where(lam_raw < INF, lam_raw, 0.0)  # [G]
 
-    # Zone availability → equal-split quotas for spread groups.
-    zidx = jnp.arange(n_zones, dtype=jnp.int32)
-    zoneh_opt = inputs.opt_zone[None, :] == zidx[:, None]  # [Z, O]
-    avail_opt = jnp.any(ok[:, None, :] & zoneh_opt[None, :, :], axis=-1)  # [G, Z]
+    # Zone quotas are host-computed (water-filled over cluster-wide seeds,
+    # solver._zone_quotas); the kernel only derives the limited flag.
+    quota = inputs.quota  # [G, Z]
     ex_ok = inputs.ex_compat & inputs.ex_valid[None, :]  # [G, E]
-    zoneh_ex = inputs.ex_zone[None, :] == zidx[:, None]  # [Z, E]
-    avail_ex = jnp.any(ex_ok[:, None, :] & zoneh_ex[None, :, :], axis=-1)  # [G, Z]
-    zones_avail = avail_opt | avail_ex
-    n_avail = jnp.maximum(jnp.sum(zones_avail.astype(jnp.int32), axis=1), 1)  # [G]
-    rank = jnp.cumsum(zones_avail.astype(jnp.int32), axis=1) - 1
-    # Exact equal split: first (cnt % n) available zones take ceil(cnt/n).
-    eq = cnt[:, None] // n_avail[:, None] + (rank < (cnt % n_avail)[:, None]).astype(jnp.int32)
-    eq = jnp.where(zones_avail, eq, 0)
-    spread = inputs.zone_skew > 0
-    quota = jnp.where(spread[:, None], eq, IBIG)
-    quota = jnp.minimum(quota, inputs.zone_cap[:, None])  # [G, Z]
-    zone_limited = spread | (inputs.zone_cap < IBIG)
+    zone_limited = jnp.any(quota < IBIG, axis=1)
 
     # Lookahead value table: val_pair[g, o, g'] = value of one (g,o) node's
     # residual capacity to group g' — pods of g' it can absorb × g''s cheapest

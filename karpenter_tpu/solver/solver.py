@@ -40,6 +40,84 @@ def _next_pow2(n: int, floor: int = 8) -> int:
     return max(floor, 1 << (max(n, 1) - 1).bit_length())
 
 
+_IBIG = 1 << 30
+
+
+def _water_fill(count: int, seeds: np.ndarray, avail: np.ndarray) -> np.ndarray:
+    """Distribute ``count`` new pods over available zones so final levels
+    (seed + new) are as equal as possible — the DoNotSchedule-optimal split
+    when domains already hold pods. Returns per-zone quotas summing exactly
+    to ``count`` (so a quota-exhausting placement realizes the level set)."""
+    Z = seeds.shape[0]
+    out = np.zeros(Z, np.int64)
+    idx = np.flatnonzero(avail)
+    if idx.size == 0 or count <= 0:
+        return out
+    s = seeds[idx].astype(np.int64)
+    order = np.argsort(s, kind="stable")
+    ss = s[order]
+    n = ss.size
+    csum = np.concatenate([[0], np.cumsum(ss)])
+    L = None
+    for k in range(1, n + 1):
+        nxt = ss[k] if k < n else None
+        cap = None if nxt is None else k * int(nxt) - int(csum[k])
+        if cap is None or cap >= count:
+            L = -(-(count + int(csum[k])) // k)  # ceil
+            break
+    base = np.maximum(L - 1 - ss, 0)
+    r = count - int(base.sum())
+    new = base.copy()
+    bump = np.flatnonzero(ss <= L - 1)[: max(r, 0)]
+    new[bump] += 1
+    out[idx[order]] = new
+    return out
+
+
+def _zone_quotas(problem: EncodedProblem, n_zones: int) -> np.ndarray:
+    """Per-(group, zone) NEW-pod quotas for the kernel: water-filled spread
+    targets over cluster-wide seeds, min'd with zone anti-affinity headroom
+    (zone_cap minus matching occupancy). IBIG = unlimited."""
+    G = problem.G
+    quota = np.full((G, n_zones), _IBIG, np.int64)
+    if G == 0:
+        return quota.astype(np.int32)
+    spread = problem.zone_skew > 0
+    capped = problem.zone_cap < _IBIG
+    if not spread.any() and not capped.any():
+        return quota.astype(np.int32)
+    # zone availability: any compatible option or existing node in the zone
+    avail = np.zeros((G, n_zones), bool)
+    for z in range(n_zones):
+        opt_in_zone = problem.opt_zone == z
+        if opt_in_zone.any():
+            avail[:, z] |= problem.compat[:, opt_in_zone].any(axis=1)
+        if problem.E:
+            ex_in_zone = problem.ex_zone == z
+            if ex_in_zone.any():
+                avail[:, z] |= problem.ex_compat[:, ex_in_zone].any(axis=1)
+    seeds = problem.zone_seed
+    occupied = problem.zone_occupied
+    for g in range(G):
+        if spread[g]:
+            s = (
+                seeds[g, :n_zones].astype(np.int64)
+                if seeds is not None
+                else np.zeros(n_zones, np.int64)
+            )
+            quota[g] = _water_fill(int(problem.count[g]), s, avail[g])
+        if capped[g]:
+            occ = (
+                occupied[g, :n_zones].astype(np.int64)
+                if occupied is not None
+                else np.zeros(n_zones, np.int64)
+            )
+            quota[g] = np.minimum(
+                quota[g], np.maximum(int(problem.zone_cap[g]) - occ, 0)
+            )
+    return np.clip(quota, 0, _IBIG).astype(np.int32)
+
+
 # Cheap per-axis bound for the hot path; the tight LP bound lives in bounds.py.
 from .bounds import fractional_lower_bound as lower_bound  # noqa: E402
 
@@ -445,10 +523,8 @@ class TPUSolver(Solver):
         count[:G] = problem.count
         node_cap = np.full((Gp,), 1 << 30, np.int32)
         node_cap[:G] = problem.node_cap
-        zone_cap = np.full((Gp,), 1 << 30, np.int32)
-        zone_cap[:G] = problem.zone_cap
-        zone_skew = np.zeros((Gp,), np.int32)
-        zone_skew[:G] = problem.zone_skew
+        quota = np.full((Gp, n_zones), 1 << 30, np.int32)
+        quota[:G] = _zone_quotas(problem, n_zones)
         colocate = np.zeros((Gp,), bool)
         colocate[:G] = problem.colocate
         compat = np.zeros((Gp, Op), bool)
@@ -475,8 +551,7 @@ class TPUSolver(Solver):
             demand=demand,
             count=count,
             node_cap=node_cap,
-            zone_cap=zone_cap,
-            zone_skew=zone_skew,
+            quota=quota,
             colocate=colocate,
             compat=compat,
             alloc=alloc,

@@ -115,53 +115,95 @@ def check_topology(problem: EncodedProblem, agg: Dict[tuple, int]) -> List[str]:
 
     Shared by the name-level validator above and the count-level kernel-path
     validator below; selector matching only depends on group labels, so the
-    aggregate view is exact."""
+    aggregate view is exact. Pods already bound in the cluster
+    (``problem.seed_pods``) count toward every domain — a placement that only
+    looks balanced against the in-batch pods is still a violation if the
+    cluster's existing occupancy tips the skew."""
     violations: List[str] = []
     reps = [g.pods[0] for g in problem.groups]
+    seed_pods = problem.seed_pods or []
+    # Per-problem memo: seed scans are O(bound pods) with a Python selector
+    # call each — compute once per (constraint, axis) for the problem's
+    # lifetime, not on every kernel solve (validate_counts is hot-path).
+    memo = problem.__dict__.setdefault("_seed_count_memo", {})
+
+    def seed_counts(owner, selects, key_is_host: bool) -> Dict[str, int]:
+        key = (id(owner), key_is_host)
+        cached = memo.get(key)
+        if cached is not None:
+            return cached
+        out: Dict[str, int] = defaultdict(int)
+        for host, zone, p in seed_pods:
+            if selects(p):
+                out[host if key_is_host else zone] += 1
+        memo[key] = out
+        return out
+
     for gi, g in enumerate(problem.groups):
         rep = reps[gi]
         for c in rep.topology_spread:
             if c.when_unsatisfiable != "DoNotSchedule":
                 continue
             selected_groups = [gj for gj, r in enumerate(reps) if c.selects(r)]
-            counts: Dict[str, int] = defaultdict(int)
+            new_counts: Dict[str, int] = defaultdict(int)
             for (gj, host, zone), n in agg.items():
                 if gj in selected_groups:
                     key = host if c.topology_key == wk.HOSTNAME else zone
+                    new_counts[key] += n
+            counts: Dict[str, int] = defaultdict(int, new_counts)
+            if seed_pods:
+                for key, n in seed_counts(c, c.selects, c.topology_key == wk.HOSTNAME).items():
                     counts[key] += n
-            if counts:
-                # min domain count is 0 as long as an empty feasible domain exists;
-                # conservatively compare against 0 for new-capacity scenarios.
-                if c.topology_key == wk.HOSTNAME and max(counts.values()) > c.max_skew:
-                    violations.append(
-                        f"group {gi} hostname spread skew {max(counts.values())} > {c.max_skew}"
-                    )
+            if new_counts:
+                # Only domains RECEIVING new pods can violate: pre-existing
+                # seed skew (pods placed before a zone existed, drained hosts)
+                # is not fixable by a scale-up batch — the per-pod admission
+                # rule the reference scheduler applies compares the receiving
+                # domain's new total against the global min.
+                if c.topology_key == wk.HOSTNAME:
+                    worst = max(counts[k] for k in new_counts)
+                    if worst > c.max_skew:
+                        violations.append(
+                            f"group {gi} hostname spread skew {worst} > {c.max_skew}"
+                        )
                 if c.topology_key == wk.ZONE:
-                    skew = max(counts.values()) - min(
-                        [counts.get(z, 0) for z in problem.zones] or [0]
-                    )
-                    if skew > c.max_skew:
-                        violations.append(f"group {gi} zone spread skew {skew} > {c.max_skew}")
+                    floor_ = min([counts.get(z, 0) for z in problem.zones] or [0])
+                    worst = max(counts[k] for k in new_counts)
+                    if worst - floor_ > c.max_skew:
+                        violations.append(
+                            f"group {gi} zone spread skew {worst - floor_} > {c.max_skew}"
+                        )
         for term in rep.affinity_terms:
             my_domains = {
                 (host if term.topology_key == wk.HOSTNAME else zone)
                 for (gj, host, zone), n in agg.items()
                 if gj == gi and n > 0
             }
+            key_is_host = term.topology_key == wk.HOSTNAME
             if term.anti:
                 if term.selects(rep):
                     domain_counts: Dict[str, int] = defaultdict(int)
                     for (gj, host, zone), n in agg.items():
                         if gj == gi:
-                            key = host if term.topology_key == wk.HOSTNAME else zone
+                            key = host if key_is_host else zone
+                            domain_counts[key] += n
+                    if seed_pods:
+                        for key, n in seed_counts(term, term.selects, key_is_host).items():
                             domain_counts[key] += n
                     for key, n in domain_counts.items():
                         if n > 1:
                             violations.append(f"group {gi} anti-affinity violated in {key}")
-            elif term.selects(rep) and len(my_domains) > 1:
-                violations.append(
-                    f"group {gi} required self-affinity split across {len(my_domains)}"
-                )
+            elif term.selects(rep):
+                if len(my_domains) > 1:
+                    violations.append(
+                        f"group {gi} required self-affinity split across {len(my_domains)}"
+                    )
+                elif seed_pods and my_domains:
+                    seeded = set(seed_counts(term, term.selects, key_is_host))
+                    if seeded and not my_domains <= seeded:
+                        violations.append(
+                            f"group {gi} required self-affinity outside the existing domain"
+                        )
     return violations
 
 

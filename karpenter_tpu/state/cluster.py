@@ -165,13 +165,16 @@ class Cluster:
             return out
 
     def existing_capacity(self) -> List[ExistingNode]:
-        """Schedulable in-flight capacity for the solver: every ready, managed,
-        non-deleting node with its remaining allocatable."""
+        """In-flight capacity view for the solver: every managed node with its
+        remaining allocatable and its bound pods. Cordoned/deleting nodes are
+        included — the encoder marks them unschedulable (no NEW placements)
+        but their bound pods still seed topology domain counts."""
         out = []
         for n in self.managed_nodes():
-            if n.unschedulable or n.meta.deletion_timestamp is not None:
-                continue
-            out.append(ExistingNode(node=n, remaining=self.node_remaining(n)))
+            pods = tuple(p for p in self.pods_on_node(n.name) if not p.is_daemonset)
+            out.append(
+                ExistingNode(node=n, remaining=self.node_remaining(n), pods=pods)
+            )
         return out
 
     def provisioner_usage(self, provisioner: str) -> Resources:

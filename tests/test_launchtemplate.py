@@ -1,0 +1,193 @@
+"""Launch-config resolution wired end-to-end: NodeTemplate -> ImageResolver ->
+hash-named cached launch configs -> Machine/Instance provenance -> per-family
+drift. Reference: launchtemplate.go:89-135 (EnsureAll), :273-304 (cache
+hydration/eviction), amifamily/resolver.go:108-141 (variant grouping)."""
+
+import pytest
+
+from karpenter_tpu.api import (
+    Machine,
+    ObjectMeta,
+    Pod,
+    Provisioner,
+    Requirement,
+    Requirements,
+    Resources,
+    Taint,
+)
+from karpenter_tpu.api import labels as wk
+from karpenter_tpu.api.objects import NodeTemplate
+from karpenter_tpu.cloudprovider import FakeCloudProvider, generate_catalog
+from karpenter_tpu.cloudprovider.imagefamily import ImageResolver, get_family
+from karpenter_tpu.cloudprovider.launchtemplate import (
+    NAME_PREFIX,
+    LaunchTemplateProvider,
+)
+
+
+@pytest.fixture
+def provider():
+    return FakeCloudProvider(catalog=generate_catalog(n_types=20))
+
+
+@pytest.fixture
+def template():
+    return NodeTemplate(
+        meta=ObjectMeta(name="default"),
+        image_family="al2",
+        resolved_security_groups=["sg-default", "sg-nodes"],
+    )
+
+
+def _machine(provider, template_ref="default", taints=()):
+    it = provider.catalog[0]
+    return Machine(
+        meta=ObjectMeta(name="m1", labels={"team": "web"}),
+        provisioner_name="default",
+        requirements=Requirements(
+            [Requirement.in_values(wk.INSTANCE_TYPE, [it.name])]
+        ),
+        requests=Resources(cpu="100m"),
+        taints=list(taints),
+        node_template_ref=template_ref,
+    )
+
+
+class TestEnsureAll:
+    def test_content_hash_dedupe(self, provider, template):
+        lt = provider.launch_template_provider
+        types = provider.catalog[:5]
+        cfgs1 = lt.ensure_all(template, types)
+        cfgs2 = lt.ensure_all(template, types)
+        assert [c.name for c in cfgs1] == [c.name for c in cfgs2]
+        assert all(c.name.startswith(NAME_PREFIX) for c in cfgs1)
+        # one provider-side template per personality, not per call
+        assert len(provider.launch_templates) == len(cfgs1)
+
+    def test_input_change_changes_name(self, provider, template):
+        lt = provider.launch_template_provider
+        types = provider.catalog[:3]
+        before = {c.name for c in lt.ensure_all(template, types)}
+        template.user_data = "#!/bin/bash\necho extra"
+        after = {c.name for c in lt.ensure_all(template, types)}
+        assert before.isdisjoint(after)
+
+    def test_userdata_rendered_per_family(self, provider):
+        for fam, marker in (("al2", "bootstrap.sh"), ("bottlerocket", "cluster-name"),
+                            ("ubuntu", "ubuntu-bootstrap.sh")):
+            nt = NodeTemplate(meta=ObjectMeta(name=fam), image_family=fam)
+            cfgs = provider.launch_template_provider.ensure_all(nt, provider.catalog[:2])
+            assert cfgs, fam
+            assert marker in cfgs[0].user_data
+
+    def test_custom_family_passthrough(self, provider):
+        nt = NodeTemplate(
+            meta=ObjectMeta(name="c"), image_family="custom",
+            user_data="#!/bin/sh\nmy-bootstrap",
+        )
+        # custom family has no seeded images -> resolve yields nothing
+        cfgs = provider.launch_template_provider.ensure_all(nt, provider.catalog[:1])
+        assert cfgs == []
+
+    def test_unknown_family_rejected(self):
+        with pytest.raises(ValueError):
+            get_family("windows-2003")
+
+    def test_eviction_deletes_provider_side(self, provider, template):
+        now = [0.0]
+        lt = LaunchTemplateProvider(
+            store=provider, resolver=ImageResolver(provider), ttl=10.0,
+            clock=lambda: now[0],
+        )
+        cfgs = lt.ensure_all(template, provider.catalog[:2])
+        assert provider.launch_templates
+        now[0] = 100.0
+        template.user_data = "changed"  # force a new personality next call
+        lt.ensure_all(template, provider.catalog[:2])
+        for c in cfgs:
+            assert c.name not in lt.cached_names()
+            assert c.name not in provider.launch_templates
+
+    def test_hydration_adopts_existing(self, provider, template):
+        lt1 = provider.launch_template_provider
+        cfgs = lt1.ensure_all(template, provider.catalog[:2])
+        # fresh provider-cache instance (operator restart) over the same store
+        lt2 = LaunchTemplateProvider(store=provider, resolver=ImageResolver(provider))
+        created_before = len(provider.launch_templates)
+        cfgs2 = lt2.ensure_all(template, provider.catalog[:2])
+        assert {c.name for c in cfgs2} == {c.name for c in cfgs}
+        assert len(provider.launch_templates) == created_before
+
+
+class TestLaunchPath:
+    def test_launch_stamps_config(self, provider, template):
+        provider.node_template_lookup = {"default": template}.get
+        m = provider.create(_machine(provider))
+        inst = provider.instance_for(m)
+        assert inst.launch_template.startswith(NAME_PREFIX)
+        assert inst.image_family == "al2"
+        assert inst.image_id.startswith("img-al2-")
+        assert m.meta.annotations[wk.LAUNCH_TEMPLATE_ANNOTATION] == inst.launch_template
+
+    def test_no_template_ref_keeps_legacy_image(self, provider):
+        provider.node_template_lookup = {}.get
+        m = provider.create(_machine(provider, template_ref=None))
+        inst = provider.instance_for(m)
+        assert inst.launch_template == ""
+        assert inst.image_id == "image-001"
+
+    def test_accelerator_variant_selected(self, template):
+        from karpenter_tpu.cloudprovider.imagefamily import is_accelerator
+
+        catalog = generate_catalog()  # full catalog includes tpu-v5e/v5p types
+        accel = [it for it in catalog if is_accelerator(it.capacity)]
+        assert accel, "catalog should include accelerator shapes"
+        provider = FakeCloudProvider(catalog=catalog)
+        provider.node_template_lookup = {"default": template}.get
+        it = accel[0]
+        m = Machine(
+            meta=ObjectMeta(name="m-acc"),
+            provisioner_name="default",
+            requirements=Requirements([Requirement.in_values(wk.INSTANCE_TYPE, [it.name])]),
+            requests=Resources(cpu="100m"),
+            node_template_ref="default",
+        )
+        m = provider.create(m)
+        inst = provider.instance_for(m)
+        assert inst.image_variant == "accelerator"
+        assert "accelerator" in inst.image_id
+
+
+class TestPerFamilyDrift:
+    def test_image_rotation_drifts_only_that_family_variant(self, provider, template):
+        provider.node_template_lookup = {"default": template}.get
+        m = provider.create(_machine(provider))
+        assert not provider.is_machine_drifted(m)
+        provider.rotate_image("ubuntu", "standard")  # other family: no drift
+        assert not provider.is_machine_drifted(m)
+        provider.rotate_image("al2", "accelerator")  # other variant: no drift
+        assert not provider.is_machine_drifted(m)
+        provider.rotate_image("al2", "standard")
+        assert provider.is_machine_drifted(m)
+
+    def test_userdata_change_drifts(self, provider, template):
+        provider.node_template_lookup = {"default": template}.get
+        m = provider.create(_machine(provider))
+        assert not provider.is_machine_drifted(m)
+        template.user_data = "#!/bin/bash\nnew-generation"
+        assert provider.is_machine_drifted(m)
+
+    def test_taints_in_userdata_stable_across_drift_checks(self, provider, template):
+        provider.node_template_lookup = {"default": template}.get
+        m = provider.create(
+            _machine(provider, taints=[Taint(key="team", value="web")])
+        )
+        # label stamping at launch must not flip the config hash afterwards
+        assert not provider.is_machine_drifted(m)
+
+    def test_legacy_drift_still_works(self, provider):
+        provider.node_template_lookup = {}.get
+        m = provider.create(_machine(provider, template_ref=None))
+        assert not provider.is_machine_drifted(m)
+        provider.rotate_image()
+        assert provider.is_machine_drifted(m)

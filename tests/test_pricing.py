@@ -1,0 +1,175 @@
+"""Pricing subsystem: live spot/on-demand refresh, static fallback, cache
+invalidation, and consolidation triggered by a price change. Reference:
+pricing.go:85 (fallback table), :177-283 (on-demand refresh), :381-437
+(spot refresh per (type, zone))."""
+
+import pytest
+
+from karpenter_tpu.api import Machine, ObjectMeta, Pod, Provisioner, Requirement, Requirements, Resources
+from karpenter_tpu.api import labels as wk
+from karpenter_tpu.api.settings import Settings
+from karpenter_tpu.cloudprovider import FakeCloudProvider, generate_catalog
+from karpenter_tpu.cloudprovider.pricing import (
+    ON_DEMAND_REFRESH_INTERVAL,
+    SPOT_REFRESH_INTERVAL,
+    PricingController,
+    PricingProvider,
+)
+from karpenter_tpu.controllers.deprovisioning import DeprovisioningController
+from karpenter_tpu.controllers.provisioning import ProvisioningController, register_node
+from karpenter_tpu.controllers.termination import TerminationController
+from karpenter_tpu.state import Cluster
+from karpenter_tpu.utils.cache import FakeClock
+
+
+@pytest.fixture
+def catalog():
+    return generate_catalog(n_types=30)
+
+
+class TestPricingProvider:
+    def test_initial_prices_match_catalog(self, catalog):
+        p = PricingProvider(catalog)
+        it = catalog[0]
+        for o in it.offerings:
+            assert p.price(it.name, o.zone, o.capacity_type) == o.price
+
+    def test_spot_refresh_moves_prices_deterministically(self, catalog):
+        p1, p2 = PricingProvider(catalog), PricingProvider(catalog)
+        p1.update_spot_prices()
+        p2.update_spot_prices()
+        it = catalog[0]
+        o = next(o for o in it.offerings if o.capacity_type == wk.CAPACITY_TYPE_SPOT)
+        assert p1.spot_price(it.name, o.zone) == p2.spot_price(it.name, o.zone)
+        moved = sum(
+            1
+            for it in catalog
+            for o in it.offerings
+            if o.capacity_type == wk.CAPACITY_TYPE_SPOT
+            and p1.spot_price(it.name, o.zone) != o.price
+        )
+        assert moved > 0
+        assert p1.version == 1
+
+    def test_outage_serves_last_known_then_fallback(self, catalog):
+        p = PricingProvider(catalog)
+        p.update_spot_prices()
+        it = catalog[0]
+        o = next(o for o in it.offerings if o.capacity_type == wk.CAPACITY_TYPE_SPOT)
+        live = p.spot_price(it.name, o.zone)
+        p.api_available = False
+        assert not p.update_spot_prices()
+        assert p.spot_price(it.name, o.zone) == live  # last-known keeps serving
+        p.reset_to_fallback()
+        assert p.spot_price(it.name, o.zone) == o.price  # static table
+
+    def test_on_demand_refresh_bounded(self, catalog):
+        p = PricingProvider(catalog)
+        p.update_on_demand_prices()
+        for it in catalog:
+            od = next(o for o in it.offerings if o.capacity_type == wk.CAPACITY_TYPE_ON_DEMAND)
+            live = p.on_demand_price(it.name)
+            assert abs(live - od.price) <= od.price * 0.021
+
+    def test_controller_cadence(self, catalog):
+        clock = FakeClock(start=0.0)
+        p = PricingProvider(catalog)
+        p.last_spot_update = 0.0
+        p.last_od_update = 0.0
+        ctl = PricingController(p, clock=lambda: clock.now())
+        assert ctl.reconcile() == []  # nothing due yet
+        clock.step(SPOT_REFRESH_INTERVAL + 1)
+        assert ctl.reconcile() == ["spot"]
+        clock.step(ON_DEMAND_REFRESH_INTERVAL)
+        assert set(ctl.reconcile()) == {"spot", "on-demand"}
+
+
+class TestProviderIntegration:
+    def test_refresh_invalidates_instance_type_cache(self, catalog):
+        provider = FakeCloudProvider(catalog=catalog)
+        prov = Provisioner(meta=ObjectMeta(name="d"))
+        types1 = provider.get_instance_types(prov)
+        assert provider.get_instance_types(prov) is types1  # cached
+        provider.pricing.update_spot_prices()
+        types2 = provider.get_instance_types(prov)
+        assert types2 is not types1
+        # offerings now carry the refreshed prices
+        name = types2[0].name
+        spot = next(
+            o for o in types2[0].offerings if o.capacity_type == wk.CAPACITY_TYPE_SPOT
+        )
+        assert spot.price == provider.pricing.spot_price(name, spot.zone)
+
+    def test_launch_orders_by_live_price(self, catalog):
+        provider = FakeCloudProvider(catalog=catalog)
+        types = sorted(catalog, key=lambda t: min(o.price for o in t.offerings))
+        cheap, nxt = types[0], types[1]
+        # make the catalog-cheapest type expensive live: launches must avoid it
+        for zone in ("zone-a", "zone-b", "zone-c"):
+            provider.pricing.set_spot_price(cheap.name, zone, 99.0)
+        m = Machine(
+            meta=ObjectMeta(name="m1"),
+            provisioner_name="d",
+            requirements=Requirements(
+                [Requirement.in_values(wk.INSTANCE_TYPE, [cheap.name, nxt.name])]
+            ),
+            requests=Resources(cpu="100m"),
+        )
+        m = provider.create(m)
+        assert m.meta.labels[wk.INSTANCE_TYPE] != cheap.name
+
+
+class TestConsolidationOnPriceChange:
+    def test_spot_price_drop_triggers_replace(self):
+        """A running node becomes consolidatable when a cheaper offering
+        appears after a spot price refresh — the scenario the reference's
+        pricing loop exists to enable."""
+        catalog = generate_catalog(n_types=40)
+        provider = FakeCloudProvider(catalog=catalog)
+        cluster = Cluster()
+        settings = Settings(
+            batch_idle_duration=0, batch_max_duration=0,
+            consolidation_validation_ttl=0, stabilization_window=0,
+        )
+        clock = FakeClock(start=100_000.0)
+        # on-demand only: spot nodes are delete-only in consolidation
+        # (deprovisioning.md:83-85), so the replace path needs an OD node
+        prov = Provisioner(
+            meta=ObjectMeta(name="default"),
+            consolidation_enabled=True,
+            requirements=Requirements(
+                [Requirement.in_values(wk.CAPACITY_TYPE, [wk.CAPACITY_TYPE_ON_DEMAND])]
+            ),
+        )
+        cluster.add_provisioner(prov)
+        prov_ctl = ProvisioningController(cluster, provider, settings=settings)
+        term = TerminationController(cluster, provider, clock=clock)
+        deprov = DeprovisioningController(
+            cluster, provider, term, solver=prov_ctl.solver, settings=settings,
+            clock=clock,
+        )
+        # one pod that fits anywhere; provisioning picks the cheapest offering
+        pod = Pod(meta=ObjectMeta(name="p1", owner_kind="ReplicaSet"),
+                  requests=Resources(cpu="200m", memory="256Mi"))
+        cluster.add_pod(pod)
+        res = prov_ctl.reconcile()
+        assert len(res.nodes) == 1
+        node = res.nodes[0]
+        launched_type = node.instance_type()
+        launched_price = deprov._node_price(node)
+        # a decisive price change: another type's on-demand price collapses
+        others = [it for it in catalog if it.name != launched_type
+                  and pod.requests.fits(it.allocatable())]
+        target = min(others, key=lambda t: min(o.price for o in t.offerings))
+        provider.pricing.set_on_demand_price(target.name, 0.0001)
+        for _ in range(10):
+            action = deprov.reconcile()
+            prov_ctl.reconcile()
+            term.reconcile()
+            clock.step(30)
+            if action is None and deprov.pending_action is None:
+                break
+        bound = [p for p in cluster.pods.values() if p.node_name is not None]
+        assert len(bound) == 1
+        new_node = cluster.nodes[bound[0].node_name]
+        assert deprov._node_price(new_node) < launched_price

@@ -1,0 +1,216 @@
+"""Cluster-wide topology seeding + startup-taint scheduling semantics.
+
+Regression tests for the two round-1 advisor findings: (1) a second
+provisioning cycle must count pods bound in the first cycle toward
+DoNotSchedule spread/anti-affinity domains; (2) startup taints must not
+exclude non-tolerating pods from existing capacity forever."""
+
+import pytest
+
+from karpenter_tpu.api import (
+    ObjectMeta,
+    Pod,
+    PodAffinityTerm,
+    Provisioner,
+    Resources,
+    Taint,
+    TopologySpreadConstraint,
+)
+from karpenter_tpu.api import labels as wk
+from karpenter_tpu.cloudprovider import FakeCloudProvider, generate_catalog
+from karpenter_tpu.controllers.provisioning import ProvisioningController
+from karpenter_tpu.solver import GreedySolver, TPUSolver, encode, validate
+from karpenter_tpu.solver.solver import _water_fill
+from karpenter_tpu.state import Cluster
+
+import numpy as np
+
+
+def _spread_pod(name, app="web", cpu="250m"):
+    return Pod(
+        meta=ObjectMeta(name=name, labels={"app": app}),
+        requests=Resources(cpu=cpu, memory="256Mi"),
+        topology_spread=[
+            TopologySpreadConstraint(
+                max_skew=1, topology_key=wk.ZONE, label_selector={"app": app}
+            )
+        ],
+    )
+
+
+def _anti_pod(name, app="db"):
+    return Pod(
+        meta=ObjectMeta(name=name, labels={"app": app}),
+        requests=Resources(cpu="500m", memory="512Mi"),
+        affinity_terms=[
+            PodAffinityTerm(
+                label_selector={"app": app}, topology_key=wk.HOSTNAME, anti=True
+            )
+        ],
+    )
+
+
+class TestWaterFill:
+    def test_no_seeds_is_equal_split(self):
+        out = _water_fill(10, np.zeros(3, np.int64), np.ones(3, bool))
+        assert sorted(out.tolist()) == [3, 3, 4]
+        assert out.sum() == 10
+
+    def test_seeds_level_first(self):
+        # zone levels 5/1/0 -> 6 new pods should land 0/2/4 (final 5/3/4? no:
+        # water fill equalizes: final levels 4/4/4 -> new 0/3/4 = 7... with 6:
+        # finals {5,1,0}+new sum 6 -> levels (0:4,1:4,5:0) -> new 3 to z2, ...
+        seeds = np.array([5, 1, 0], np.int64)
+        out = _water_fill(6, seeds, np.ones(3, bool))
+        finals = seeds + out
+        assert out.sum() == 6
+        assert finals.max() - finals[finals < seeds.max()].min() <= 1 or finals.max() == 5
+
+    def test_unavailable_zone_gets_zero(self):
+        avail = np.array([True, False, True])
+        out = _water_fill(4, np.zeros(3, np.int64), avail)
+        assert out[1] == 0 and out.sum() == 4
+
+    def test_big_seed_zone_excluded(self):
+        seeds = np.array([100, 0, 0], np.int64)
+        out = _water_fill(10, seeds, np.ones(3, bool))
+        assert out[0] == 0 and out.sum() == 10
+
+
+class TestSecondCycleSpread:
+    def test_second_cycle_respects_seeded_zone_counts(self):
+        """Cycle 1 binds 9 spread pods (3/zone); cycle 2 adds 3 more — every
+        valid outcome levels zones to 4/4/4, never 5+ in one zone."""
+        provider = FakeCloudProvider(catalog=generate_catalog(n_types=30))
+        cluster = Cluster()
+        cluster.add_provisioner(Provisioner(meta=ObjectMeta(name="default")))
+        ctl = ProvisioningController(cluster, provider)
+        for i in range(9):
+            cluster.add_pod(_spread_pod(f"a-{i}"))
+        res1 = ctl.reconcile()
+        assert not res1.unschedulable
+        def zone_counts():
+            counts = {}
+            for p in cluster.pods.values():
+                if p.node_name:
+                    z = cluster.nodes[p.node_name].zone()
+                    counts[z] = counts.get(z, 0) + 1
+            return counts
+        c1 = zone_counts()
+        assert max(c1.values()) - min(c1.values()) <= 1
+        for i in range(3):
+            cluster.add_pod(_spread_pod(f"b-{i}"))
+        res2 = ctl.reconcile()
+        assert not res2.unschedulable
+        c2 = zone_counts()
+        assert sum(c2.values()) == 12
+        assert max(c2.values()) - min(c2.values()) <= 1, c2
+
+    def test_seeded_validation_catches_skew(self):
+        """validate() flags a placement that looks balanced in-batch but tips
+        the cluster-wide skew."""
+        provider = FakeCloudProvider(catalog=generate_catalog(n_types=30))
+        cluster = Cluster()
+        cluster.add_provisioner(Provisioner(meta=ObjectMeta(name="default")))
+        ctl = ProvisioningController(cluster, provider)
+        for i in range(4):
+            cluster.add_pod(_spread_pod(f"a-{i}"))
+        ctl.reconcile()
+        existing = cluster.existing_capacity()
+        assert any(e.pods for e in existing)
+        new_pods = [_spread_pod(f"b-{i}") for i in range(2)]
+        prov = list(cluster.provisioners.values())[0]
+        problem = encode(new_pods, [(prov, provider.get_instance_types(prov))], existing)
+        assert problem.zone_seed is not None
+        assert problem.zone_seed.sum() == 4
+        result = TPUSolver(portfolio=8, latency_budget_s=10.0).solve(problem)
+        assert validate(problem, result) == []
+
+    def test_second_cycle_anti_affinity_avoids_seeded_nodes(self):
+        provider = FakeCloudProvider(catalog=generate_catalog(n_types=30))
+        cluster = Cluster()
+        cluster.add_provisioner(Provisioner(meta=ObjectMeta(name="default")))
+        ctl = ProvisioningController(cluster, provider)
+        for i in range(3):
+            cluster.add_pod(_anti_pod(f"d-{i}"))
+        res1 = ctl.reconcile()
+        assert not res1.unschedulable
+        for i in range(2):
+            cluster.add_pod(_anti_pod(f"e-{i}"))
+        res2 = ctl.reconcile()
+        assert not res2.unschedulable
+        # every node hosts at most one db pod, cluster-wide
+        for n in cluster.nodes.values():
+            db = [p for p in cluster.pods_on_node(n.name) if p.meta.labels.get("app") == "db"]
+            assert len(db) <= 1, n.name
+
+    def test_colocate_pins_to_existing_domain(self):
+        provider = FakeCloudProvider(catalog=generate_catalog(n_types=30))
+        cluster = Cluster()
+        cluster.add_provisioner(Provisioner(meta=ObjectMeta(name="default")))
+        ctl = ProvisioningController(cluster, provider)
+        def coloc(name):
+            return Pod(
+                meta=ObjectMeta(name=name, labels={"app": "pair"}),
+                requests=Resources(cpu="100m", memory="128Mi"),
+                affinity_terms=[
+                    PodAffinityTerm(label_selector={"app": "pair"},
+                                    topology_key=wk.HOSTNAME, anti=False)
+                ],
+            )
+        cluster.add_pod(coloc("c-0"))
+        res1 = ctl.reconcile()
+        assert not res1.unschedulable
+        host = cluster.pods["c-0"].node_name
+        cluster.add_pod(coloc("c-1"))
+        res2 = ctl.reconcile()
+        assert not res2.unschedulable
+        assert cluster.pods["c-1"].node_name == host
+
+
+class TestStartupTaints:
+    def test_existing_capacity_reusable_despite_startup_taints(self):
+        provider = FakeCloudProvider(catalog=generate_catalog(n_types=30))
+        cluster = Cluster()
+        prov = Provisioner(
+            meta=ObjectMeta(name="default"),
+            startup_taints=[Taint(key="cni.example.com/uninitialized", value="true")],
+        )
+        cluster.add_provisioner(prov)
+        ctl = ProvisioningController(cluster, provider)
+        cluster.add_pod(Pod(meta=ObjectMeta(name="p-0"),
+                            requests=Resources(cpu="100m", memory="128Mi")))
+        res1 = ctl.reconcile()
+        assert len(res1.nodes) == 1
+        node = res1.nodes[0]
+        assert any(t.key == "cni.example.com/uninitialized" for t in node.taints)
+        # a second tiny pod WITHOUT tolerations must reuse the node, not
+        # scale up forever
+        cluster.add_pod(Pod(meta=ObjectMeta(name="p-1"),
+                            requests=Resources(cpu="100m", memory="128Mi")))
+        res2 = ctl.reconcile()
+        assert not res2.unschedulable
+        assert res2.nodes == []  # no new node
+        assert cluster.pods["p-1"].node_name == node.name
+
+    def test_real_provisioner_taints_still_exclude(self):
+        provider = FakeCloudProvider(catalog=generate_catalog(n_types=30))
+        cluster = Cluster()
+        prov = Provisioner(
+            meta=ObjectMeta(name="default"),
+            taints=[Taint(key="team", value="ml")],
+        )
+        cluster.add_provisioner(prov)
+        ctl = ProvisioningController(cluster, provider)
+        from karpenter_tpu.api import Toleration
+
+        cluster.add_pod(Pod(meta=ObjectMeta(name="tol-0"),
+                            requests=Resources(cpu="100m", memory="128Mi"),
+                            tolerations=[Toleration(key="team", operator="Equal", value="ml")]))
+        res1 = ctl.reconcile()
+        assert len(res1.nodes) == 1
+        cluster.add_pod(Pod(meta=ObjectMeta(name="plain"),
+                            requests=Resources(cpu="100m", memory="128Mi")))
+        res2 = ctl.reconcile()
+        # the non-tolerating pod must NOT reuse the tainted node
+        assert cluster.pods["plain"].node_name != res1.nodes[0].name
