@@ -277,6 +277,61 @@ def bench_consolidation(n_nodes=200, pods_per_node=3, max_passes=40):
     }
 
 
+def bench_interruption(sizes=(100, 1000, 5000, 15000)):
+    """Interruption message throughput (reference
+    interruption_benchmark_test.go:60-74 runs 100/1k/5k/15k messages):
+    spot-interruption events against a fleet, measured msgs/sec end-to-end
+    (parse -> node map -> ICE mark -> delete+drain pass)."""
+    from karpenter_tpu.api import Machine, ObjectMeta, Provisioner, Requirement, Requirements, Resources
+    from karpenter_tpu.api import labels as wk
+    from karpenter_tpu.cloudprovider import FakeCloudProvider, generate_catalog
+    from karpenter_tpu.controllers.interruption import FakeQueue, InterruptionController
+    from karpenter_tpu.controllers.provisioning import register_node
+    from karpenter_tpu.controllers.termination import TerminationController
+    from karpenter_tpu.state import Cluster
+    from karpenter_tpu.utils.cache import FakeClock
+
+    out = {}
+    for n in sizes:
+        provider = FakeCloudProvider(catalog=generate_catalog(n_types=20))
+        for s in provider.subnets:  # size subnets for a 15k fleet
+            s.available_ips = 1 << 20
+        cluster = Cluster()
+        prov = Provisioner(meta=ObjectMeta(name="default"))
+        cluster.add_provisioner(prov)
+        clock = FakeClock(start=0.0)
+        term = TerminationController(cluster, provider, clock=clock)
+        queue = FakeQueue()
+        ctl = InterruptionController(
+            cluster, queue, term, unavailable_offerings=provider.unavailable_offerings
+        )
+        it = provider.catalog[0]
+        for i in range(n):
+            machine = Machine(
+                meta=ObjectMeta(name=f"m-{i}", labels=dict(prov.labels)),
+                provisioner_name=prov.name,
+                requirements=Requirements([
+                    Requirement.in_values(wk.INSTANCE_TYPE, [it.name]),
+                    Requirement.in_values(wk.CAPACITY_TYPE, [wk.CAPACITY_TYPE_SPOT]),
+                ]),
+                requests=Resources(cpu="100m"),
+            )
+            machine = provider.create(machine)
+            cluster.add_machine(machine)
+            node = register_node(cluster, machine, prov)
+            queue.send({
+                "version": "0", "source": "cloud.compute",
+                "detail-type": "Spot Instance Interruption Warning",
+                "detail": {"instance-id": machine.status.provider_id.rsplit("/", 1)[-1]},
+            })
+        t0 = time.perf_counter()
+        while len(queue):
+            ctl.reconcile(max_messages=100)
+        elapsed = time.perf_counter() - t0
+        out[str(n)] = round(n / elapsed, 1)
+    return {"messages_per_sec": out}
+
+
 def bench_config(name, make, repeats=REPEATS):
     from karpenter_tpu.solver import TPUSolver, best_lower_bound, encode, validate
 
@@ -341,6 +396,17 @@ def main():
         details["consolidation"] = bench_consolidation()
     except Exception as e:
         details["consolidation"] = {"error": f"{type(e).__name__}: {e}"}
+    try:
+        details["interruption"] = bench_interruption()
+    except Exception as e:
+        details["interruption"] = {"error": f"{type(e).__name__}: {e}"}
+    try:
+        from karpenter_tpu.solver.solver import TPUSolver as _S
+
+        rtt = _S.device_rtt()
+        details["device_rtt_ms"] = round(rtt * 1e3, 1) if rtt != float("inf") else None
+    except Exception:
+        details["device_rtt_ms"] = None
     head = details.get("50k_full", {})
     p50 = head.get("solve_p50_ms", float("nan"))
     line = {

@@ -453,14 +453,16 @@ class FakeCloudProvider(CloudProvider):
             nt = self.node_template_lookup(machine.node_template_ref)
             it = self._by_name.get(instance.instance_type)
             if nt is not None and it is not None:
-                cfgs = self.launch_template_provider.ensure_all(
+                # read-only resolution: a drift poll must not create or
+                # TTL-refresh provider-side templates
+                names = self.launch_template_provider.resolve_names(
                     nt,
                     [it],
                     taints=tuple(machine.taints),
                     labels=_bootstrap_labels(machine.meta.labels),
                     kubelet=machine.kubelet,
                 )
-                if cfgs and all(c.name != instance.launch_template for c in cfgs):
+                if names and instance.launch_template not in names:
                     return True
         return False
 

@@ -184,6 +184,29 @@ class LaunchTemplateProvider:
                 out.append(cfg)
         return out
 
+    def resolve_names(
+        self,
+        node_template: NodeTemplate,
+        instance_types: Sequence,
+        taints: Sequence[Taint] = (),
+        labels: Optional[Dict[str, str]] = None,
+        kubelet: Optional[KubeletConfiguration] = None,
+    ) -> List[str]:
+        """The content-hash names ensure_all WOULD produce, with no store
+        writes or cache touches — the read-only form drift detection needs
+        (a pure predicate must not create provider-side templates)."""
+        ctx = BootstrapContext(
+            cluster=self.cluster,
+            kubelet=kubelet,
+            taints=tuple(taints),
+            labels=dict(labels or {}),
+        )
+        specs = self.resolver.resolve(node_template, instance_types, ctx)
+        sgs = tuple(node_template.resolved_security_groups)
+        return [
+            _content_name(spec, sgs, node_template.metadata_options) for spec in specs
+        ]
+
     def cached_names(self) -> List[str]:
         with self._lock:
             return sorted(self._cache)

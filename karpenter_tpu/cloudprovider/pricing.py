@@ -54,6 +54,7 @@ class PricingProvider:
         self._od: Dict[str, float] = dict(self._fallback_od)
         self._spot: Dict[Tuple[str, str], float] = dict(self._fallback_spot)
         self._tick = 0
+        self._od_tick = 0
         self.version = 0  # seqnum: bumps on every successful refresh
         self.api_available = True  # fake outage switch
         self.last_spot_update: float = 0.0
@@ -94,9 +95,12 @@ class PricingProvider:
         if not self.api_available:
             return False
         with self._lock:
-            # on-demand moves far less than spot: +-2% around the anchor
+            # on-demand moves far less than spot: +-2% around the anchor.
+            # Its own tick — consecutive OD refreshes must re-quote, not
+            # replay the last spot generation's walk.
+            self._od_tick += 1
             for name, anchor in self._fallback_od.items():
-                drift = _walk(name, "", self._tick)
+                drift = _walk(name, "od", self._od_tick)
                 self._od[name] = round(anchor * (0.98 + 0.04 * (drift - 0.75) / 0.5), 6)
             self.version += 1
             self.last_od_update = now

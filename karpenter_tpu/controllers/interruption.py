@@ -165,22 +165,46 @@ class InterruptionController:
         self.recorder = recorder or Recorder()
         self.parsers = ParserRegistry()
 
+    #: concurrent message workers, matching the reference's 10-way
+    #: reconciler (controller.go:101 MaxConcurrentReconciles)
+    WORKERS = 10
+
     def reconcile(self, max_messages: int = 10) -> int:
-        """One poll cycle; returns the number of messages handled."""
-        handled = 0
+        """One poll cycle; returns the number of messages handled. Messages
+        fan out over a worker pool — parsing and handling are independent per
+        message; node deletion and the termination pass serialize internally
+        (cluster lock / termination queue)."""
         messages = self.queue.receive(max_messages)
+        if not messages:
+            return 0
         node_by_instance = self._instance_id_map()
-        for msg in messages:
+
+        acted = []
+
+        def one(msg) -> int:
             try:
                 parsed = self.parsers.parse(json.loads(msg.body))
             except (json.JSONDecodeError, TypeError):
                 metrics.INTERRUPTION_MESSAGES.inc({"kind": "unparseable"})
                 self.queue.delete(msg.id)
-                continue
-            self._handle(parsed, node_by_instance)
+                return 0
+            if self._handle(parsed, node_by_instance):
+                acted.append(True)
             metrics.INTERRUPTION_MESSAGES.inc({"kind": parsed.kind})
             self.queue.delete(msg.id)
-            handled += 1
+            return 1
+
+        if len(messages) == 1:
+            handled = one(messages[0])
+        else:
+            from concurrent.futures import ThreadPoolExecutor
+
+            with ThreadPoolExecutor(max_workers=min(self.WORKERS, len(messages))) as pool:
+                handled = sum(pool.map(one, messages))
+        if acted:
+            # ONE drain pass for the whole batch (delete_node marks nodes;
+            # the termination finalizer serializes the actual work)
+            self.termination.reconcile()
         return handled
 
     def _instance_id_map(self) -> Dict[str, str]:
@@ -192,11 +216,14 @@ class InterruptionController:
                 out[node.provider_id.rsplit("/", 1)[-1]] = node.name
         return out
 
-    def _handle(self, parsed: ParsedMessage, node_by_instance: Dict[str, str]) -> None:
+    def _handle(self, parsed: ParsedMessage, node_by_instance: Dict[str, str]) -> bool:
+        """Apply one parsed message; returns True when a node was marked for
+        deletion (the caller runs one termination pass per batch)."""
         if parsed.kind == "noop":
-            return
+            return False
         if parsed.kind == "state-change" and parsed.detail not in ACTIONABLE_STATES:
-            return
+            return False
+        acted = False
         for instance_id in parsed.instance_ids:
             node_name = node_by_instance.get(instance_id)
             if node_name is None:
@@ -218,5 +245,5 @@ class InterruptionController:
                     reason="spot-interruption",
                 )
             self.termination.delete_node(node_name)
-        if parsed.kind != "rebalance":
-            self.termination.reconcile()
+            acted = True
+        return acted

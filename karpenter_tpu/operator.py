@@ -131,10 +131,29 @@ class Operator:
         self.garbagecollect.reconcile()
 
     # -- continuous run -----------------------------------------------------
-    def run(self, stop: threading.Event, tick: float = 0.25) -> None:
+    def run(
+        self, stop: threading.Event, tick: float = 0.25, http_port: Optional[int] = None
+    ) -> None:
         """Drive the loops until `stop` is set. Cadences follow the reference:
         provisioning honors its batch window; slow loops (nodetemplate 5m, GC 5m,
-        drift 5m) tick on their own schedule."""
+        drift 5m) tick on their own schedule. ``http_port`` serves /metrics,
+        /healthz and /readyz for the lifetime of the loop (the reference's
+        manager endpoints, cmd/controller/main.go:33-71); 0 picks a free port,
+        exposed as ``self.http_server.port``."""
+        self.http_server = None
+        if http_port is not None:
+            from .utils.httpserver import OperatorHTTPServer
+
+            self.http_server = OperatorHTTPServer(port=http_port).start()
+        try:
+            self._run_loop(stop, tick)
+        finally:
+            # ALWAYS release the port — a crashed loop must not keep serving
+            # ready probes (or block a supervised restart with EADDRINUSE)
+            if self.http_server is not None:
+                self.http_server.stop()
+
+    def _run_loop(self, stop: threading.Event, tick: float) -> None:
         from .utils.gctuning import freeze_long_lived
 
         last_slow = 0.0

@@ -92,7 +92,11 @@ class Cluster:
         return self._delete(self.machines, name)
 
     def add_provisioner(self, provisioner: Provisioner) -> Provisioner:
-        provisioner.validate()
+        # admission chain (defaulting + validation) — the write chokepoint a
+        # webhook occupies in the reference (webhooks.go:34-63)
+        from ..api.admission import admit_provisioner
+
+        admit_provisioner(provisioner)
         self._put(self.provisioners, provisioner, provisioner.name)
         return provisioner
 
@@ -100,6 +104,9 @@ class Cluster:
         return self._delete(self.provisioners, name)
 
     def add_node_template(self, t: NodeTemplate) -> NodeTemplate:
+        from ..api.admission import admit_node_template
+
+        admit_node_template(t)
         self._put(self.node_templates, t, t.name)
         return t
 
@@ -168,12 +175,24 @@ class Cluster:
         """In-flight capacity view for the solver: every managed node with its
         remaining allocatable and its bound pods. Cordoned/deleting nodes are
         included — the encoder marks them unschedulable (no NEW placements)
-        but their bound pods still seed topology domain counts."""
+        but their bound pods still seed topology domain counts. ONE pass over
+        the pod map feeds both the seed lists and the remaining-resource
+        computation (N nodes x P pods would otherwise scan P per node)."""
+        with self._lock:
+            by_node: Dict[str, List[Pod]] = {}
+            for p in self.pods.values():
+                if p.node_name is not None:
+                    by_node.setdefault(p.node_name, []).append(p)
         out = []
         for n in self.managed_nodes():
-            pods = tuple(p for p in self.pods_on_node(n.name) if not p.is_daemonset)
+            bound = by_node.get(n.name, ())
+            used = merge([p.requests + Resources(pods=1) for p in bound])
             out.append(
-                ExistingNode(node=n, remaining=self.node_remaining(n), pods=pods)
+                ExistingNode(
+                    node=n,
+                    remaining=(n.allocatable - used).clamp_min_zero(),
+                    pods=tuple(p for p in bound if not p.is_daemonset),
+                )
             )
         return out
 

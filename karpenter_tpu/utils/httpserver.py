@@ -1,0 +1,76 @@
+"""Operator HTTP surface: /metrics, /healthz, /readyz.
+
+Rebuild of the reference's manager endpoints
+(``/root/reference/cmd/controller/main.go:33-71`` wires the metrics server on
+:8080 and health probes on :8081 through controller-runtime): a small stdlib
+HTTP server exposing the Prometheus exposition of ``utils.metrics.REGISTRY``
+plus liveness/readiness probes backed by operator-supplied callables.
+"""
+
+from __future__ import annotations
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Optional
+
+from .metrics import REGISTRY, Registry
+
+
+class OperatorHTTPServer:
+    def __init__(
+        self,
+        port: int = 0,
+        registry: Optional[Registry] = None,
+        ready_check: Optional[Callable[[], bool]] = None,
+        healthy_check: Optional[Callable[[], bool]] = None,
+    ):
+        self.registry = registry or REGISTRY
+        self.ready_check = ready_check or (lambda: True)
+        self.healthy_check = healthy_check or (lambda: True)
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self) -> None:  # noqa: N802 (http.server API)
+                path = self.path.split("?", 1)[0]
+                if path == "/metrics":
+                    body = outer.registry.exposition().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/plain; version=0.0.4")
+                elif path == "/healthz":
+                    ok = outer.healthy_check()
+                    body = (b"ok" if ok else b"unhealthy") + b"\n"
+                    self.send_response(200 if ok else 503)
+                    self.send_header("Content-Type", "text/plain")
+                elif path == "/readyz":
+                    ok = outer.ready_check()
+                    body = (b"ok" if ok else b"not ready") + b"\n"
+                    self.send_response(200 if ok else 503)
+                    self.send_header("Content-Type", "text/plain")
+                else:
+                    body = b"not found\n"
+                    self.send_response(404)
+                    self.send_header("Content-Type", "text/plain")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, fmt, *args) -> None:  # quiet by default
+                pass
+
+        self._server = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    def start(self) -> "OperatorHTTPServer":
+        self._thread = threading.Thread(target=self._server.serve_forever, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)

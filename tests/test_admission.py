@@ -1,0 +1,109 @@
+"""Admission layer (defaulting + validation) at the cluster write chokepoint.
+Reference: webhooks.go:34-63, provider_validation.go, provisioner_validation."""
+
+import pytest
+
+from karpenter_tpu.api import ObjectMeta, Provisioner, Requirement, Requirements, Resources, Taint
+from karpenter_tpu.api import labels as wk
+from karpenter_tpu.api.admission import (
+    AdmissionError,
+    admit_node_template,
+    admit_provisioner,
+)
+from karpenter_tpu.api.objects import BlockDeviceMapping, NodeTemplate
+from karpenter_tpu.state import Cluster
+
+
+class TestProvisionerAdmission:
+    def test_defaults_taint_effect(self):
+        p = Provisioner(meta=ObjectMeta(name="p"), taints=[Taint(key="team", value="a", effect="")])
+        admit_provisioner(p)
+        assert p.taints[0].effect == "NoSchedule"
+
+    def test_negative_ttl_rejected(self):
+        p = Provisioner(meta=ObjectMeta(name="p"), ttl_seconds_after_empty=-5)
+        with pytest.raises(AdmissionError, match="ttlSecondsAfterEmpty"):
+            admit_provisioner(p)
+
+    def test_consolidation_and_empty_ttl_exclusive(self):
+        p = Provisioner(meta=ObjectMeta(name="p"), consolidation_enabled=True,
+                        ttl_seconds_after_empty=30)
+        with pytest.raises(AdmissionError, match="mutually exclusive"):
+            admit_provisioner(p)
+
+    def test_restricted_requirement_rejected(self):
+        p = Provisioner(
+            meta=ObjectMeta(name="p"),
+            requirements=Requirements(
+                [Requirement.in_values(wk.PROVISIONER_NAME, ["other"])]
+            ),
+        )
+        with pytest.raises(AdmissionError, match="restricted label"):
+            admit_provisioner(p)
+
+    def test_unknown_capacity_type_rejected(self):
+        p = Provisioner(
+            meta=ObjectMeta(name="p"),
+            requirements=Requirements(
+                [Requirement.in_values(wk.CAPACITY_TYPE, ["preemptible"])]
+            ),
+        )
+        with pytest.raises(AdmissionError, match="capacity type"):
+            admit_provisioner(p)
+
+    def test_weight_bounds(self):
+        with pytest.raises(AdmissionError, match="weight"):
+            admit_provisioner(Provisioner(meta=ObjectMeta(name="p"), weight=101))
+
+    def test_bad_taint_effect_rejected(self):
+        p = Provisioner(meta=ObjectMeta(name="p"),
+                        taints=[Taint(key="k", value="v", effect="Sideways")])
+        with pytest.raises(AdmissionError, match="taint effect"):
+            admit_provisioner(p)
+
+    def test_negative_limit_rejected(self):
+        p = Provisioner(meta=ObjectMeta(name="p"), limits=Resources(cpu=-1))
+        with pytest.raises(AdmissionError, match="limits"):
+            admit_provisioner(p)
+
+    def test_all_errors_reported_together(self):
+        p = Provisioner(meta=ObjectMeta(name="p"), weight=-1, ttl_seconds_until_expired=-2)
+        with pytest.raises(AdmissionError) as exc:
+            admit_provisioner(p)
+        assert len(exc.value.field_errors) == 2
+
+    def test_cluster_write_is_the_chokepoint(self):
+        cluster = Cluster()
+        with pytest.raises(AdmissionError):
+            cluster.add_provisioner(
+                Provisioner(meta=ObjectMeta(name="bad"), weight=-3)
+            )
+        assert "bad" not in cluster.provisioners
+
+
+class TestNodeTemplateAdmission:
+    def test_unknown_family_rejected(self):
+        nt = NodeTemplate(meta=ObjectMeta(name="t"), image_family="windows-2003")
+        with pytest.raises(AdmissionError, match="unknown family"):
+            admit_node_template(nt)
+
+    def test_zero_volume_rejected(self):
+        nt = NodeTemplate(
+            meta=ObjectMeta(name="t"), image_family="al2",
+            block_device_mappings=[BlockDeviceMapping(device_name="/dev/xvda", volume_size_gib=0)],
+        )
+        with pytest.raises(AdmissionError, match="volumeSize"):
+            admit_node_template(nt)
+
+    def test_bottlerocket_userdata_must_be_toml(self):
+        nt = NodeTemplate(meta=ObjectMeta(name="t"), image_family="bottlerocket",
+                          user_data="#!/bin/bash\necho nope")
+        with pytest.raises(AdmissionError, match="TOML"):
+            admit_node_template(nt)
+
+    def test_valid_template_admitted_via_cluster(self):
+        cluster = Cluster()
+        nt = NodeTemplate(meta=ObjectMeta(name="ok"), image_family="al2",
+                          subnet_selector={"karpenter.tpu/discovery": "cluster"})
+        cluster.add_node_template(nt)
+        assert "ok" in cluster.node_templates

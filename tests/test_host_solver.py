@@ -1,0 +1,214 @@
+"""Direct unit tests for the host LP pipeline (solver/host.py) — the
+production hot path for LP-safe problems: lp_solve/lp_round boundaries,
+config_greedy tails, refill_existing with compat holes, ruin_recreate
+invariants, and a differential fuzz against the greedy oracle."""
+
+import numpy as np
+import pytest
+
+import karpenter_tpu.solver.host as H
+from karpenter_tpu.api import ObjectMeta, Pod, Provisioner, Resources, Node
+from karpenter_tpu.api import labels as wk
+from karpenter_tpu.cloudprovider import generate_catalog
+from karpenter_tpu.solver import GreedySolver, best_lower_bound, encode, validate
+from karpenter_tpu.solver.encode import ExistingNode
+
+
+def _pods(specs):
+    out = []
+    for prefix, n, cpu, mem in specs:
+        for i in range(n):
+            out.append(
+                Pod(meta=ObjectMeta(name=f"{prefix}-{i}"),
+                    requests=Resources(cpu=cpu, memory=mem))
+            )
+    return out
+
+
+def _problem(specs, n_types=30, existing=()):
+    prov = Provisioner(meta=ObjectMeta(name="d"))
+    return encode(_pods(specs), [(prov, generate_catalog(n_types=n_types))], existing)
+
+
+def _existing_node(name, it, zone="zone-a", util=0.0):
+    node = Node(
+        meta=ObjectMeta(
+            name=name,
+            labels={**it.requirements.labels(), wk.ZONE: zone,
+                    wk.PROVISIONER_NAME: "d", wk.INSTANCE_TYPE: it.name},
+        ),
+        capacity=it.capacity,
+        allocatable=it.allocatable(),
+        ready=True,
+    )
+    return ExistingNode(node=node, remaining=it.allocatable() * (1.0 - util))
+
+
+class TestLpSolveRound:
+    def test_solves_and_rounds_complete(self):
+        p = _problem([("a", 500, "250m", "512Mi"), ("b", 200, "1", "2Gi")])
+        rem = p.count.astype(np.int64).copy()
+        plan = H.lp_solve(p, rem, [])
+        assert isinstance(plan, H._LPPlan)
+        assert plan.fun > 0
+        opens, left, cost = H.lp_round(p, rem, plan, mode="nearest")
+        tails, left, tc = H._finish_leftovers(p, left, opens, opt_subset=plan.cols)
+        assert left.sum() == 0
+        assert cost + tc >= plan.fun - 1e-6  # integral >= fractional
+
+    def test_floor_vs_nearest_both_feasible(self):
+        p = _problem([("a", 777, "300m", "700Mi"), ("b", 333, "1500m", "1Gi")])
+        rem = p.count.astype(np.int64).copy()
+        plan = H.lp_solve(p, rem, [])
+        for mode in ("floor", "nearest"):
+            opens, left, cost = H.lp_round(p, rem, plan, mode=mode)
+            placed = np.zeros(p.G, np.int64)
+            for op in opens:
+                ys = op.placements(p.G)
+                # capacity per node holds
+                load = ys.T.astype(np.float64) @ p.demand.astype(np.float64)
+                assert np.all(load <= p.alloc[op.option][None, :] * (1 + 5e-4) + 1e-6)
+                placed += ys.sum(axis=1)
+            assert np.all(placed + left == p.count)
+            assert np.all(left >= 0)  # nearest-rounding must not overshoot
+
+    def test_empty_remaining_is_trivial(self):
+        p = _problem([("a", 10, "250m", "512Mi")])
+        out = H.lp_solve(p, np.zeros(p.G, np.int64), [])
+        opens, left, cost, cols = out
+        assert opens == [] and cost == 0.0
+
+    def test_zero_options_returns_none_result(self):
+        prov = Provisioner(meta=ObjectMeta(name="d"))
+        p = encode(_pods([("a", 5, "250m", "512Mi")]), [(prov, [])])
+        assert H.solve_host(p) is None or not H.lp_safe(p) or p.O == 0
+
+    def test_lp_polish_wrapper_matches_split_path(self):
+        p = _problem([("a", 300, "500m", "1Gi")])
+        rem = p.count.astype(np.int64).copy()
+        out = H.lp_polish(p, rem, [], mode="floor")
+        assert out is not None
+        opens, left, cost, cols = out
+        plan = H.lp_solve(p, rem, [])
+        opens2, left2, cost2 = H.lp_round(p, rem, plan, mode="floor")
+        assert cost == pytest.approx(cost2)
+        assert np.array_equal(left, left2)
+
+
+class TestConfigGreedy:
+    def test_packs_all_without_lp(self):
+        p = _problem([("a", 200, "250m", "512Mi"), ("b", 100, "2", "4Gi")])
+        rem = p.count.astype(np.int64).copy()
+        opens, left, cost = H.config_greedy(p, rem)
+        assert left.sum() == 0
+        assert cost > 0
+
+    def test_respects_compat_holes(self):
+        prov = Provisioner(meta=ObjectMeta(name="d"))
+        pods = [
+            Pod(meta=ObjectMeta(name=f"z-{i}"), requests=Resources(cpu="250m", memory="512Mi"),
+                node_selector={wk.ZONE: "zone-b"})
+            for i in range(50)
+        ]
+        p = encode(pods, [(prov, generate_catalog(n_types=20))])
+        rem = p.count.astype(np.int64).copy()
+        opens, left, cost = H.config_greedy(p, rem)
+        assert left.sum() == 0
+        for op in opens:
+            assert p.options[op.option].zone == "zone-b"
+
+    def test_incompatible_group_left_over(self):
+        prov = Provisioner(meta=ObjectMeta(name="d"))
+        pods = [Pod(meta=ObjectMeta(name="imp"), requests=Resources(cpu="250m"),
+                    node_selector={wk.ZONE: "zone-nope"})]
+        p = encode(pods, [(prov, generate_catalog(n_types=10))])
+        rem = p.count.astype(np.int64).copy()
+        opens, left, cost = H.config_greedy(p, rem)
+        assert left.sum() == 1 and opens == []
+
+    def test_pruned_subset_restricts_options(self):
+        p = _problem([("a", 100, "250m", "512Mi")])
+        rem = p.count.astype(np.int64).copy()
+        subset = np.array([0, 1], np.int64)
+        opens, left, cost = H.config_greedy(p, rem, opt_subset=subset)
+        for op in opens:
+            assert op.option in (0, 1)
+
+
+class TestRefillExisting:
+    def test_refills_before_opening(self):
+        cat = generate_catalog(n_types=20)
+        big = max(cat, key=lambda t: t.capacity["cpu"])
+        existing = [_existing_node("n-0", big, util=0.0)]
+        prov = Provisioner(meta=ObjectMeta(name="d"))
+        pods = _pods([("a", 4, "1", "1Gi")])
+        p = encode(pods, [(prov, cat)], existing)
+        rem = p.count.astype(np.int64).copy()
+        ex_rem = p.ex_rem.astype(np.float64).copy()
+        placements, rem, ex_rem2 = H.refill_existing(p, rem, ex_rem)
+        assert placements.sum() == 4 and rem.sum() == 0
+
+    def test_compat_hole_skips_node(self):
+        cat = generate_catalog(n_types=20)
+        big = max(cat, key=lambda t: t.capacity["cpu"])
+        existing = [_existing_node("n-a", big, zone="zone-a")]
+        prov = Provisioner(meta=ObjectMeta(name="d"))
+        pods = [Pod(meta=ObjectMeta(name=f"b-{i}"), requests=Resources(cpu="500m"),
+                    node_selector={wk.ZONE: "zone-b"}) for i in range(3)]
+        p = encode(pods, [(prov, cat)], existing)
+        rem = p.count.astype(np.int64).copy()
+        placements, rem, _ = H.refill_existing(p, rem, p.ex_rem.astype(np.float64).copy())
+        assert placements.sum() == 0 and rem.sum() == 3
+
+
+class TestRuinRecreate:
+    def test_never_regresses_and_stays_complete(self):
+        p = _problem([("a", 800, "250m", "512Mi"), ("b", 300, "1", "3Gi"),
+                      ("c", 150, "2", "2Gi")])
+        rem = p.count.astype(np.int64).copy()
+        plan = H.lp_solve(p, rem, [])
+        opens, left, cost = H.lp_round(p, rem, plan, mode="nearest")
+        if left.sum() > 0:
+            tails, left, tc = H._finish_leftovers(p, left, opens, opt_subset=plan.cols)
+            opens, cost = opens + tails, cost + tc
+        assert left.sum() == 0
+        price = p.price.astype(np.float64)
+        before = sum(op.nodes * price[op.option] for op in opens)
+        rr = H.ruin_recreate(p, opens, plan.cols)
+        after = sum(op.nodes * price[op.option] for op in rr)
+        assert after <= before + 1e-9
+        placed = np.zeros(p.G, np.int64)
+        for op in rr:
+            placed += op.placements(p.G).sum(axis=1)
+        assert np.array_equal(placed, p.count)
+
+    def test_single_node_noop(self):
+        p = _problem([("a", 3, "250m", "512Mi")])
+        rem = p.count.astype(np.int64).copy()
+        opens, left, cost = H.config_greedy(p, rem)
+        rr = H.ruin_recreate(p, opens, np.arange(p.O))
+        placed = sum(op.placements(p.G).sum() for op in rr)
+        assert placed == 3
+
+
+class TestDifferentialFuzz:
+    @pytest.mark.parametrize("seed", [1, 2, 3, 4, 5])
+    def test_host_beats_or_matches_greedy_at_1k(self, seed):
+        rng = np.random.default_rng(seed)
+        specs = []
+        total = 0
+        for i in range(int(rng.integers(3, 9))):
+            n = int(rng.integers(20, 400))
+            total += n
+            cpu = float(rng.choice([0.1, 0.25, 0.5, 1.0, 2.0, 4.0]))
+            mem = float(rng.choice([0.25, 0.5, 1.0, 2.0, 4.0]))
+            specs.append((f"g{i}", n, cpu, f"{mem}Gi"))
+        p = _problem(specs, n_types=50)
+        res = H.solve_host(p)
+        assert res is not None
+        assert validate(p, res) == []
+        assert not res.unschedulable
+        greedy = GreedySolver().solve(p)
+        assert res.cost <= greedy.cost * 1.001, (res.cost, greedy.cost)
+        lb = best_lower_bound(p)
+        assert res.cost >= lb - 1e-6

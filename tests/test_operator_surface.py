@@ -1,0 +1,144 @@
+"""Operator HTTP surface (/metrics, /healthz, /readyz) + parallel
+interruption handling. Reference: cmd/controller/main.go:33-71 (manager
+endpoints), interruption controller.go:101 (10-way concurrency)."""
+
+import json
+import threading
+import urllib.request
+
+from karpenter_tpu.api import Machine, ObjectMeta, Provisioner, Requirement, Requirements, Resources
+from karpenter_tpu.api import labels as wk
+from karpenter_tpu.cloudprovider import FakeCloudProvider, generate_catalog
+from karpenter_tpu.controllers.interruption import FakeQueue, InterruptionController
+from karpenter_tpu.controllers.provisioning import register_node
+from karpenter_tpu.controllers.termination import TerminationController
+from karpenter_tpu.state import Cluster
+from karpenter_tpu.utils.cache import FakeClock
+from karpenter_tpu.utils.httpserver import OperatorHTTPServer
+from karpenter_tpu.utils.metrics import REGISTRY
+
+
+def _get(port, path):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}", timeout=5) as r:
+        return r.status, r.read().decode()
+
+
+class TestHTTPServer:
+    def test_metrics_endpoint_serves_registry(self):
+        srv = OperatorHTTPServer(port=0).start()
+        try:
+            status, body = _get(srv.port, "/metrics")
+            assert status == 200
+            assert "karpenter_tpu_pods_scheduled_total" in body
+        finally:
+            srv.stop()
+
+    def test_health_and_ready(self):
+        ready = {"ok": False}
+        srv = OperatorHTTPServer(port=0, ready_check=lambda: ready["ok"]).start()
+        try:
+            assert _get(srv.port, "/healthz")[0] == 200
+            try:
+                _get(srv.port, "/readyz")
+                assert False, "expected 503"
+            except urllib.error.HTTPError as e:
+                assert e.code == 503
+            ready["ok"] = True
+            assert _get(srv.port, "/readyz")[0] == 200
+        finally:
+            srv.stop()
+
+    def test_404(self):
+        srv = OperatorHTTPServer(port=0).start()
+        try:
+            try:
+                _get(srv.port, "/nope")
+                assert False
+            except urllib.error.HTTPError as e:
+                assert e.code == 404
+        finally:
+            srv.stop()
+
+    def test_operator_run_serves_metrics(self):
+        import time
+
+        from karpenter_tpu.operator import Operator
+
+        op = Operator.new(provider=FakeCloudProvider(catalog=generate_catalog(n_types=10)))
+        stop = threading.Event()
+        t = threading.Thread(target=op.run, args=(stop,), kwargs={"http_port": 0})
+        t.start()
+        try:
+            deadline = time.time() + 10
+            while time.time() < deadline and getattr(op, "http_server", None) is None:
+                time.sleep(0.05)
+            assert op.http_server is not None
+            status, body = _get(op.http_server.port, "/metrics")
+            assert status == 200 and "karpenter_tpu" in body
+        finally:
+            stop.set()
+            t.join(timeout=10)
+
+
+import urllib.error  # noqa: E402
+
+
+class TestParallelInterruption:
+    def _fleet(self, n):
+        provider = FakeCloudProvider(catalog=generate_catalog(n_types=10))
+        cluster = Cluster()
+        prov = Provisioner(meta=ObjectMeta(name="default"))
+        cluster.add_provisioner(prov)
+        clock = FakeClock(start=0.0)
+        term = TerminationController(cluster, provider, clock=clock)
+        queue = FakeQueue()
+        ctl = InterruptionController(
+            cluster, queue, term, unavailable_offerings=provider.unavailable_offerings
+        )
+        it = provider.catalog[0]
+        nodes = []
+        for i in range(n):
+            m = Machine(
+                meta=ObjectMeta(name=f"m-{i}", labels=dict(prov.labels)),
+                provisioner_name=prov.name,
+                requirements=Requirements([
+                    Requirement.in_values(wk.INSTANCE_TYPE, [it.name]),
+                    Requirement.in_values(wk.CAPACITY_TYPE, [wk.CAPACITY_TYPE_SPOT]),
+                ]),
+                requests=Resources(cpu="100m"),
+            )
+            m = provider.create(m)
+            cluster.add_machine(m)
+            nodes.append(register_node(cluster, m, prov))
+        return provider, cluster, queue, ctl, nodes
+
+    def test_batch_of_spot_interruptions_handled_concurrently(self):
+        provider, cluster, queue, ctl, nodes = self._fleet(30)
+        for node in nodes:
+            queue.send({
+                "version": "0", "source": "cloud.compute",
+                "detail-type": "Spot Instance Interruption Warning",
+                "detail": {"instance-id": node.provider_id.rsplit("/", 1)[-1]},
+            })
+        handled = 0
+        while len(queue):
+            handled += ctl.reconcile(max_messages=100)
+        assert handled == 30
+        # every node got cordoned/drained/deleted by the termination pass
+        assert len(cluster.nodes) == 0
+        # and the spot pools were ICE'd
+        assert provider.unavailable_offerings.seqnum >= 30
+
+    def test_mixed_batch_with_garbage(self):
+        from karpenter_tpu.controllers.interruption import QueueMessage
+
+        provider, cluster, queue, ctl, nodes = self._fleet(3)
+        queue.send({"version": "0", "source": "cloud.compute",
+                    "detail-type": "Instance Rebalance Recommendation",
+                    "detail": {"instance-id": nodes[0].provider_id.rsplit("/", 1)[-1]}})
+        queue._messages.append(QueueMessage(id="bad", body="{not json"))
+        queue.send({"version": "9", "source": "unknown", "detail-type": "???"})
+        while len(queue):
+            ctl.reconcile(max_messages=10)
+        # rebalance is event-only: node survives; garbage/noop drained cleanly
+        assert nodes[0].name in cluster.nodes
