@@ -1,0 +1,193 @@
+"""Deployment manifest renderer — the Helm chart analogue.
+
+The reference ships ``charts/karpenter`` (Deployment with 2 leader-elected
+replicas, a PDB, ports http-metrics 8080 / http 8081 probes, RBAC split, the
+global-settings ConfigMap — ``deployment.yaml:96-104``) and
+``charts/karpenter-crd``. This renderer produces the equivalent manifests for
+the TPU operator, parameterized like chart values:
+
+    python deploy/render.py --cluster-name prod --replicas 2 > manifests.yaml
+    python deploy/render.py --out-dir deploy/manifests   # one file per object
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import Dict, List
+
+import yaml
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+APP = "karpenter-tpu"
+
+
+def labels() -> Dict[str, str]:
+    return {"app.kubernetes.io/name": APP, "app.kubernetes.io/managed-by": "render.py"}
+
+
+def namespace(values: Dict) -> Dict:
+    return {
+        "apiVersion": "v1",
+        "kind": "Namespace",
+        "metadata": {"name": values["namespace"], "labels": labels()},
+    }
+
+
+def serviceaccount(values: Dict) -> Dict:
+    return {
+        "apiVersion": "v1",
+        "kind": "ServiceAccount",
+        "metadata": {"name": APP, "namespace": values["namespace"], "labels": labels()},
+    }
+
+
+def rbac(values: Dict) -> List[Dict]:
+    core_rules = [
+        {"apiGroups": [""], "resources": ["pods", "nodes", "events"],
+         "verbs": ["get", "list", "watch", "create", "patch", "delete"]},
+        {"apiGroups": [""], "resources": ["pods/eviction"], "verbs": ["create"]},
+        {"apiGroups": ["policy"], "resources": ["poddisruptionbudgets"],
+         "verbs": ["get", "list", "watch"]},
+        {"apiGroups": ["coordination.k8s.io"], "resources": ["leases"],
+         "verbs": ["get", "create", "update"]},
+    ]
+    crd_rules = [
+        {"apiGroups": ["karpenter.tpu"],
+         "resources": ["provisioners", "machines", "nodetemplates"],
+         "verbs": ["get", "list", "watch", "create", "update", "patch", "delete"]},
+    ]
+    role = {
+        "apiVersion": "rbac.authorization.k8s.io/v1",
+        "kind": "ClusterRole",
+        "metadata": {"name": APP, "labels": labels()},
+        "rules": core_rules + crd_rules,
+    }
+    binding = {
+        "apiVersion": "rbac.authorization.k8s.io/v1",
+        "kind": "ClusterRoleBinding",
+        "metadata": {"name": APP, "labels": labels()},
+        "roleRef": {"apiGroup": "rbac.authorization.k8s.io", "kind": "ClusterRole",
+                    "name": APP},
+        "subjects": [{"kind": "ServiceAccount", "name": APP,
+                      "namespace": "{}".format(values["namespace"])}],
+    }
+    return [role, binding]
+
+
+def settings_configmap(values: Dict) -> Dict:
+    from karpenter_tpu.api.settings import Settings
+    from dataclasses import fields
+
+    s = Settings(cluster_name=values["cluster_name"])
+    data = {}
+    for f in fields(Settings):
+        v = getattr(s, f.name)
+        if v is None or isinstance(v, dict):
+            continue
+        data[f"KARPENTER_TPU_{f.name.upper()}"] = str(v)
+    return {
+        "apiVersion": "v1",
+        "kind": "ConfigMap",
+        "metadata": {"name": f"{APP}-global-settings",
+                     "namespace": values["namespace"], "labels": labels()},
+        "data": data,
+    }
+
+
+def deployment(values: Dict) -> Dict:
+    return {
+        "apiVersion": "apps/v1",
+        "kind": "Deployment",
+        "metadata": {"name": APP, "namespace": values["namespace"], "labels": labels()},
+        "spec": {
+            "replicas": values["replicas"],
+            "selector": {"matchLabels": {"app.kubernetes.io/name": APP}},
+            "template": {
+                "metadata": {"labels": labels()},
+                "spec": {
+                    "serviceAccountName": APP,
+                    "containers": [
+                        {
+                            "name": "controller",
+                            "image": values["image"],
+                            "args": [
+                                "--metrics-port", "8080",
+                                "--leader-elect",
+                                "--log-format", "json",
+                                "--cluster-name", values["cluster_name"],
+                            ],
+                            "envFrom": [
+                                {"configMapRef": {"name": f"{APP}-global-settings"}}
+                            ],
+                            "ports": [
+                                {"name": "http-metrics", "containerPort": 8080},
+                            ],
+                            "livenessProbe": {
+                                "httpGet": {"path": "/healthz", "port": 8080},
+                                "initialDelaySeconds": 30,
+                            },
+                            "readinessProbe": {
+                                "httpGet": {"path": "/readyz", "port": 8080},
+                            },
+                            "resources": {
+                                "requests": {"cpu": "1", "memory": "1Gi"},
+                                "limits": {"cpu": "2", "memory": "2Gi"},
+                            },
+                        }
+                    ],
+                },
+            },
+        },
+    }
+
+
+def pdb(values: Dict) -> Dict:
+    return {
+        "apiVersion": "policy/v1",
+        "kind": "PodDisruptionBudget",
+        "metadata": {"name": APP, "namespace": values["namespace"], "labels": labels()},
+        "spec": {
+            "maxUnavailable": 1,
+            "selector": {"matchLabels": {"app.kubernetes.io/name": APP}},
+        },
+    }
+
+
+def render_all(values: Dict) -> List[Dict]:
+    return [
+        namespace(values),
+        serviceaccount(values),
+        *rbac(values),
+        settings_configmap(values),
+        deployment(values),
+        pdb(values),
+    ]
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--cluster-name", default="karpenter-tpu")
+    ap.add_argument("--namespace", default="karpenter-tpu")
+    ap.add_argument("--replicas", type=int, default=2)
+    ap.add_argument("--image", default="karpenter-tpu:latest")
+    ap.add_argument("--out-dir", default=None)
+    args = ap.parse_args()
+    values = vars(args)
+    objs = render_all(values)
+    if args.out_dir:
+        os.makedirs(args.out_dir, exist_ok=True)
+        for obj in objs:
+            name = f"{obj['kind'].lower()}-{obj['metadata']['name']}.yaml"
+            with open(os.path.join(args.out_dir, name), "w") as f:
+                yaml.safe_dump(obj, f, sort_keys=False)
+            print(f"wrote {args.out_dir}/{name}")
+    else:
+        print(yaml.safe_dump_all(objs, sort_keys=False), end="")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

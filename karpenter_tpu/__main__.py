@@ -1,0 +1,97 @@
+"""Operator entry point: ``python -m karpenter_tpu``.
+
+The analogue of ``/root/reference/cmd/controller/main.go:33-71`` plus the
+operator flag surface (settings.md:15-26): flags for the metrics/health port,
+leader election, logging, batching and the interruption queue; settings also
+ingest from KARPENTER_TPU_* env vars; SIGINT/SIGTERM stop the loops cleanly.
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import sys
+import threading
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="karpenter-tpu", description="TPU-native cluster autoscaler operator"
+    )
+    p.add_argument("--cluster-name", default=None, help="cluster identity")
+    p.add_argument("--metrics-port", type=int, default=8080,
+                   help="serve /metrics,/healthz,/readyz on this port (0=ephemeral, -1=off)")
+    p.add_argument("--leader-elect", action="store_true",
+                   help="enable leader election before running loops")
+    p.add_argument("--leader-elect-lease", default="/tmp/karpenter-tpu-leader",
+                   help="lease file path for leader election")
+    p.add_argument("--log-level", default="INFO")
+    p.add_argument("--log-format", choices=("console", "json"), default="console")
+    p.add_argument("--batch-idle-duration", type=float, default=None)
+    p.add_argument("--batch-max-duration", type=float, default=None)
+    p.add_argument("--interruption-queue-name", default=None)
+    p.add_argument("--tick", type=float, default=0.25, help="loop poll interval")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+
+    from .api.settings import Settings
+    from .context import OperatorContext
+    from .operator import Operator
+    from .utils.logging import configure, get_logger, kv
+
+    configure(level=args.log_level, fmt=args.log_format)
+    log = get_logger("main")
+
+    settings = Settings.from_env()
+    overrides = {
+        k: v
+        for k, v in (
+            ("cluster_name", args.cluster_name),
+            ("batch_idle_duration", args.batch_idle_duration),
+            ("batch_max_duration", args.batch_max_duration),
+            ("interruption_queue_name", args.interruption_queue_name),
+        )
+        if v is not None
+    }
+    if overrides:
+        settings.apply(overrides)
+
+    ctx = OperatorContext.discover(settings=settings)
+    op = Operator.new(provider=ctx.provider, settings=ctx.settings)
+    import logging
+
+    kv(log, logging.INFO, "operator starting",
+       cluster=ctx.settings.cluster_name, region=ctx.region)
+
+    elector = None
+    stop = threading.Event()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        signal.signal(sig, lambda *_: stop.set())
+
+    if args.leader_elect:
+        from .utils.leaderelection import LeaderElector
+
+        elector = LeaderElector(args.leader_elect_lease)
+        kv(log, logging.INFO, "waiting for leadership", lease=args.leader_elect_lease)
+        if not elector.acquire(stop=stop):
+            return 0  # stopped before becoming leader
+        kv(log, logging.INFO, "became leader", identity=elector.identity)
+
+    try:
+        op.run(
+            stop,
+            tick=args.tick,
+            http_port=args.metrics_port if args.metrics_port >= 0 else None,
+        )
+    finally:
+        if elector is not None:
+            elector.release()
+    kv(log, logging.INFO, "operator stopped")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -8,7 +8,7 @@ tuning (batchIdleDuration 1s / batchMaxDuration 10s), vmMemoryOverheadPercent
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass, field, fields
 from typing import Dict, Optional
 
 
@@ -34,3 +34,71 @@ class Settings:
             raise ValueError("invalid batch durations")
         if not 0 <= self.vm_memory_overhead_percent < 1:
             raise ValueError("vmMemoryOverheadPercent must be in [0,1)")
+
+    # -- config system (reference: karpenter-global-settings ConfigMap,
+    # settings.go:40-93; env/flag ingestion in the operator bootstrap) -------
+
+    _ENV_PREFIX = "KARPENTER_TPU_"
+
+    @classmethod
+    def from_env(cls, env: Optional[Dict[str, str]] = None) -> "Settings":
+        """Build settings from KARPENTER_TPU_* environment variables
+        (CLUSTER_NAME, BATCH_IDLE_DURATION, INTERRUPTION_QUEUE_NAME, ...),
+        falling back to defaults — the 12-factor face of the reference's
+        global-settings ConfigMap. Unknown KARPENTER_TPU_* keys are an error:
+        a misspelled override silently falling back to a default is the worst
+        possible config failure mode."""
+        import os
+
+        env = dict(os.environ if env is None else env)
+        s = cls()
+        known = {cls._ENV_PREFIX + f.name.upper(): f.name for f in fields(cls)}
+        unknown = [
+            k for k in env
+            if k.startswith(cls._ENV_PREFIX) and k not in known
+        ]
+        if unknown:
+            raise ValueError(
+                f"unknown settings env vars: {sorted(unknown)}; known: {sorted(known)}"
+            )
+        updates: Dict[str, object] = {
+            name: _coerce(key, env[key], getattr(s, name))
+            for key, name in known.items()
+            if key in env
+        }
+        s.apply(updates)
+        return s
+
+    def apply(self, updates: Dict[str, object]) -> "Settings":
+        """Live-config update (the ConfigMap watcher analogue): set the given
+        fields, validate the result atomically (all-or-nothing)."""
+        candidate = Settings(**{**self.__dict__, **updates})
+        candidate.validate()
+        for k, v in updates.items():
+            setattr(self, k, v)
+        return self
+
+
+_TRUE = ("1", "true", "yes", "on")
+_FALSE = ("0", "false", "no", "off")
+
+
+def _coerce(key: str, raw: str, current) -> object:
+    raw = raw.strip()
+    if isinstance(current, bool):
+        if raw.lower() in _TRUE:
+            return True
+        if raw.lower() in _FALSE:
+            return False
+        raise ValueError(f"{key}: invalid boolean {raw!r} (use true/false)")
+    if isinstance(current, float):
+        return float(raw)
+    if isinstance(current, int) and current is not None:
+        return int(raw)
+    if isinstance(current, dict):
+        import json
+
+        return json.loads(raw)
+    if raw == "" and current is None:
+        return None
+    return raw

@@ -123,6 +123,12 @@ class ProvisioningController:
 
     # -- the reconcile loop body -------------------------------------------
     def reconcile(self) -> ProvisioningResult:
+        from ..utils.tracing import span
+
+        with span("provisioning.reconcile"):
+            return self._reconcile()
+
+    def _reconcile(self) -> ProvisioningResult:
         t0 = time.perf_counter()
         batch_gen = self.batcher.generation
         pods = self.cluster.pending_pods()

@@ -153,10 +153,15 @@ class Solver(abc.ABC):
         existing: Sequence[ExistingNode] = (),
         daemonsets: Sequence[Pod] = (),
     ) -> SolveResult:
+        from ..utils.tracing import span
+
         t0 = time.perf_counter()
-        problem = encode(pods, provisioners, existing, daemonsets)
-        t1 = time.perf_counter()
-        result = self.solve(problem)
+        with span("solve", pods=len(pods)):
+            with span("solve.encode"):
+                problem = encode(pods, provisioners, existing, daemonsets)
+            t1 = time.perf_counter()
+            with span("solve.backend"):
+                result = self.solve(problem)
         result.stats["encode_s"] = t1 - t0
         result.stats["total_s"] = time.perf_counter() - t0
         result.stats["lower_bound"] = lower_bound(problem)

@@ -1,0 +1,102 @@
+"""Leader election over a lease file.
+
+The reference deploys 2 replicas with controller-runtime leader election
+(chart ``deployment.yaml``; operator flag table): only the leader runs the
+reconcile loops and background refreshers. Without an apiserver, the lease
+is a file — acquired with an atomic create, carried with a holder identity +
+deadline, renewed on a heartbeat, stealable once expired. Same semantics as
+a coordination.k8s.io Lease: at most one live holder, takeover on expiry.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import uuid
+from typing import Optional
+
+
+class LeaderElector:
+    def __init__(
+        self,
+        lease_path: str,
+        identity: Optional[str] = None,
+        lease_duration: float = 15.0,
+        renew_interval: float = 5.0,
+    ):
+        self.lease_path = lease_path
+        self.identity = identity or f"{os.getpid()}-{uuid.uuid4().hex[:8]}"
+        self.lease_duration = lease_duration
+        self.renew_interval = renew_interval
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.is_leader = False
+
+    # -- lease file ops ------------------------------------------------------
+    def _read(self) -> Optional[dict]:
+        try:
+            with open(self.lease_path) as f:
+                return json.load(f)
+        except (FileNotFoundError, json.JSONDecodeError):
+            return None
+
+    def _write(self) -> None:
+        tmp = f"{self.lease_path}.{self.identity}.tmp"
+        with open(tmp, "w") as f:
+            json.dump(
+                {"holder": self.identity, "renewed": time.time(),
+                 "duration": self.lease_duration},
+                f,
+            )
+        os.replace(tmp, self.lease_path)  # atomic on POSIX
+
+    def try_acquire(self) -> bool:
+        """One acquisition attempt: take a free/expired lease, renew our own."""
+        lease = self._read()
+        now = time.time()
+        if lease is not None:
+            expired = now - lease.get("renewed", 0) > lease.get("duration", self.lease_duration)
+            if lease.get("holder") != self.identity and not expired:
+                self.is_leader = False
+                return False
+        self._write()
+        # re-read to detect a racing writer (last atomic replace wins)
+        check = self._read()
+        self.is_leader = bool(check and check.get("holder") == self.identity)
+        return self.is_leader
+
+    def acquire(self, stop: Optional[threading.Event] = None, poll: float = 1.0) -> bool:
+        """Block until leadership (or ``stop``); then renew on a heartbeat."""
+        while not (stop and stop.is_set()):
+            if self.try_acquire():
+                self._start_renewal()
+                return True
+            time.sleep(poll)
+        return False
+
+    def _start_renewal(self) -> None:
+        self._stop.clear()
+
+        def renew() -> None:
+            while not self._stop.wait(self.renew_interval):
+                if not self.try_acquire():
+                    self.is_leader = False  # lost the lease (stolen post-expiry)
+                    return
+
+        self._thread = threading.Thread(target=renew, daemon=True)
+        self._thread.start()
+
+    def release(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        if self.is_leader:
+            lease = self._read()
+            if lease and lease.get("holder") == self.identity:
+                try:
+                    os.unlink(self.lease_path)
+                except FileNotFoundError:
+                    pass
+        self.is_leader = False

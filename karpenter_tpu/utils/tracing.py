@@ -1,0 +1,103 @@
+"""Lightweight tracing/profiling for the control loops.
+
+The reference exposes pprof profiling behind an operator flag and times its
+cloud-provider calls through a metrics decorator
+(``karpenter_cloudprovider_duration_seconds``). This module is the tracing
+side of that observability story, TPU-control-plane shaped:
+
+* ``span("solve.encode")`` context-managers nest into a thread-local stack,
+  producing a tree of timed spans per operation;
+* the last completed ROOT span tree per name is kept for inspection
+  (``last_trace``), and every span can be exported to the structured logger;
+* always-on cheap (perf_counter + list append); no-op when disabled.
+
+Controllers wrap their reconcile bodies; the solver wraps encode/solve/
+decode/validate, which is how "where did the 100ms go" questions get
+answered without a profiler attached (spans show up in SolveResult.stats
+via the solver's timings too).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+_state = threading.local()
+
+
+@dataclass
+class Span:
+    name: str
+    start: float
+    end: float = 0.0
+    children: List["Span"] = field(default_factory=list)
+    attrs: Dict[str, object] = field(default_factory=dict)
+
+    @property
+    def duration_ms(self) -> float:
+        return (self.end - self.start) * 1e3
+
+    def to_dict(self) -> Dict:
+        out = {"name": self.name, "ms": round(self.duration_ms, 3)}
+        if self.attrs:
+            out["attrs"] = dict(self.attrs)
+        if self.children:
+            out["children"] = [c.to_dict() for c in self.children]
+        return out
+
+    def flat(self, prefix: str = "") -> Dict[str, float]:
+        """{dotted.path: ms} for metrics/stats export."""
+        path = f"{prefix}.{self.name}" if prefix else self.name
+        out = {path: round(self.duration_ms, 3)}
+        for c in self.children:
+            out.update(c.flat(path))
+        return out
+
+
+class Tracer:
+    def __init__(self, enabled: bool = True, keep: int = 16):
+        self.enabled = enabled
+        self.keep = keep
+        self._lock = threading.Lock()
+        self._last: Dict[str, Span] = {}  # root span name -> most recent tree
+
+    @contextmanager
+    def span(self, name: str, **attrs):
+        if not self.enabled:
+            yield None
+            return
+        stack: List[Span] = getattr(_state, "stack", None) or []
+        _state.stack = stack
+        s = Span(name=name, start=time.perf_counter(), attrs=dict(attrs))
+        stack.append(s)
+        try:
+            yield s
+        finally:
+            s.end = time.perf_counter()
+            stack.pop()
+            if stack:
+                stack[-1].children.append(s)
+            else:
+                with self._lock:
+                    self._last[name] = s
+                    while len(self._last) > self.keep:
+                        self._last.pop(next(iter(self._last)))
+
+    def last_trace(self, name: str) -> Optional[Span]:
+        with self._lock:
+            return self._last.get(name)
+
+    def last_flat(self, name: str) -> Dict[str, float]:
+        s = self.last_trace(name)
+        return s.flat() if s is not None else {}
+
+
+#: process-wide default tracer (controllers/solver import this)
+TRACER = Tracer()
+
+
+def span(name: str, **attrs):
+    return TRACER.span(name, **attrs)
