@@ -1,0 +1,71 @@
+"""Controller kit: singleton reconcilers with cadence + error backoff.
+
+Rebuild of karpenter-core's controller kit surface
+(``corecontroller.{Controller, NewSingletonManagedBy}`` — poll-style
+singleton controllers with a requeue interval, plus controller-runtime's
+exponential error backoff). Every loop the operator drives is wrapped in a
+``SingletonController``: a crash in one controller backs that controller off
+(1s doubling to 5m) and is logged/counted instead of killing the whole run
+loop, and per-loop cadences (drift/GC/nodetemplate at 5m, termination every
+tick) live in ONE place instead of ad-hoc timestamp math.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Callable, Optional
+
+from ..utils import metrics
+from ..utils.logging import get_logger, kv
+
+BASE_BACKOFF = 1.0
+MAX_BACKOFF = 300.0
+
+
+class SingletonController:
+    """Wraps a reconcile callable with cadence and failure backoff."""
+
+    def __init__(
+        self,
+        name: str,
+        reconcile: Callable[[], object],
+        interval: float = 0.0,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.name = name
+        self._reconcile = reconcile
+        self.interval = interval
+        self._clock = clock
+        self._next = 0.0
+        self._backoff = BASE_BACKOFF
+        self.consecutive_errors = 0
+        self._log = get_logger(f"controller.{name}")
+
+    def due(self, now: Optional[float] = None) -> bool:
+        return (self._clock() if now is None else now) >= self._next
+
+    def run_if_due(self, now: Optional[float] = None) -> bool:
+        """Run when due; on success schedule the next interval, on failure
+        back off exponentially (reference: workqueue rate-limiter semantics).
+        Returns True when the reconcile ran (successfully or not)."""
+        now = self._clock() if now is None else now
+        if now < self._next:
+            return False
+        try:
+            with metrics.RECONCILE_DURATION.time({"controller": self.name}):
+                self._reconcile()
+        except Exception as e:
+            self.consecutive_errors += 1
+            metrics.RECONCILE_ERRORS.inc({"controller": self.name})
+            kv(self._log, logging.ERROR, "reconcile failed",
+               controller=self.name, consecutive=self.consecutive_errors,
+               error=f"{type(e).__name__}: {e}")
+            self._log.debug("reconcile traceback", exc_info=True)
+            self._next = now + self._backoff
+            self._backoff = min(self._backoff * 2, MAX_BACKOFF)
+            return True
+        self.consecutive_errors = 0
+        self._backoff = BASE_BACKOFF
+        self._next = now + self.interval
+        return True

@@ -154,15 +154,12 @@ class Operator:
                 self.http_server.stop()
 
     def _run_loop(self, stop: threading.Event, tick: float) -> None:
+        from .controllers.kit import SingletonController
         from .utils.gctuning import freeze_long_lived
 
-        last_slow = 0.0
-        last_retry = 0.0
-        frozen = False
-        while not stop.is_set():
-            now = time.monotonic()
-            if self.interruption is not None:
-                self.interruption.reconcile()
+        state = {"frozen": False, "last_retry": 0.0}
+
+        def provision() -> None:
             # The batch window is the primary provisioning trigger: pod
             # arrivals (fresh or re-pending after eviction) arm it via watch
             # events, so batch_idle/batch_max govern continuous mode
@@ -171,28 +168,51 @@ class Operator:
             # batch already fired but could not be placed (launch failures,
             # ICE, no provisioner yet) — no watch event ever re-arms those
             # (reference analogue: workqueue requeue-with-backoff).
+            now = time.monotonic()
             retry_due = False
-            if now - last_retry >= 5.0:
-                last_retry = now  # pace the pending_pods scan itself, not
-                # just successful reconciles — it walks every pod under the
-                # cluster lock
+            if now - state["last_retry"] >= 5.0:
+                state["last_retry"] = now  # pace the pending_pods scan itself
                 retry_due = bool(self.cluster.pending_pods())
             if self.provisioning.batcher.ready() or retry_due:
                 self.provisioning.reconcile()
-                if not frozen:
+                if not state["frozen"]:
                     # freeze AFTER the first reconcile built the long-lived
                     # state (pods, nodes, encoder caches) so gen-2 GC scans
                     # exclude it — see utils/gctuning.py
                     freeze_long_lived()
-                    frozen = True
-            self.deprovisioning.reconcile()
-            self.termination.reconcile()
-            if now - last_slow > 300.0:
-                if self.nodetemplate is not None:
-                    self.nodetemplate.reconcile()
-                if self.pricing is not None:
-                    self.pricing.reconcile()
-                self.drift.reconcile()
-                self.garbagecollect.reconcile()
-                last_slow = now
+                    state["frozen"] = True
+
+        # Every loop runs through the controller kit: per-loop cadence
+        # (reference: nodetemplate/drift/GC every 5m) and exponential error
+        # backoff per controller — one crashing loop backs itself off instead
+        # of killing the operator.
+        controllers = [
+            SingletonController("provisioning", provision),
+            SingletonController("deprovisioning", self.deprovisioning.reconcile),
+            SingletonController("termination", self.termination.reconcile),
+        ]
+        if self.interruption is not None:
+            controllers.insert(
+                0, SingletonController("interruption", self.interruption.reconcile)
+            )
+        if self.nodetemplate is not None:
+            controllers.append(
+                SingletonController(
+                    "nodetemplate", self.nodetemplate.reconcile, interval=300.0
+                )
+            )
+        if self.pricing is not None:
+            controllers.append(
+                SingletonController("pricing", self.pricing.reconcile, interval=300.0)
+            )
+        controllers.append(SingletonController("drift", self.drift.reconcile, interval=300.0))
+        controllers.append(
+            SingletonController(
+                "garbagecollect", self.garbagecollect.reconcile, interval=300.0
+            )
+        )
+        self.controllers = controllers
+        while not stop.is_set():
+            for c in controllers:
+                c.run_if_due()
             stop.wait(tick)

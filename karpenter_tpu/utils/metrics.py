@@ -10,6 +10,8 @@ from __future__ import annotations
 
 import threading
 from bisect import bisect_right
+from contextlib import contextmanager
+from time import perf_counter
 from typing import Dict, List, Optional, Sequence, Tuple
 
 _DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
@@ -91,6 +93,15 @@ class Histogram:
     def count(self, labels: Optional[Dict[str, str]] = None) -> int:
         return self._totals.get(_key(labels), 0)
 
+    @contextmanager
+    def time(self, labels: Optional[Dict[str, str]] = None):
+        """Context manager observing the elapsed wall time."""
+        t0 = perf_counter()
+        try:
+            yield
+        finally:
+            self.observe(perf_counter() - t0, labels)
+
     def sum(self, labels: Optional[Dict[str, str]] = None) -> float:
         return self._sums.get(_key(labels), 0.0)
 
@@ -139,6 +150,12 @@ PODS_UNSCHEDULABLE = Gauge("karpenter_tpu_pods_unschedulable", registry=REGISTRY
 NODES_CREATED = Counter("karpenter_tpu_nodes_created_total", registry=REGISTRY)
 NODES_TERMINATED = Counter("karpenter_tpu_nodes_terminated_total", registry=REGISTRY)
 SOLVE_DURATION = Histogram("karpenter_tpu_solve_duration_seconds", registry=REGISTRY)
+RECONCILE_DURATION = Histogram(
+    "karpenter_tpu_controller_reconcile_duration_seconds", registry=REGISTRY
+)
+RECONCILE_ERRORS = Counter(
+    "karpenter_tpu_controller_reconcile_errors_total", registry=REGISTRY
+)
 PROVISIONING_DURATION = Histogram(
     "karpenter_tpu_provisioning_duration_seconds", registry=REGISTRY
 )

@@ -173,3 +173,46 @@ class TestConsolidationOnPriceChange:
         assert len(bound) == 1
         new_node = cluster.nodes[bound[0].node_name]
         assert deprov._node_price(new_node) < launched_price
+
+
+class TestSpotPricierThanOnDemand:
+    def test_overpriced_spot_filtered_from_launch(self):
+        """Spot offerings above the cheapest compatible on-demand price are
+        dropped from the candidate list (instance.go:486-508)."""
+        catalog = generate_catalog(n_types=10)
+        provider = FakeCloudProvider(catalog=catalog)
+        it = catalog[0]
+        od_price = next(
+            o.price for o in it.offerings
+            if o.capacity_type == wk.CAPACITY_TYPE_ON_DEMAND and o.zone == "zone-a"
+        )
+        # inflate this type's spot above its own on-demand everywhere
+        for zone in ("zone-a", "zone-b", "zone-c"):
+            provider.pricing.set_spot_price(it.name, zone, od_price * 3)
+        m = Machine(
+            meta=ObjectMeta(name="m1"),
+            provisioner_name="d",
+            requirements=Requirements(
+                [Requirement.in_values(wk.INSTANCE_TYPE, [it.name])]
+            ),
+            requests=Resources(cpu="100m"),
+        )
+        m = provider.create(m)
+        # spot was preferred, but every spot offering was pricier than OD:
+        # the launch fell back to on-demand
+        assert m.meta.labels[wk.CAPACITY_TYPE] == wk.CAPACITY_TYPE_ON_DEMAND
+
+    def test_cheap_spot_still_wins(self):
+        catalog = generate_catalog(n_types=10)
+        provider = FakeCloudProvider(catalog=catalog)
+        it = catalog[0]
+        m = Machine(
+            meta=ObjectMeta(name="m2"),
+            provisioner_name="d",
+            requirements=Requirements(
+                [Requirement.in_values(wk.INSTANCE_TYPE, [it.name])]
+            ),
+            requests=Resources(cpu="100m"),
+        )
+        m = provider.create(m)
+        assert m.meta.labels[wk.CAPACITY_TYPE] == wk.CAPACITY_TYPE_SPOT

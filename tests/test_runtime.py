@@ -218,3 +218,66 @@ class TestRestartAdoption:
         assert adopted, "no machines adopted after restart"
         # nothing was deleted from the cloud by the restart
         assert set(provider.instances) == instances_before
+
+
+class TestControllerKit:
+    def test_cadence_and_backoff(self):
+        from karpenter_tpu.controllers.kit import SingletonController
+
+        clock = {"t": 0.0}
+        calls = {"n": 0, "fail": True}
+
+        def reconcile():
+            calls["n"] += 1
+            if calls["fail"]:
+                raise RuntimeError("boom")
+
+        c = SingletonController("t", reconcile, interval=10.0, clock=lambda: clock["t"])
+        assert c.run_if_due()          # t=0: runs, fails -> backoff 1s
+        assert c.consecutive_errors == 1
+        assert not c.run_if_due()      # still backing off
+        clock["t"] = 1.1
+        assert c.run_if_due()          # retries, fails -> backoff 2s
+        clock["t"] = 2.0
+        assert not c.run_if_due()
+        clock["t"] = 3.2
+        calls["fail"] = False
+        assert c.run_if_due()          # succeeds -> next = t+interval
+        assert c.consecutive_errors == 0
+        clock["t"] = 10.0
+        assert not c.run_if_due()      # cadence respected
+        clock["t"] = 13.3
+        assert c.run_if_due()
+
+    def test_operator_survives_crashing_controller(self):
+        """A reconcile raising inside the run loop must not kill the loop."""
+        provider = FakeCloudProvider(catalog=generate_catalog(n_types=10))
+        op = Operator.new(provider=provider,
+                          settings=Settings(batch_idle_duration=0.01,
+                                            batch_max_duration=0.05))
+        op.cluster.add_provisioner(Provisioner(meta=ObjectMeta(name="default")))
+        boom = {"n": 0}
+        orig = op.drift.reconcile
+
+        def exploding():
+            boom["n"] += 1
+            raise RuntimeError("drift crashed")
+
+        op.drift.reconcile = exploding
+        stop = threading.Event()
+        t = threading.Thread(target=op.run, args=(stop,), kwargs={"tick": 0.02})
+        t.start()
+        try:
+            op.cluster.add_pod(Pod(meta=ObjectMeta(name="p-0"),
+                                   requests=Resources(cpu="250m", memory="512Mi")))
+            deadline = time.time() + 10
+            while time.time() < deadline and not op.cluster.pods["p-0"].node_name:
+                time.sleep(0.05)
+            # the crashing drift loop ran (and backed off) while provisioning
+            # still bound the pod
+            assert op.cluster.pods["p-0"].node_name is not None
+            assert boom["n"] >= 1
+        finally:
+            stop.set()
+            t.join(timeout=10)
+        assert t.is_alive() is False
