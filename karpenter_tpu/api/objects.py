@@ -74,6 +74,11 @@ class Pod:
     # Required node affinity: list of OR'd Requirements terms (each term AND'd inside).
     required_affinity_terms: List[Requirements] = field(default_factory=list)
     preferred_affinity_terms: List[Tuple[int, Requirements]] = field(default_factory=list)
+    # Zones allowed by the pod's bound persistent volumes (PV topology: the
+    # reference scheduler folds PV nodeAffinity into the pod's requirements —
+    # website concepts/scheduling.md "persistent volume topology"). Empty =
+    # unconstrained.
+    volume_zones: List[str] = field(default_factory=list)
     tolerations: List[Toleration] = field(default_factory=list)
     topology_spread: List[TopologySpreadConstraint] = field(default_factory=list)
     affinity_terms: List[PodAffinityTerm] = field(default_factory=list)  # required only
@@ -86,16 +91,57 @@ class Pod:
     def name(self) -> str:
         return self.meta.name
 
+    def active_preferred_terms(self) -> List[Tuple[int, Requirements]]:
+        """Preferred terms still in force at this pod's relaxation level:
+        the ``_relax_level`` lowest-weight terms are dropped (the reference
+        scheduler relaxes preferences one at a time, weakest first, only
+        while the pod cannot schedule)."""
+        prefs = self.preferred_affinity_terms
+        if not prefs:
+            return []
+        level = self.__dict__.get("_relax_level", 0)
+        if level >= len(prefs):
+            return []
+        return sorted(prefs, key=lambda t: t[0])[level:]
+
     def scheduling_requirement_terms(self) -> List[Requirements]:
         """OR'd requirement terms: nodeSelector AND'd into each affinity term.
 
         Mirrors how core's scheduler folds nodeSelector + requiredDuringScheduling
-        node affinity into scheduling requirements (website concepts/scheduling.md).
+        node affinity into scheduling requirements, with PV topology zones
+        folded in as a zone requirement, and preferredDuringScheduling terms
+        treated as REQUIRED until relaxed (website concepts/scheduling.md
+        "preferences"); see ``active_preferred_terms``.
         """
         base = Requirements.from_labels(self.node_selector)
+        if self.volume_zones:
+            base = base.add(Requirement.in_values(wk.ZONE, self.volume_zones))
+        for _, term in self.active_preferred_terms():
+            base = base.intersect(term)
         if not self.required_affinity_terms:
             return [base]
         return [base.intersect(term) for term in self.required_affinity_terms]
+
+    def relax_preferences(self) -> bool:
+        """Drop the weakest still-active soft constraint (called when the pod
+        failed to schedule with it). Returns True when something was relaxed."""
+        prefs = self.preferred_affinity_terms
+        level = self.__dict__.get("_relax_level", 0)
+        if prefs and level < len(prefs):
+            self.__dict__["_relax_level"] = level + 1
+            self.__dict__.pop("_sched_sig", None)  # grouping key changed
+            return True
+        return False
+
+    def relaxed_clone(self) -> "Pod":
+        """A copy of this pod with one more preference relaxed — solvers use
+        clones so a what-if simulation (consolidation) or a transient
+        unschedulability never permanently strips a LIVE pod's preferences."""
+        import dataclasses
+
+        clone = dataclasses.replace(self)
+        clone.__dict__["_relax_level"] = self.__dict__.get("_relax_level", 0) + 1
+        return clone
 
     def deletion_cost(self) -> float:
         try:

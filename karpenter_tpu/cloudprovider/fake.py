@@ -271,50 +271,39 @@ class FakeCloudProvider(CloudProvider):
         )
         chosen_ct = wk.CAPACITY_TYPE_SPOT if use_spot else wk.CAPACITY_TYPE_ON_DEMAND
         zone_req = reqs.get(wk.ZONE)
+        # ONE pass collects launchable offerings into the chosen-capacity list
+        # and (for the spot-vs-OD comparison) the on-demand alternative list,
+        # priced LIVE (pricing.go feeds instance.go's price-ordered launch
+        # list), so the two can never use different filter rules.
         priced: List[Tuple[float, InstanceType, Offering]] = []
-        cheapest_od = float("inf")
+        od_candidates: List[Tuple[float, InstanceType, Offering]] = []
         for it in types:
             for o in it.offerings:
-                if not o.available or o.capacity_type != chosen_ct:
-                    continue
-                if not zone_req.has(o.zone):
+                if not o.available or not zone_req.has(o.zone):
                     continue
                 if self.unavailable_offerings.is_unavailable(it.name, o.zone, o.capacity_type):
                     continue
-                # order by LIVE price (pricing.go feeds instance.go's
-                # price-ordered launch list), not the catalog anchor
-                price = self.pricing.price(it.name, o.zone, o.capacity_type)
-                priced.append((price if price is not None else o.price, it, o))
-        if chosen_ct == wk.CAPACITY_TYPE_SPOT and ct_req.has(wk.CAPACITY_TYPE_ON_DEMAND):
+                p = self.pricing.price(it.name, o.zone, o.capacity_type)
+                entry = (p if p is not None else o.price, it, o)
+                if o.capacity_type == chosen_ct:
+                    priced.append(entry)
+                elif o.capacity_type == wk.CAPACITY_TYPE_ON_DEMAND:
+                    od_candidates.append(entry)
+        if (
+            chosen_ct == wk.CAPACITY_TYPE_SPOT
+            and ct_req.has(wk.CAPACITY_TYPE_ON_DEMAND)
+            and od_candidates
+        ):
             # Spot offerings pricier than the cheapest LAUNCHABLE on-demand are
             # strictly worse (pay more AND risk reclaim) — drop them
             # (instance.go:486-508 filterInstanceTypes). Only applies when the
             # machine may actually use on-demand; spot-pinned machines keep
             # their offerings regardless of price.
-            od_candidates = [
-                (
-                    p if (p := self.pricing.price(it.name, o.zone, o.capacity_type)) is not None else o.price,
-                    it,
-                    o,
-                )
-                for it in types
-                for o in it.offerings
-                if o.available
-                and o.capacity_type == wk.CAPACITY_TYPE_ON_DEMAND
-                and zone_req.has(o.zone)
-                and not self.unavailable_offerings.is_unavailable(
-                    it.name, o.zone, o.capacity_type
-                )
-            ]
-            if od_candidates:
-                cheapest_od = min(e[0] for e in od_candidates)
+            cheapest_od = min(e[0] for e in od_candidates)
             filtered = [e for e in priced if e[0] < cheapest_od]
-            if filtered:
-                priced = filtered
-            elif od_candidates:
-                # every spot offering is pricier than on-demand: launch
-                # on-demand instead of paying a spot premium for reclaim risk
-                priced = od_candidates
+            # all spot overpriced: launch on-demand instead of paying a spot
+            # premium for reclaim risk
+            priced = filtered if filtered else od_candidates
         priced.sort(key=lambda p: p[0])
         # Reference truncates the launch request to the cheapest 60 types
         # (instance.go:55,90-92); we bound offerings similarly.

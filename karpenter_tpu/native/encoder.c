@@ -31,7 +31,8 @@
 
 static PyObject *sig_key = NULL; /* interned "_sched_sig" */
 static PyObject *s_required_affinity_terms, *s_tolerations, *s_topology_spread,
-    *s_affinity_terms, *s_requests, *s_r, *s_node_selector, *s_meta, *s_labels;
+    *s_affinity_terms, *s_requests, *s_r, *s_node_selector, *s_meta, *s_labels,
+    *s_preferred_affinity_terms, *s_volume_zones;
 
 /* tuple(d.items()) for a dict; () for empty/non-dict (caller validates). */
 static PyObject *
@@ -101,6 +102,10 @@ signature_for(PyObject *pod, PyObject *py_signature)
         complex_shape = nonempty_list_attr(pod, s_topology_spread);
     if (complex_shape == 0)
         complex_shape = nonempty_list_attr(pod, s_affinity_terms);
+    if (complex_shape == 0)
+        complex_shape = nonempty_list_attr(pod, s_preferred_affinity_terms);
+    if (complex_shape == 0)
+        complex_shape = nonempty_list_attr(pod, s_volume_zones);
     if (complex_shape < 0) {
         Py_DECREF(dict);
         return NULL;
@@ -136,9 +141,10 @@ signature_for(PyObject *pod, PyObject *py_signature)
     empty = PyTuple_New(0);
     if (empty == NULL)
         goto fail;
-    /* (requests, node_selector, (), (), (), (), labels) */
-    sig = PyTuple_Pack(7, req_items, sel_items, empty, empty, empty, empty,
-                       lab_items);
+    /* (requests, node_selector, (), (), (), (), labels, (), ()) — the same
+     * 9-tuple layout encode._signature builds for the simple shape */
+    sig = PyTuple_Pack(9, req_items, sel_items, empty, empty, empty, empty,
+                       lab_items, empty, empty);
     Py_DECREF(empty);
     if (sig == NULL)
         goto fail;
@@ -250,10 +256,13 @@ PyInit__encoder(void)
     s_node_selector = PyUnicode_InternFromString("node_selector");
     s_meta = PyUnicode_InternFromString("meta");
     s_labels = PyUnicode_InternFromString("labels");
+    s_preferred_affinity_terms = PyUnicode_InternFromString("preferred_affinity_terms");
+    s_volume_zones = PyUnicode_InternFromString("volume_zones");
     if (sig_key == NULL || s_required_affinity_terms == NULL ||
         s_tolerations == NULL || s_topology_spread == NULL ||
         s_affinity_terms == NULL || s_requests == NULL || s_r == NULL ||
-        s_node_selector == NULL || s_meta == NULL || s_labels == NULL)
+        s_node_selector == NULL || s_meta == NULL || s_labels == NULL ||
+        s_preferred_affinity_terms == NULL || s_volume_zones == NULL)
         return NULL;
     return PyModule_Create(&moduledef);
 }

@@ -96,6 +96,14 @@ def _signature(pod: Pod) -> tuple:
                          for r in term))
             for term in pod.required_affinity_terms
         )
+    soft = _EMPTY
+    if pod.preferred_affinity_terms:
+        soft = tuple(
+            (w, tuple(sorted((r.key, r.complement, tuple(sorted(r.values)),
+                              r.greater_than, r.less_than) for r in term)))
+            for w, term in pod.active_preferred_terms()
+        )
+    vz = tuple(pod.volume_zones) if pod.volume_zones else _EMPTY
     tol = _EMPTY
     if pod.tolerations:
         tol = tuple(sorted((t.key, t.operator, t.value, t.effect) for t in pod.tolerations))
@@ -115,6 +123,8 @@ def _signature(pod: Pod) -> tuple:
         spread,
         aff,
         _items_t(pod.meta.labels),
+        soft,
+        vz,
     )
     pod.__dict__["_sched_sig"] = sig
     return sig

@@ -156,13 +156,46 @@ class Solver(abc.ABC):
         from ..utils.tracing import span
 
         t0 = time.perf_counter()
+        encode_s = 0.0
         with span("solve", pods=len(pods)):
             with span("solve.encode"):
                 problem = encode(pods, provisioners, existing, daemonsets)
-            t1 = time.perf_counter()
+            encode_s += time.perf_counter() - t0
             with span("solve.backend"):
                 result = self.solve(problem)
-        result.stats["encode_s"] = t1 - t0
+            # Preference relaxation (the reference scheduler's relaxation
+            # pass): preferred node affinity is honored as a hard constraint
+            # first; a pod that cannot schedule sheds its weakest still-active
+            # preference (one per round) and the batch re-solves — soft
+            # constraints may never strand a pod. Relaxation happens on
+            # CLONES: live cluster pods keep their preferences, so a what-if
+            # simulation or transient failure never mutates real state.
+            work = None
+            total_relaxed = 0
+            while result.unschedulable:
+                if work is None:
+                    work = list(pods)
+                    index = {p.name: i for i, p in enumerate(work)}
+                relaxed_round = 0
+                for name in result.unschedulable:
+                    i = index.get(name)
+                    if i is None:
+                        continue
+                    p = work[i]
+                    if p.active_preferred_terms():
+                        work[i] = p.relaxed_clone()
+                        relaxed_round += 1
+                if relaxed_round == 0:
+                    break
+                total_relaxed += relaxed_round
+                with span("solve.relax", pods=relaxed_round):
+                    t_enc = time.perf_counter()
+                    problem = encode(work, provisioners, existing, daemonsets)
+                    encode_s += time.perf_counter() - t_enc
+                    result = self.solve(problem)
+            if total_relaxed:
+                result.stats["relaxed_pods"] = float(total_relaxed)
+        result.stats["encode_s"] = encode_s
         result.stats["total_s"] = time.perf_counter() - t0
         result.stats["lower_bound"] = lower_bound(problem)
         return result

@@ -214,3 +214,96 @@ class TestStartupTaints:
         res2 = ctl.reconcile()
         # the non-tolerating pod must NOT reuse the tainted node
         assert cluster.pods["plain"].node_name != res1.nodes[0].name
+
+
+class TestSoftConstraintsAndVolumes:
+    def test_volume_zone_pins_pod(self):
+        provider = FakeCloudProvider(catalog=generate_catalog(n_types=30))
+        cluster = Cluster()
+        cluster.add_provisioner(Provisioner(meta=ObjectMeta(name="default")))
+        ctl = ProvisioningController(cluster, provider)
+        cluster.add_pod(Pod(
+            meta=ObjectMeta(name="pv-pod"),
+            requests=Resources(cpu="250m", memory="256Mi"),
+            volume_zones=["zone-b"],
+        ))
+        res = ctl.reconcile()
+        assert not res.unschedulable
+        node = cluster.nodes[cluster.pods["pv-pod"].node_name]
+        assert node.zone() == "zone-b"
+
+    def test_preferred_affinity_honored_when_satisfiable(self):
+        from karpenter_tpu.api import Requirement, Requirements
+
+        provider = FakeCloudProvider(catalog=generate_catalog(n_types=30))
+        cluster = Cluster()
+        cluster.add_provisioner(Provisioner(meta=ObjectMeta(name="default")))
+        ctl = ProvisioningController(cluster, provider)
+        cluster.add_pod(Pod(
+            meta=ObjectMeta(name="pref"),
+            requests=Resources(cpu="250m", memory="256Mi"),
+            preferred_affinity_terms=[
+                (10, Requirements([Requirement.in_values(wk.ZONE, ["zone-c"])]))
+            ],
+        ))
+        res = ctl.reconcile()
+        assert not res.unschedulable
+        node = cluster.nodes[cluster.pods["pref"].node_name]
+        assert node.zone() == "zone-c"
+
+    def test_unsatisfiable_preference_relaxed_not_unschedulable(self):
+        from karpenter_tpu.api import Requirement, Requirements
+
+        provider = FakeCloudProvider(catalog=generate_catalog(n_types=30))
+        cluster = Cluster()
+        cluster.add_provisioner(Provisioner(meta=ObjectMeta(name="default")))
+        ctl = ProvisioningController(cluster, provider)
+        cluster.add_pod(Pod(
+            meta=ObjectMeta(name="soft"),
+            requests=Resources(cpu="250m", memory="256Mi"),
+            preferred_affinity_terms=[
+                (1, Requirements([Requirement.in_values(wk.ZONE, ["zone-on-the-moon"])]))
+            ],
+        ))
+        res = ctl.reconcile()
+        # a soft constraint may never strand the pod: it relaxes and binds
+        assert not res.unschedulable
+        assert cluster.pods["soft"].node_name is not None
+        assert res.solve.stats.get("relaxed_pods") == 1.0
+
+    def test_hard_constraint_still_unschedulable(self):
+        provider = FakeCloudProvider(catalog=generate_catalog(n_types=30))
+        cluster = Cluster()
+        cluster.add_provisioner(Provisioner(meta=ObjectMeta(name="default")))
+        ctl = ProvisioningController(cluster, provider)
+        cluster.add_pod(Pod(
+            meta=ObjectMeta(name="hard"),
+            requests=Resources(cpu="250m"),
+            node_selector={wk.ZONE: "zone-on-the-moon"},
+        ))
+        res = ctl.reconcile()
+        assert res.unschedulable == ["hard"]
+
+    def test_one_by_one_relaxation_keeps_satisfiable_preferences(self):
+        """Weakest preference drops first; a satisfiable stronger preference
+        survives relaxation, and the LIVE pod object is never mutated."""
+        from karpenter_tpu.api import Requirement, Requirements
+
+        provider = FakeCloudProvider(catalog=generate_catalog(n_types=30))
+        cluster = Cluster()
+        cluster.add_provisioner(Provisioner(meta=ObjectMeta(name="default")))
+        ctl = ProvisioningController(cluster, provider)
+        pod = Pod(
+            meta=ObjectMeta(name="p"),
+            requests=Resources(cpu="250m", memory="256Mi"),
+            preferred_affinity_terms=[
+                (10, Requirements([Requirement.in_values(wk.ZONE, ["zone-c"])])),
+                (1, Requirements([Requirement.in_values(wk.ZONE, ["zone-on-the-moon"])])),
+            ],
+        )
+        cluster.add_pod(pod)
+        res = ctl.reconcile()
+        assert not res.unschedulable
+        node = cluster.nodes[cluster.pods["p"].node_name]
+        assert node.zone() == "zone-c"
+        assert pod.__dict__.get("_relax_level") is None  # clone-only relaxation
