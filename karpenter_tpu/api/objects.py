@@ -91,6 +91,14 @@ class Pod:
     def name(self) -> str:
         return self.meta.name
 
+    def _soft_constraint_count(self) -> int:
+        return len(self.preferred_affinity_terms) + sum(
+            1 for c in self.topology_spread if c.when_unsatisfiable != "DoNotSchedule"
+        )
+
+    def has_relaxable_constraints(self) -> bool:
+        return self.__dict__.get("_relax_level", 0) < self._soft_constraint_count()
+
     def active_preferred_terms(self) -> List[Tuple[int, Requirements]]:
         """Preferred terms still in force at this pod's relaxation level:
         the ``_relax_level`` lowest-weight terms are dropped (the reference
@@ -103,6 +111,22 @@ class Pod:
         if level >= len(prefs):
             return []
         return sorted(prefs, key=lambda t: t[0])[level:]
+
+    def effective_spread(self) -> List["TopologySpreadConstraint"]:
+        """Topology spread constraints in force: DoNotSchedule always; a
+        ScheduleAnyway constraint is PROMOTED to hard (the reference honors
+        soft spreads until the pod cannot schedule, then relaxes them AFTER
+        the pod's preferred affinities are exhausted — relaxation list order:
+        preferences weakest-first, then soft spreads)."""
+        spread = self.topology_spread
+        if all(c.when_unsatisfiable == "DoNotSchedule" for c in spread):
+            return spread  # hot-path fast path: nothing soft, nothing to split
+        hard = [c for c in spread if c.when_unsatisfiable == "DoNotSchedule"]
+        soft = [c for c in spread if c.when_unsatisfiable != "DoNotSchedule"]
+        over = self.__dict__.get("_relax_level", 0) - len(self.preferred_affinity_terms)
+        if over > 0:
+            soft = soft[over:]
+        return hard + soft
 
     def scheduling_requirement_terms(self) -> List[Requirements]:
         """OR'd requirement terms: nodeSelector AND'd into each affinity term.
@@ -123,12 +147,13 @@ class Pod:
         return [base.intersect(term) for term in self.required_affinity_terms]
 
     def relax_preferences(self) -> bool:
-        """Drop the weakest still-active soft constraint (called when the pod
-        failed to schedule with it). Returns True when something was relaxed."""
-        prefs = self.preferred_affinity_terms
-        level = self.__dict__.get("_relax_level", 0)
-        if prefs and level < len(prefs):
-            self.__dict__["_relax_level"] = level + 1
+        """IN-PLACE relaxation of the weakest still-active soft constraint
+        (preferred affinities weakest-first, then ScheduleAnyway spreads).
+        Solvers use ``relaxed_clone`` instead so live pods stay untouched;
+        this is the mutating form for callers that own the pod. Returns True
+        when something was relaxed."""
+        if self.has_relaxable_constraints():
+            self.__dict__["_relax_level"] = self.__dict__.get("_relax_level", 0) + 1
             self.__dict__.pop("_sched_sig", None)  # grouping key changed
             return True
         return False

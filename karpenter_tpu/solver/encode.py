@@ -110,7 +110,7 @@ def _signature(pod: Pod) -> tuple:
     spread = _EMPTY
     if pod.topology_spread:
         spread = tuple(sorted((c.max_skew, c.topology_key, c.when_unsatisfiable,
-                               _sorted_items(c.label_selector)) for c in pod.topology_spread))
+                               _sorted_items(c.label_selector)) for c in pod.effective_spread()))
     aff = _EMPTY
     if pod.affinity_terms:
         aff = tuple(sorted((t.topology_key, t.anti, _sorted_items(t.label_selector))
@@ -162,15 +162,18 @@ def group_pods(pods: Sequence[Pod]) -> List[PodGroup]:
         zone_cap = BIG_CAP
         zone_skew = 0
         colocate = False
-        for c in pod.topology_spread:
-            if c.when_unsatisfiable != "DoNotSchedule" or not c.selects(pod):
+        for c in pod.effective_spread():
+            if not c.selects(pod):
                 continue
             if c.topology_key == wk.HOSTNAME:
                 # Conservative: capping each node at maxSkew keeps |max-min| <= skew
                 # for any node population (min can stay 0 on fresh nodes).
                 node_cap = min(node_cap, max(1, c.max_skew))
             elif c.topology_key == wk.ZONE:
-                zone_skew = max(zone_skew, c.max_skew)
+                # TIGHTEST applicable skew: every constraint (hard and
+                # promoted-soft) is validated independently, so the quota must
+                # honor the strictest one, not the loosest
+                zone_skew = c.max_skew if zone_skew == 0 else min(zone_skew, c.max_skew)
         for t in pod.affinity_terms:
             if not t.selects(pod):
                 continue  # cross-group affinity handled only by the greedy fallback
@@ -732,12 +735,8 @@ def _topology_seeds(
         rep = groups[i].pods[0]
         # per-zone spread seeds (first DoNotSchedule zone constraint drives
         # the quota; the validator checks every constraint independently)
-        for c in rep.topology_spread:
-            if (
-                c.when_unsatisfiable == "DoNotSchedule"
-                and c.topology_key == wk.ZONE
-                and c.selects(rep)
-            ):
+        for c in rep.effective_spread():
+            if c.topology_key == wk.ZONE and c.selects(rep):
                 for _, zone, p in seed_pods:
                     zi = zone_index.get(zone)
                     if zi is not None and c.selects(p):
@@ -746,10 +745,8 @@ def _topology_seeds(
         # hostname-capped groups: occupied nodes are off-limits
         host_sels = [
             c.selects
-            for c in rep.topology_spread
-            if c.when_unsatisfiable == "DoNotSchedule"
-            and c.topology_key == wk.HOSTNAME
-            and c.selects(rep)
+            for c in rep.effective_spread()
+            if c.topology_key == wk.HOSTNAME and c.selects(rep)
         ]
         colocate_sel = None
         for t in rep.affinity_terms:

@@ -56,9 +56,9 @@ class GreedyPacker:
 
     # -- constraint checks against the evolving assignment ------------------
     def _spread_ok(self, pod: Pod, node: _SimNode) -> bool:
-        for c in pod.topology_spread:
-            if c.when_unsatisfiable != "DoNotSchedule":
-                continue
+        # effective_spread: DoNotSchedule plus still-active promoted
+        # ScheduleAnyway constraints (relaxation happens via pod clones)
+        for c in pod.effective_spread():
             # Zone domains include every zone in the problem (empty zones count 0);
             # hostname domains always admit a fresh empty node, so min stays 0.
             counts: Dict[str, int] = (
@@ -69,7 +69,9 @@ class GreedyPacker:
                 counts.setdefault(key, 0)
                 counts[key] += sum(1 for q in n.pods if c.selects(q))
             key = node.host_id() if c.topology_key == wk.HOSTNAME else node.zone
-            new_count = counts.get(key, 0) + 1
+            # selfMatchNum: the incoming pod only counts toward the skew when
+            # the constraint's selector matches the pod itself
+            new_count = counts.get(key, 0) + (1 if c.selects(pod) else 0)
             min_count = 0 if c.topology_key == wk.HOSTNAME else min(counts.values(), default=0)
             if new_count - min_count > c.max_skew:
                 return False

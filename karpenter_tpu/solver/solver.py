@@ -182,7 +182,7 @@ class Solver(abc.ABC):
                     if i is None:
                         continue
                     p = work[i]
-                    if p.active_preferred_terms():
+                    if p.has_relaxable_constraints():
                         work[i] = p.relaxed_clone()
                         relaxed_round += 1
                 if relaxed_round == 0:

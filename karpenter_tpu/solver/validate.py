@@ -141,9 +141,7 @@ def check_topology(problem: EncodedProblem, agg: Dict[tuple, int]) -> List[str]:
 
     for gi, g in enumerate(problem.groups):
         rep = reps[gi]
-        for c in rep.topology_spread:
-            if c.when_unsatisfiable != "DoNotSchedule":
-                continue
+        for c in rep.effective_spread():
             selected_groups = [gj for gj, r in enumerate(reps) if c.selects(r)]
             new_counts: Dict[str, int] = defaultdict(int)
             for (gj, host, zone), n in agg.items():

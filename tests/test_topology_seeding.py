@@ -307,3 +307,52 @@ class TestSoftConstraintsAndVolumes:
         node = cluster.nodes[cluster.pods["p"].node_name]
         assert node.zone() == "zone-c"
         assert pod.__dict__.get("_relax_level") is None  # clone-only relaxation
+
+    def test_schedule_anyway_spread_honored_best_effort(self):
+        """ScheduleAnyway spreads balance when possible and relax rather than
+        strand pods (reference: soft spreads join the relaxation list)."""
+        provider = FakeCloudProvider(catalog=generate_catalog(n_types=30))
+        cluster = Cluster()
+        cluster.add_provisioner(Provisioner(meta=ObjectMeta(name="default")))
+        ctl = ProvisioningController(cluster, provider)
+        for i in range(6):
+            cluster.add_pod(Pod(
+                meta=ObjectMeta(name=f"sa-{i}", labels={"app": "soft"}),
+                requests=Resources(cpu="250m", memory="256Mi"),
+                topology_spread=[TopologySpreadConstraint(
+                    max_skew=1, topology_key=wk.ZONE,
+                    label_selector={"app": "soft"},
+                    when_unsatisfiable="ScheduleAnyway",
+                )],
+            ))
+        res = ctl.reconcile()
+        assert not res.unschedulable
+        counts = {}
+        for p in cluster.pods.values():
+            z = cluster.nodes[p.node_name].zone()
+            counts[z] = counts.get(z, 0) + 1
+        assert max(counts.values()) - min(counts.values()) <= 1, counts
+
+    def test_schedule_anyway_relaxes_when_zone_pinned(self):
+        """A soft spread conflicting with a hard zone pin relaxes instead of
+        stranding the pods."""
+        provider = FakeCloudProvider(catalog=generate_catalog(n_types=30))
+        cluster = Cluster()
+        cluster.add_provisioner(Provisioner(meta=ObjectMeta(name="default")))
+        ctl = ProvisioningController(cluster, provider)
+        for i in range(4):
+            cluster.add_pod(Pod(
+                meta=ObjectMeta(name=f"pin-{i}", labels={"app": "pinned"}),
+                requests=Resources(cpu="250m", memory="256Mi"),
+                node_selector={wk.ZONE: "zone-a"},  # hard: one zone only
+                topology_spread=[TopologySpreadConstraint(
+                    max_skew=1, topology_key=wk.ZONE,
+                    label_selector={"app": "pinned"},
+                    when_unsatisfiable="ScheduleAnyway",
+                )],
+            ))
+        res = ctl.reconcile()
+        assert not res.unschedulable
+        for p in cluster.pods.values():
+            assert cluster.nodes[p.node_name].zone() == "zone-a"
+        assert res.solve.stats.get("relaxed_pods", 0) > 0
