@@ -222,7 +222,7 @@ class KubeletConfiguration:
     (/root/reference/pkg/providers/instancetype/types.go:241-340).
     """
 
-    cluster_dns: Optional[str] = None
+    cluster_dns: Optional[List[str]] = None  # list of DNS IPs (k8s clusterDNS)
     max_pods: Optional[int] = None
     pods_per_core: Optional[int] = None
     kube_reserved: Optional[Resources] = None

@@ -147,24 +147,66 @@ class ProvisioningController:
             return result
 
         provs = [(p, self.provider.get_instance_types(p)) for p in provisioners]
-        existing = self.cluster.existing_capacity()
         daemonsets = self.cluster.daemonsets()
 
-        solve = self.solver.solve_pods(pods, provs, existing=existing, daemonsets=daemonsets)
-        result.solve = solve
-        metrics.SOLVE_DURATION.observe(solve.stats.get("total_s", 0.0))
+        # Pool cascade (reference: provisioners are tried highest-weight-first
+        # and a pool that cannot host — limits reached, zone coverage too
+        # narrow — is skipped for the next one): each round solves the still-
+        # pending pods against the non-exhausted pools; a round that exhausts
+        # a pool's limits re-solves without it.
+        batch = list(pods)
+        exhausted: set = set()
+        for round_no in range(max(len(provisioners), 1) + 1):
+            round_provs = [(p, t) for (p, t) in provs if p.name not in exhausted]
+            if not round_provs or not batch:
+                result.unschedulable.extend(p.name for p in batch)
+                break
+            solve = self.solver.solve_pods(
+                batch,
+                round_provs,
+                existing=self.cluster.existing_capacity(),
+                daemonsets=daemonsets,
+            )
+            if result.solve is None:
+                result.solve = solve
+            metrics.SOLVE_DURATION.observe(solve.stats.get("total_s", 0.0))
+            limit_hit = self._apply_solve(solve, result)
+            if limit_hit:
+                exhausted |= limit_hit
+                still = {
+                    n for n in result.unschedulable
+                    if (q := self.cluster.pods.get(n)) is not None and q.is_pending()
+                }
+                if still:
+                    batch = [q for q in batch if q.name in still]
+                    result.unschedulable = [n for n in result.unschedulable if n not in still]
+                    continue
+            result.unschedulable.extend(solve.unschedulable)
+            for name in solve.unschedulable:
+                self.recorder.publish(
+                    "FailedScheduling", "no feasible instance offering", object_name=name,
+                    object_kind="Pod", type="Warning",
+                )
+            break
+        metrics.PODS_UNSCHEDULABLE.set(float(len(result.unschedulable)))
+        metrics.PROVISIONING_DURATION.observe(time.perf_counter() - t0)
+        self.batcher.reset(upto_generation=batch_gen)
+        return result
 
-        # bind pods onto existing nodes first
+    def _apply_solve(self, solve: SolveResult, result: ProvisioningResult) -> set:
+        """Bind existing-node assignments and launch new nodes for one solve,
+        honoring provisioner limits. Returns the names of provisioners whose
+        limits blocked specs this pass (the caller cascades to other pools)."""
         for node_name, pod_names in solve.existing_assignments.items():
             for pod_name in pod_names:
                 self.cluster.bind_pod(pod_name, node_name)
                 result.bound[pod_name] = node_name
                 metrics.PODS_SCHEDULED.inc()
 
-        # launch new nodes, honoring provisioner limits (serial phase: limit
-        # accounting is order-dependent)
+        # limits phase is serial: accounting is order-dependent
         usage: Dict[str, Resources] = {}
         launchable: List[NewNodeSpec] = []
+        limit_hit: set = set()
         for spec in solve.new_nodes:
             prov = spec.option.provisioner
             if prov.limits is not None:
@@ -180,6 +222,7 @@ class ProvisioningController:
                         object_kind="Provisioner",
                         type="Warning",
                     )
+                    limit_hit.add(prov.name)
                     result.unschedulable.extend(spec.pod_names)
                     continue
                 usage[prov.name] = projected
@@ -214,17 +257,7 @@ class ProvisioningController:
                 self.cluster.bind_pod(pod_name, node.name)
                 result.bound[pod_name] = node.name
                 metrics.PODS_SCHEDULED.inc()
-
-        result.unschedulable.extend(solve.unschedulable)
-        for name in solve.unschedulable:
-            self.recorder.publish(
-                "FailedScheduling", "no feasible instance offering", object_name=name,
-                object_kind="Pod", type="Warning",
-            )
-        metrics.PODS_UNSCHEDULABLE.set(float(len(result.unschedulable)))
-        metrics.PROVISIONING_DURATION.observe(time.perf_counter() - t0)
-        self.batcher.reset(upto_generation=batch_gen)
-        return result
+        return limit_hit
 
     def _launch(self, spec: NewNodeSpec, create_fn=None) -> Tuple[Machine, Node]:
         requests = merge([self._pod_requests(n) for n in spec.pod_names])

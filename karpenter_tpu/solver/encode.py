@@ -563,6 +563,7 @@ def encode(
     provisioners: Sequence[Tuple[Provisioner, Sequence[InstanceType]]],
     existing: Sequence[ExistingNode] = (),
     daemonsets: Sequence[Pod] = (),
+    weight_degate: frozenset = frozenset(),
 ) -> EncodedProblem:
     # The ONLY vocab compaction boundary: every table built or reused inside
     # one encode must share a code generation with the vocab that eval reads.
@@ -619,6 +620,25 @@ def encode(
         per_pod = _vector(g.requests, axes, pods=1.0)
         cap_ok = ~np.any(per_pod[None, :] > alloc + 1e-9, axis=1)
         compat[i] = tol_ok & req_ok & cap_ok
+
+    # Provisioner weight priority: when a group is compatible with options
+    # from provisioners of different weights, only the HIGHEST weight's
+    # options stay eligible — weights are a strict preference order (the
+    # reference tries provisioners highest-weight-first), not a tiebreak the
+    # price ordering may override. Existing-capacity reuse is not gated.
+    # ``weight_degate`` lists pods whose groups fall back to ALL weights —
+    # the controller's next-pool pass when the preferred pool cannot host
+    # them (limits exhausted, zone coverage too narrow for a spread).
+    opt_weight = np.array([o.provisioner.weight for o in options], np.int64)
+    if O and opt_weight.size and opt_weight.min() != opt_weight.max():
+        for i, g in enumerate(groups):
+            row = compat[i]
+            if not row.any():
+                continue
+            if weight_degate and any(p.name in weight_degate for p in g.pods):
+                continue
+            best_w = opt_weight[row].max()
+            compat[i] = row & (opt_weight == best_w)
 
     ex_rem = np.zeros((E, R), dtype=np.float64)
     ex_zone = np.zeros((E,), dtype=np.int32)

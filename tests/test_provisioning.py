@@ -166,3 +166,82 @@ class TestProvisioning:
         result = controller.reconcile()
         assert result.unschedulable == []
         assert len(result.bound) == 30
+
+
+class TestProvisionerWeightPriority:
+    def test_higher_weight_provisioner_wins_even_when_pricier(self):
+        """Weights are a strict preference order (reference: provisioners are
+        tried highest-weight-first), not overridable by price."""
+        from karpenter_tpu.api import ObjectMeta, Pod, Provisioner, Requirement, Requirements, Resources
+        from karpenter_tpu.api import labels as wk
+        from karpenter_tpu.cloudprovider import FakeCloudProvider, generate_catalog
+        from karpenter_tpu.controllers.provisioning import ProvisioningController
+        from karpenter_tpu.state import Cluster
+
+        catalog = generate_catalog(n_types=40)
+        provider = FakeCloudProvider(catalog=catalog)
+        cluster = Cluster()
+        # the high-weight pool is restricted to pricier large types
+        big = sorted(catalog, key=lambda t: -t.capacity["cpu"])[0]
+        cluster.add_provisioner(Provisioner(
+            meta=ObjectMeta(name="priority"), weight=50,
+            requirements=Requirements(
+                [Requirement.in_values(wk.INSTANCE_TYPE, [big.name])]
+            ),
+        ))
+        cluster.add_provisioner(Provisioner(meta=ObjectMeta(name="default"), weight=0))
+        ctl = ProvisioningController(cluster, provider)
+        cluster.add_pod(Pod(meta=ObjectMeta(name="p"),
+                            requests=Resources(cpu="250m", memory="256Mi")))
+        res = ctl.reconcile()
+        assert not res.unschedulable
+        node = cluster.nodes[cluster.pods["p"].node_name]
+        assert node.provisioner_name() == "priority"
+        assert node.instance_type() == big.name
+
+    def test_incompatible_high_weight_falls_to_lower(self):
+        from karpenter_tpu.api import ObjectMeta, Pod, Provisioner, Resources, Taint
+        from karpenter_tpu.api import labels as wk
+        from karpenter_tpu.cloudprovider import FakeCloudProvider, generate_catalog
+        from karpenter_tpu.controllers.provisioning import ProvisioningController
+        from karpenter_tpu.state import Cluster
+
+        provider = FakeCloudProvider(catalog=generate_catalog(n_types=20))
+        cluster = Cluster()
+        cluster.add_provisioner(Provisioner(
+            meta=ObjectMeta(name="gated"), weight=50,
+            taints=[Taint(key="team", value="ml")],  # pod doesn't tolerate
+        ))
+        cluster.add_provisioner(Provisioner(meta=ObjectMeta(name="default"), weight=0))
+        ctl = ProvisioningController(cluster, provider)
+        cluster.add_pod(Pod(meta=ObjectMeta(name="p"),
+                            requests=Resources(cpu="250m", memory="256Mi")))
+        res = ctl.reconcile()
+        assert not res.unschedulable
+        node = cluster.nodes[cluster.pods["p"].node_name]
+        assert node.provisioner_name() == "default"
+
+    def test_limit_exhausted_pool_falls_to_next_weight(self):
+        """A weight-preferred pool at its resource limits is skipped for the
+        next pool in the SAME reconcile (reference: limit-exceeded pools are
+        skipped in the weight cascade) — the pod must not strand."""
+        from karpenter_tpu.api import ObjectMeta, Pod, Provisioner, Resources
+        from karpenter_tpu.api import labels as wk
+        from karpenter_tpu.cloudprovider import FakeCloudProvider, generate_catalog
+        from karpenter_tpu.controllers.provisioning import ProvisioningController
+        from karpenter_tpu.state import Cluster
+
+        provider = FakeCloudProvider(catalog=generate_catalog(n_types=20))
+        cluster = Cluster()
+        cluster.add_provisioner(Provisioner(
+            meta=ObjectMeta(name="prio"), weight=50,
+            limits=Resources(cpu="0.001"),  # effectively exhausted
+        ))
+        cluster.add_provisioner(Provisioner(meta=ObjectMeta(name="default"), weight=0))
+        ctl = ProvisioningController(cluster, provider)
+        cluster.add_pod(Pod(meta=ObjectMeta(name="p"),
+                            requests=Resources(cpu="250m", memory="256Mi")))
+        res = ctl.reconcile()
+        assert not res.unschedulable
+        node = cluster.nodes[cluster.pods["p"].node_name]
+        assert node.provisioner_name() == "default"

@@ -327,7 +327,7 @@ class TestSoftConstraintsAndVolumes:
             ))
         res = ctl.reconcile()
         assert not res.unschedulable
-        counts = {}
+        counts = {z: 0 for z in ("zone-a", "zone-b", "zone-c")}  # empty zones count
         for p in cluster.pods.values():
             z = cluster.nodes[p.node_name].zone()
             counts[z] = counts.get(z, 0) + 1

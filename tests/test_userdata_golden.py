@@ -1,0 +1,55 @@
+"""Userdata golden-file tests — the reference's launchtemplate suite pins
+rendered bootstrap payloads to testdata goldens (suite_test.go + testdata/),
+so any change to the node personality is an explicit, reviewed diff."""
+
+import os
+
+import pytest
+
+from karpenter_tpu.api.objects import KubeletConfiguration
+from karpenter_tpu.api.taints import Taint
+from karpenter_tpu.cloudprovider.imagefamily import (
+    BootstrapContext,
+    ClusterInfo,
+    get_family,
+)
+
+TESTDATA = os.path.join(os.path.dirname(__file__), "testdata")
+
+
+def _ctx(custom=None):
+    return BootstrapContext(
+        cluster=ClusterInfo(name="golden-cluster", endpoint="https://golden.local",
+                            ca_bundle="Q0EtQlVORExF", dns_ip="10.0.0.10"),
+        kubelet=KubeletConfiguration(max_pods=58, cluster_dns=["10.0.0.10"]),
+        taints=(Taint(key="team", value="ml", effect="NoSchedule"),),
+        labels={"team": "ml", "tier": "batch"},
+        custom_user_data=custom,
+    )
+
+
+@pytest.mark.parametrize("family", ["al2", "ubuntu", "bottlerocket", "custom"])
+@pytest.mark.parametrize("custom", [None, "#!/bin/bash\necho custom-part\n"])
+def test_userdata_matches_golden(family, custom):
+    suffix = "_custom" if custom else ""
+    path = os.path.join(TESTDATA, f"userdata_{family}{suffix}.golden")
+    with open(path) as f:
+        golden = f.read()
+    rendered = get_family(family).user_data(_ctx(custom))
+    assert rendered == golden, (
+        f"userdata for {family}{suffix} changed; if intentional, regenerate "
+        f"tests/testdata (see test docstring)"
+    )
+
+
+def test_bottlerocket_custom_merge_preserves_user_keys():
+    """User TOML keys survive the merge; cluster-critical keys win."""
+    custom = '[settings.kubernetes]\ncluster-name = "evil"\n[settings.motd]\nbanner = "hi"\n'
+    out = get_family("bottlerocket").user_data(_ctx(custom))
+    assert 'cluster-name = "golden-cluster"' in out  # critical key wins
+    assert 'banner = "hi"' in out  # user key preserved
+
+
+def test_mime_multipart_orders_custom_first():
+    out = get_family("al2").user_data(_ctx("#!/bin/bash\necho custom-part\n"))
+    assert out.index("custom-part") < out.index("bootstrap.sh")
