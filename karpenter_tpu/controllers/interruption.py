@@ -49,7 +49,9 @@ class FakeQueue:
     (reference SQSProvider, sqs.go:33-105)."""
 
     def __init__(self) -> None:
-        self._messages: List[QueueMessage] = []
+        # insertion-ordered dict: receive() takes the head, delete() is O(1)
+        # (a 15k-message storm over a list was O(Q^2) in deletes alone)
+        self._messages: Dict[str, QueueMessage] = {}
         self._lock = threading.Lock()
         self._counter = 0
 
@@ -57,19 +59,22 @@ class FakeQueue:
         with self._lock:
             self._counter += 1
             mid = f"msg-{self._counter}"
-            self._messages.append(QueueMessage(id=mid, body=json.dumps(body)))
+            self._messages[mid] = QueueMessage(id=mid, body=json.dumps(body))
             return mid
 
     def receive(self, max_messages: int = 10) -> List[QueueMessage]:
         with self._lock:
-            batch = self._messages[:max_messages]
-            for m in batch:
+            batch = []
+            for m in self._messages.values():
+                if len(batch) >= max_messages:
+                    break
                 m.receive_count += 1
-            return list(batch)
+                batch.append(m)
+            return batch
 
     def delete(self, message_id: str) -> None:
         with self._lock:
-            self._messages = [m for m in self._messages if m.id != message_id]
+            self._messages.pop(message_id, None)
 
     def __len__(self) -> int:
         return len(self._messages)
@@ -164,6 +169,21 @@ class InterruptionController:
         self.unavailable_offerings = unavailable_offerings or UnavailableOfferings()
         self.recorder = recorder or Recorder()
         self.parsers = ParserRegistry()
+        # instance-id -> node-name map, cached across poll batches and
+        # invalidated by node watch events: rebuilding it per 10-message batch
+        # is O(nodes) and turns a 15k-node interruption storm into O(N^2).
+        # The generation counter closes the check-then-act race: a build only
+        # publishes if no node event landed while it ran.
+        self._id_map: Optional[Dict[str, str]] = None
+        self._id_gen = 0
+        cluster.watch(self._on_event)
+
+    def _on_event(self, event: str, obj) -> None:
+        from ..api.objects import Node
+
+        if isinstance(obj, Node):
+            self._id_gen += 1
+            self._id_map = None
 
     #: concurrent message workers, matching the reference's 10-way
     #: reconciler (controller.go:101 MaxConcurrentReconciles)
@@ -209,11 +229,17 @@ class InterruptionController:
 
     def _instance_id_map(self) -> Dict[str, str]:
         """instance id -> node name, parsed from providerIDs
-        (makeInstanceIDMap, controller.go:240-259)."""
+        (makeInstanceIDMap, controller.go:240-259); watch-invalidated cache."""
+        cached = self._id_map
+        if cached is not None:
+            return cached
+        gen = self._id_gen
         out = {}
-        for node in self.cluster.nodes.values():
+        for node in list(self.cluster.nodes.values()):
             if node.provider_id:
                 out[node.provider_id.rsplit("/", 1)[-1]] = node.name
+        if self._id_gen == gen:
+            self._id_map = out  # no node event raced the build
         return out
 
     def _handle(self, parsed: ParsedMessage, node_by_instance: Dict[str, str]) -> bool:

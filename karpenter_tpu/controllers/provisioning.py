@@ -159,7 +159,13 @@ class ProvisioningController:
         for round_no in range(max(len(provisioners), 1) + 1):
             round_provs = [(p, t) for (p, t) in provs if p.name not in exhausted]
             if not round_provs or not batch:
-                result.unschedulable.extend(p.name for p in batch)
+                for p in batch:
+                    result.unschedulable.append(p.name)
+                    self.recorder.publish(
+                        "FailedScheduling",
+                        "every eligible provisioner is at its resource limits",
+                        object_name=p.name, object_kind="Pod", type="Warning",
+                    )
                 break
             solve = self.solver.solve_pods(
                 batch,
@@ -173,13 +179,22 @@ class ProvisioningController:
             limit_hit = self._apply_solve(solve, result)
             if limit_hit:
                 exhausted |= limit_hit
-                still = {
-                    n for n in result.unschedulable
-                    if (q := self.cluster.pods.get(n)) is not None and q.is_pending()
-                }
-                if still:
-                    batch = [q for q in batch if q.name in still]
-                    result.unschedulable = [n for n in result.unschedulable if n not in still]
+                # EVERYTHING still pending gets another round against the
+                # remaining pools — both the limit-blocked specs' pods and the
+                # pods this solve called unschedulable (their infeasibility may
+                # have come from the weight gate pinning them to the exhausted
+                # pool)
+                pending_again = [
+                    q for q in batch
+                    if (qq := self.cluster.pods.get(q.name)) is not None
+                    and qq.is_pending()
+                ]
+                if pending_again:
+                    names = {q.name for q in pending_again}
+                    result.unschedulable = [
+                        n for n in result.unschedulable if n not in names
+                    ]
+                    batch = pending_again
                     continue
             result.unschedulable.extend(solve.unschedulable)
             for name in solve.unschedulable:

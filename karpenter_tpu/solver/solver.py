@@ -193,6 +193,25 @@ class Solver(abc.ABC):
                     problem = encode(work, provisioners, existing, daemonsets)
                     encode_s += time.perf_counter() - t_enc
                     result = self.solve(problem)
+            # Final fallback: the weight gate pins each group to its highest-
+            # weight compatible pool; a group can be per-pod compatible yet
+            # JOINTLY infeasible there (e.g. a zone spread needing zones the
+            # pool doesn't cover). Re-solve with the gate dropped for the
+            # still-failing pods — the weight preference yields before a pod
+            # strands (reference: next-pool fallback in the weight cascade).
+            if result.unschedulable and len({p.weight for p, _ in provisioners}) > 1:
+                degate = frozenset(result.unschedulable)
+                with span("solve.degate", pods=len(degate)):
+                    t_enc = time.perf_counter()
+                    problem2 = encode(
+                        work or pods, provisioners, existing, daemonsets,
+                        weight_degate=degate,
+                    )
+                    encode_s += time.perf_counter() - t_enc
+                    result2 = self.solve(problem2)
+                if len(result2.unschedulable) < len(result.unschedulable):
+                    result, problem = result2, problem2
+                    result.stats["weight_degated_pods"] = float(len(degate))
             if total_relaxed:
                 result.stats["relaxed_pods"] = float(total_relaxed)
         result.stats["encode_s"] = encode_s

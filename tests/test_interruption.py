@@ -112,7 +112,7 @@ class TestInterruption:
         from karpenter_tpu.controllers.interruption import QueueMessage
 
         queue.send({"version": "9", "source": "wat", "detail-type": "???"})
-        queue._messages.append(QueueMessage(id="bad", body="not json"))
+        queue._messages["bad"] = QueueMessage(id="bad", body="not json")
         intr.reconcile()
         assert len(cluster.nodes) == n_nodes
         assert len(queue) == 0  # both deleted

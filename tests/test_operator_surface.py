@@ -136,7 +136,7 @@ class TestParallelInterruption:
         queue.send({"version": "0", "source": "cloud.compute",
                     "detail-type": "Instance Rebalance Recommendation",
                     "detail": {"instance-id": nodes[0].provider_id.rsplit("/", 1)[-1]}})
-        queue._messages.append(QueueMessage(id="bad", body="{not json"))
+        queue._messages["bad"] = QueueMessage(id="bad", body="{not json")
         queue.send({"version": "9", "source": "unknown", "detail-type": "???"})
         while len(queue):
             ctl.reconcile(max_messages=10)

@@ -245,3 +245,39 @@ class TestProvisionerWeightPriority:
         assert not res.unschedulable
         node = cluster.nodes[cluster.pods["p"].node_name]
         assert node.provisioner_name() == "default"
+
+    def test_narrow_zone_high_weight_pool_degates_for_spread(self):
+        """A high-weight pool that is per-pod compatible but cannot satisfy a
+        hard zone spread (covers one zone) must yield to a wider pool instead
+        of stranding the pods."""
+        from karpenter_tpu.api import (
+            ObjectMeta, Pod, Provisioner, Requirement, Requirements, Resources,
+            TopologySpreadConstraint,
+        )
+        from karpenter_tpu.api import labels as wk
+        from karpenter_tpu.cloudprovider import FakeCloudProvider, generate_catalog
+        from karpenter_tpu.controllers.provisioning import ProvisioningController
+        from karpenter_tpu.state import Cluster
+
+        provider = FakeCloudProvider(catalog=generate_catalog(n_types=20))
+        cluster = Cluster()
+        cluster.add_provisioner(Provisioner(
+            meta=ObjectMeta(name="narrow"), weight=50,
+            requirements=Requirements(
+                [Requirement.in_values(wk.ZONE, ["zone-a"])]
+            ),
+        ))
+        cluster.add_provisioner(Provisioner(meta=ObjectMeta(name="default"), weight=0))
+        ctl = ProvisioningController(cluster, provider)
+        for i in range(3):
+            cluster.add_pod(Pod(
+                meta=ObjectMeta(name=f"sp-{i}", labels={"app": "wide"}),
+                requests=Resources(cpu="250m", memory="256Mi"),
+                topology_spread=[TopologySpreadConstraint(
+                    max_skew=1, topology_key=wk.ZONE, label_selector={"app": "wide"},
+                )],
+            ))
+        res = ctl.reconcile()
+        assert not res.unschedulable, res.unschedulable
+        zones = {cluster.nodes[p.node_name].zone() for p in cluster.pods.values()}
+        assert len(zones) == 3  # spread satisfied across the wide pool
