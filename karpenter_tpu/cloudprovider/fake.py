@@ -127,6 +127,35 @@ class FakeCloudProvider(CloudProvider):
         )
 
     # -- test injection ----------------------------------------------------
+    def set_catalog(self, catalog: List[InstanceType]) -> None:
+        """Replace the instance-type catalog, bumping catalog_version so every
+        downstream cache (instance-type lists, encoder option tables) sees the
+        change — direct mutation of ``self.catalog`` would be served stale for
+        up to the cache staleness bucket (advisor round-2 finding).
+
+        Already-launched instances keep their (now-retired) type definitions
+        so get/list/conversion still work until they terminate, and subnets
+        are created for any zone new to the catalog (existing subnets keep
+        their IP accounting)."""
+        old_by_name = self._by_name
+        self.catalog = catalog
+        self._by_name = {it.name: it for it in catalog}
+        for inst in self.instances.values():
+            if inst.instance_type not in self._by_name and inst.instance_type in old_by_name:
+                self._by_name[inst.instance_type] = old_by_name[inst.instance_type]
+        known_zones = {s.zone for s in self.subnets}
+        for z in sorted({o.zone for it in catalog for o in it.offerings} - known_zones):
+            subnet = Subnet(
+                id=f"subnet-{z}", zone=z,
+                tags={"karpenter.tpu/discovery": "cluster", "zone": z},
+            )
+            self.subnets.append(subnet)
+            self.subnet_provider._subnets[subnet.id] = subnet
+        self.catalog_version += 1
+        from .pricing import PricingProvider
+
+        self.pricing = PricingProvider(catalog)
+
     def set_insufficient_capacity(self, instance_type: str, zone: str, capacity_type: str) -> None:
         self.insufficient_capacity_pools.add((instance_type, zone, capacity_type))
 

@@ -85,7 +85,12 @@ def _signature(pod: Pod) -> tuple:
     that construction cost dominates 50k-pod encodes) and cached on the pod, so
     re-encoding the same pods across reconcile cycles is near-free. Every
     component short-circuits on the empty case: at 50k pods the difference
-    between ~13us and ~3us per signature is the whole cold-encode budget."""
+    between ~13us and ~3us per signature is the whole cold-encode budget.
+
+    CONTRACT: pods are treated as immutable in their scheduling-relevant
+    fields after first encode. Any code that mutates labels/requests/
+    constraints in place MUST pop ``pod.__dict__['_sched_sig']`` (the
+    relaxation machinery does; see Pod.relax_preferences)."""
     cached = pod.__dict__.get("_sched_sig")
     if cached is not None:
         return cached
@@ -524,6 +529,9 @@ class EncodedProblem:
     zone_seed: Optional[np.ndarray] = None  # [G, Z] int32 spread-selector matches
     zone_occupied: Optional[np.ndarray] = None  # [G, Z] int32 anti-selector matches
     seed_pods: List[tuple] = field(default_factory=list)  # (host, zone, Pod)
+    # group indices whose compat was actually NARROWED by the provisioner
+    # weight gate — the degate fallback only makes sense for these
+    weight_gated_groups: List[int] = field(default_factory=list)
 
     @property
     def G(self) -> int:
@@ -630,6 +638,7 @@ def encode(
     # the controller's next-pool pass when the preferred pool cannot host
     # them (limits exhausted, zone coverage too narrow for a spread).
     opt_weight = np.array([o.provisioner.weight for o in options], np.int64)
+    weight_gated_groups: List[int] = []
     if O and opt_weight.size and opt_weight.min() != opt_weight.max():
         for i, g in enumerate(groups):
             row = compat[i]
@@ -638,7 +647,10 @@ def encode(
             if weight_degate and any(p.name in weight_degate for p in g.pods):
                 continue
             best_w = opt_weight[row].max()
-            compat[i] = row & (opt_weight == best_w)
+            narrowed = row & (opt_weight == best_w)
+            if narrowed.sum() < row.sum():
+                weight_gated_groups.append(i)
+            compat[i] = narrowed
 
     ex_rem = np.zeros((E, R), dtype=np.float64)
     ex_zone = np.zeros((E,), dtype=np.int32)
@@ -709,6 +721,7 @@ def encode(
         zone_seed=zone_seed,
         zone_occupied=zone_occupied,
         seed_pods=seed_pods,
+        weight_gated_groups=weight_gated_groups,
     )
 
 

@@ -199,7 +199,14 @@ class Solver(abc.ABC):
             # pool doesn't cover). Re-solve with the gate dropped for the
             # still-failing pods — the weight preference yields before a pod
             # strands (reference: next-pool fallback in the weight cascade).
-            if result.unschedulable and len({p.weight for p, _ in provisioners}) > 1:
+            gated_names: set = set()
+            if result.unschedulable and problem.weight_gated_groups:
+                for gi in problem.weight_gated_groups:
+                    gated_names.update(p.name for p in problem.groups[gi].pods)
+            if result.unschedulable and gated_names.intersection(result.unschedulable):
+                # only retry when a FAILING pod's group was actually narrowed
+                # by the weight gate — otherwise the re-solve provably returns
+                # the same result at full cost
                 degate = frozenset(result.unschedulable)
                 with span("solve.degate", pods=len(degate)):
                     t_enc = time.perf_counter()

@@ -133,3 +133,21 @@ class TestLifecycle:
         types = provider.get_instance_types(p)
         assert types
         assert all(t.requirements.get(wk.INSTANCE_CATEGORY).single_value() == "c" for t in types)
+
+
+def test_set_catalog_invalidates_caches():
+    """Catalog replacement must bump catalog_version so instance-type lists
+    (and everything keyed on them) refresh immediately (advisor finding:
+    direct catalog mutation was served stale for the cache bucket)."""
+    from karpenter_tpu.api import ObjectMeta, Provisioner
+    from karpenter_tpu.cloudprovider import FakeCloudProvider, generate_catalog
+
+    provider = FakeCloudProvider(catalog=generate_catalog(n_types=10))
+    prov = Provisioner(meta=ObjectMeta(name="d"))
+    before = provider.get_instance_types(prov)
+    assert provider.get_instance_types(prov) is before  # cached
+    new_cat = generate_catalog(n_types=5)
+    provider.set_catalog(new_cat)
+    after = provider.get_instance_types(prov)
+    assert after is not before
+    assert len(after) == len(new_cat)
