@@ -137,24 +137,24 @@ class FakeCloudProvider(CloudProvider):
         so get/list/conversion still work until they terminate, and subnets
         are created for any zone new to the catalog (existing subnets keep
         their IP accounting)."""
-        old_by_name = self._by_name
-        self.catalog = catalog
-        self._by_name = {it.name: it for it in catalog}
-        for inst in self.instances.values():
-            if inst.instance_type not in self._by_name and inst.instance_type in old_by_name:
-                self._by_name[inst.instance_type] = old_by_name[inst.instance_type]
-        known_zones = {s.zone for s in self.subnets}
-        for z in sorted({o.zone for it in catalog for o in it.offerings} - known_zones):
-            subnet = Subnet(
-                id=f"subnet-{z}", zone=z,
-                tags={"karpenter.tpu/discovery": "cluster", "zone": z},
-            )
-            self.subnets.append(subnet)
-            self.subnet_provider._subnets[subnet.id] = subnet
-        self.catalog_version += 1
-        from .pricing import PricingProvider
-
-        self.pricing = PricingProvider(catalog)
+        with self._lock:
+            old_by_name = self._by_name
+            self.catalog = catalog
+            self._by_name = {it.name: it for it in catalog}
+            for inst in self.instances.values():
+                if inst.instance_type not in self._by_name and inst.instance_type in old_by_name:
+                    self._by_name[inst.instance_type] = old_by_name[inst.instance_type]
+            known_zones = {s.zone for s in self.subnets}
+            for z in sorted({o.zone for it in catalog for o in it.offerings} - known_zones):
+                subnet = Subnet(
+                    id=f"subnet-{z}", zone=z,
+                    tags={"karpenter.tpu/discovery": "cluster", "zone": z},
+                )
+                self.subnets.append(subnet)
+                self.subnet_provider._subnets[subnet.id] = subnet
+            self.catalog_version += 1
+            # in place: PricingController holds a reference to this object
+            self.pricing.reload(catalog)
 
     def set_insufficient_capacity(self, instance_type: str, zone: str, capacity_type: str) -> None:
         self.insufficient_capacity_pools.add((instance_type, zone, capacity_type))

@@ -124,6 +124,23 @@ class PricingProvider:
             self._spot = dict(self._fallback_spot)
             self.version += 1
 
+    def reload(self, catalog: Sequence[InstanceType]) -> None:
+        """Re-anchor on a new catalog IN PLACE — object identity is preserved
+        so controllers holding a reference (PricingController) keep driving
+        the live price book after a catalog swap."""
+        with self._lock:
+            self._fallback_od = {}
+            self._fallback_spot = {}
+            for it in catalog:
+                for o in it.offerings:
+                    if o.capacity_type == wk.CAPACITY_TYPE_ON_DEMAND:
+                        self._fallback_od[it.name] = o.price
+                    else:
+                        self._fallback_spot[(it.name, o.zone)] = o.price
+            self._od = dict(self._fallback_od)
+            self._spot = dict(self._fallback_spot)
+            self.version += 1
+
 
 class PricingController:
     """Refresh cadence driver (the reference runs pricing.Provider's

@@ -28,21 +28,27 @@ def make_mesh(n_devices: Optional[int] = None) -> Mesh:
 
 
 def shard_portfolio(
-    mesh: Mesh, inputs, orders: jax.Array, alphas: jax.Array, looks: jax.Array
+    mesh: Mesh,
+    inputs,
+    orders: jax.Array,
+    alphas: jax.Array,
+    looks: jax.Array,
+    swaps: jax.Array,
 ):
     """Place portfolio members across the mesh; problem tensors replicate.
 
-    orders/alphas/looks lead with the portfolio axis; K must divide evenly by
-    mesh size (make_orders rounds K up to a multiple of the device count when
-    sharding).
+    orders/alphas/looks/swaps lead with the portfolio axis; K must divide
+    evenly by mesh size (make_orders rounds K up to a multiple of the device
+    count when sharding).
     """
     member = NamedSharding(mesh, P(PORTFOLIO_AXIS))
     replicated = NamedSharding(mesh, P())
     orders = jax.device_put(orders, member)
     alphas = jax.device_put(alphas, member)
     looks = jax.device_put(looks, member)
+    swaps = jax.device_put(swaps, member)
     inputs = jax.tree.map(lambda x: jax.device_put(x, replicated), inputs)
-    return inputs, orders, alphas, looks
+    return inputs, orders, alphas, looks, swaps
 
 
 def round_up_portfolio(k: int, mesh: Optional[Mesh]) -> int:

@@ -352,33 +352,59 @@ def pack_solve_fused(
     orders: jax.Array,
     alphas: jax.Array,
     looks: jax.Array,
+    swaps: jax.Array,
     s_new: int,
     n_zones: int,
 ) -> jax.Array:
-    """Full solve in ONE device call: every member emits assignments, the winner
-    reduces with an on-device argmin, and everything the host needs lands in a
-    single int32 buffer so the host pays exactly one transfer round-trip.
+    """Full solve in ONE device call, TWO search phases:
 
-    Layout of the returned [2 + K + K + S + S + T*(E+S)] int32 vector:
-      [0] best member index        [1] unplaced count of the winner
-      [2:2+K] member costs (f32 bitcast)   [2+K:2+2K] member slot-exhaustion flags
+    1. the K-member portfolio over host-generated orderings (FFD anchors +
+       noisy variants), and
+    2. an iterated-search phase SEEDED BY THE PHASE-1 WINNER: ``swaps`` holds
+       K position-permutation patterns (identity + small transposition
+       neighborhoods — the annealing-style move set); phase 2 re-runs the
+       member vmap on ``winner_order[swaps[k]]``. The final argmin spans both
+       phases, so phase 2 can only improve the result — at ~zero wall cost,
+       since the whole program is still one device dispatch and the scan is
+       latency-, not FLOP-, bound.
+
+    Layout of the returned [4 + 2K + 2K + S + S + T*(E+S)] int32 vector:
+      [0] winning phase (0/1)   [1] phase-1 best index (phase-2 seed)
+      [2] winning member index within its phase   [3] winner unplaced count
+      [4:4+2K] member costs (f32 bitcast)  [..2K] slot-exhaustion flags
       [.. S] new_opt   [.. S] new_active
       [..] ys assignment counts, row-major [T, E+S] in the winner's scan order.
-    The host recovers group identity from its own copy of `orders`.
+    The host reconstructs the winning order from its copies of orders/swaps.
     """
     shared = _shared_precompute(inputs, s_new, n_zones)
-    costs, unplaced, exhausted, new_opt, new_active, ys = jax.vmap(
-        lambda o, a, l: _pack_member(inputs, shared, o, a, l, s_new, n_zones)
-    )(orders, alphas, looks)
+
+    def run(o, a, l):
+        return _pack_member(inputs, shared, o, a, l, s_new, n_zones)
+
+    c1, u1, ex1, no1, na1, ys1 = jax.vmap(run)(orders, alphas, looks)
+    b1 = jnp.argmin(c1).astype(jnp.int32)
+    seed = orders[b1]  # [T]
+    orders2 = seed[swaps]  # [K, T]
+    c2, u2, ex2, no2, na2, ys2 = jax.vmap(run)(orders2, alphas, looks)
+
+    costs = jnp.concatenate([c1, c2])
     best = jnp.argmin(costs).astype(jnp.int32)
+    k = orders.shape[0]
+    phase = (best >= k).astype(jnp.int32)
+    bk = jnp.where(best >= k, best - k, best)
+    unplaced = jnp.where(phase == 1, u2[bk], u1[bk])
+    new_opt = jnp.where(phase == 1, no2[bk], no1[bk])
+    new_active = jnp.where(phase == 1, na2[bk], na1[bk])
+    ys = jnp.where(phase == 1, ys2[bk], ys1[bk])
+    exhausted = jnp.concatenate([ex1, ex2])
     return jnp.concatenate(
         [
-            jnp.stack([best, unplaced[best]]),
+            jnp.stack([phase, b1, bk, unplaced]),
             _bitcast_f32_i32(costs),
             exhausted.astype(jnp.int32),
-            new_opt[best],
-            new_active[best].astype(jnp.int32),
-            ys[best].reshape(-1),
+            new_opt,
+            new_active.astype(jnp.int32),
+            ys.reshape(-1),
         ]
     )
 
@@ -387,32 +413,43 @@ def _bitcast_f32_i32(x: jax.Array) -> jax.Array:
     return lax.bitcast_convert_type(x.astype(jnp.float32), jnp.int32)
 
 
-def unpack_solve_fused(buf: np.ndarray, k: int, s_new: int, g: int, e_pad: int):
-    """Host-side unpacking of the pack_solve_fused buffer."""
-    best = int(buf[0])
-    unplaced = int(buf[1])
-    off = 2
-    costs = np.frombuffer(buf[off : off + k].tobytes(), dtype=np.float32)
-    off += k
-    exhausted = buf[off : off + k].astype(bool)
-    off += k
+def unpack_solve_fused(
+    buf: np.ndarray, k: int, s_new: int, g: int, e_pad: int,
+    orders: np.ndarray, swaps: np.ndarray,
+):
+    """Host-side unpacking of the pack_solve_fused buffer; reconstructs the
+    winning order (phase-1 member, or the phase-1 winner's order permuted by
+    the winning swap pattern)."""
+    phase, b1, bk, unplaced = int(buf[0]), int(buf[1]), int(buf[2]), int(buf[3])
+    off = 4
+    costs = np.frombuffer(buf[off : off + 2 * k].tobytes(), dtype=np.float32)
+    off += 2 * k
+    exhausted = buf[off : off + 2 * k].astype(bool)
+    off += 2 * k
     new_opt = buf[off : off + s_new]
     off += s_new
     new_active = buf[off : off + s_new].astype(bool)
     off += s_new
     ys = buf[off:].reshape(g, e_pad + s_new)
-    return best, unplaced, costs, exhausted, new_opt, new_active, ys
+    order = orders[bk] if phase == 0 else orders[b1][swaps[bk]]
+    return order, unplaced, costs, exhausted, new_opt, new_active, ys
 
 
 def make_orders(
     sizes: np.ndarray, count: np.ndarray, k: int, seed: int = 0
-) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
-    """Portfolio construction: K × (group ordering, tiebreak exponent, lookahead).
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """Portfolio construction: K × (group ordering, tiebreak exponent,
+    lookahead) plus K phase-2 swap patterns.
 
     Member 0 is plain FFD (size-descending), no lookahead — the
     reference-semantics anchor. Member 1 is FFD with lookahead. Other members
     perturb the ordering with multiplicative noise, sweep the tiebreak
     preference, and alternate lookahead scoring.
+
+    ``swaps[k]`` is a position permutation applied to the phase-1 winner's
+    order for the on-device iterated-search phase: pattern 0 is identity
+    (re-anchors the winner), the rest compose 1..4 random transpositions —
+    the annealing move set over orderings.
     """
     g = sizes.shape[0]
     rng = np.random.default_rng(seed)
@@ -430,4 +467,9 @@ def make_orders(
         orders[i] = np.argsort(key, kind="stable").astype(np.int32)
         alphas[i] = base_alphas[i % len(base_alphas)]
         looks[i] = i % 2 == 1
-    return orders, alphas, looks
+    swaps = np.tile(np.arange(g, dtype=np.int32), (k, 1))
+    for i in range(1, k):
+        for _ in range(1 + int(rng.integers(0, 4))):
+            a, b = rng.integers(0, g, size=2)
+            swaps[i, [a, b]] = swaps[i, [b, a]]
+    return orders, alphas, looks, swaps

@@ -446,9 +446,12 @@ class TPUSolver(Solver):
             # stop dispatching — the host path owns this link
             return None
         try:
-            inputs, orders, orders_d, alphas_d, looks_d, s_new, n_zones = self._device_inputs(problem)
-            buf = pack_solve_fused(inputs, orders_d, alphas_d, looks_d, s_new, n_zones)
-            return (buf, orders, s_new, n_zones, inputs)
+            (inputs, orders, swaps, orders_d, alphas_d, looks_d, swaps_d,
+             s_new, n_zones) = self._device_inputs(problem)
+            buf = pack_solve_fused(
+                inputs, orders_d, alphas_d, looks_d, swaps_d, s_new, n_zones
+            )
+            return (buf, orders, swaps, s_new, n_zones, inputs)
         except Exception:
             return None
 
@@ -463,7 +466,7 @@ class TPUSolver(Solver):
         when its on-device cost already beats the host result."""
         if dispatched is None:
             return None
-        buf, orders, s_new, n_zones, inputs = dispatched
+        buf, orders, swaps, s_new, n_zones, inputs = dispatched
         try:
             while time.perf_counter() < deadline:
                 if buf.is_ready():
@@ -476,16 +479,16 @@ class TPUSolver(Solver):
             k = orders.shape[0]
             Gp = inputs.count.shape[0]
             Ep = inputs.ex_valid.shape[0]
-            best, unplaced, costs, exhausted, new_opt, new_active, ys = unpack_solve_fused(
-                np.asarray(buf), k, s_new, Gp, Ep
+            order, unplaced, costs, exhausted, new_opt, new_active, ys = unpack_solve_fused(
+                np.asarray(buf), k, s_new, Gp, Ep, orders, swaps
             )
-            if unplaced > 0 or costs[best] >= host_cost:
+            if unplaced > 0 or costs.min() >= host_cost:
                 return None  # decode + validation would be wasted host time
-            if validate_counts(problem, orders[best], new_opt, new_active, ys):
+            if validate_counts(problem, order, new_opt, new_active, ys):
                 return None
-            result = self._decode(problem, orders[best], new_opt, new_active, ys)
+            result = self._decode(problem, order, new_opt, new_active, ys)
             result.stats["backend"] = 1.0
-            result.stats["portfolio_best"] = float(best)
+            result.stats["portfolio_best"] = float(int(np.argmin(costs)))
             result.stats["validated_counts"] = 1.0
             return result
         except Exception:
@@ -493,18 +496,22 @@ class TPUSolver(Solver):
 
     def _solve_kernel(self, problem: EncodedProblem) -> Optional[SolveResult]:
         t0 = time.perf_counter()
-        inputs, orders, orders_d, alphas_d, looks_d, s_new, n_zones = self._device_inputs(problem)
+        (inputs, orders, swaps, orders_d, alphas_d, looks_d, swaps_d,
+         s_new, n_zones) = self._device_inputs(problem)
         k = orders.shape[0]
         Gp = inputs.count.shape[0]
         Ep = inputs.ex_valid.shape[0]
         while True:
-            # ONE device call, ONE host fetch: portfolio eval + on-device argmin,
-            # every member emitting assignments, packed into one int32 buffer.
+            # ONE device call, ONE host fetch: two-phase portfolio eval (K
+            # members + K winner-seeded perturbations) with on-device argmin,
+            # the winner's assignments packed into one int32 buffer.
             buf = np.asarray(
-                pack_solve_fused(inputs, orders_d, alphas_d, looks_d, s_new, n_zones)
+                pack_solve_fused(
+                    inputs, orders_d, alphas_d, looks_d, swaps_d, s_new, n_zones
+                )
             )
-            best, unplaced, costs, exhausted, new_opt, new_active, ys = unpack_solve_fused(
-                buf, k, s_new, Gp, Ep
+            order, unplaced, costs, exhausted, new_opt, new_active, ys = unpack_solve_fused(
+                buf, k, s_new, Gp, Ep, orders, swaps
             )
             # Grow S only when members actually ran out of slots; leftover pods
             # with free slots are genuinely unschedulable and re-running can't help.
@@ -512,23 +519,24 @@ class TPUSolver(Solver):
                 s_new *= 2
                 with self._cache_lock:
                     self._device_cache[id(problem)] = (
-                        problem, inputs, orders, orders_d, alphas_d, looks_d, s_new, n_zones
+                        problem, inputs, orders, swaps, orders_d, alphas_d,
+                        looks_d, swaps_d, s_new, n_zones,
                     )
                 continue
             break
         t_solve = time.perf_counter() - t0
         # Count-level validation on the raw kernel output: same invariants as
         # the name-level validator, no 10k-pod name expansion on the hot path.
-        violations = validate_counts(problem, orders[best], new_opt, new_active, ys)
+        violations = validate_counts(problem, order, new_opt, new_active, ys)
         if violations:
             result = self._fallback.solve(problem)
             result.stats["fallback"] = 1.0
             result.stats["tpu_violations"] = float(len(violations))
             return result
-        result = self._decode(problem, orders[best], new_opt, new_active, ys)
+        result = self._decode(problem, order, new_opt, new_active, ys)
         result.stats["solve_s"] = t_solve
         result.stats["backend"] = 1.0
-        result.stats["portfolio_best"] = float(best)
+        result.stats["portfolio_best"] = float(int(np.argmin(costs)))
         result.stats["validated_counts"] = 1.0
         return result
 
@@ -545,24 +553,29 @@ class TPUSolver(Solver):
             cached = self._device_cache.get(key)
             if cached is not None and cached[0] is problem:
                 return cached[1:]
-        inputs, orders, alphas, looks, s_new, n_zones = self._prepare(problem)
+        inputs, orders, alphas, looks, swaps, s_new, n_zones = self._prepare(problem)
         mesh = self._ensure_mesh()
         if mesh is not None:
             from ..parallel import shard_portfolio
 
-            inputs_d, orders_d, alphas_d, looks_d = shard_portfolio(
+            inputs_d, orders_d, alphas_d, looks_d, swaps_d = shard_portfolio(
                 mesh,
                 jax.tree.map(jnp.asarray, inputs),
                 jnp.asarray(orders),
                 jnp.asarray(alphas),
                 jnp.asarray(looks),
+                jnp.asarray(swaps),
             )
         else:
             inputs_d = jax.tree.map(jnp.asarray, inputs)
-            orders_d, alphas_d, looks_d = (
-                jnp.asarray(orders), jnp.asarray(alphas), jnp.asarray(looks)
+            orders_d, alphas_d, looks_d, swaps_d = (
+                jnp.asarray(orders), jnp.asarray(alphas),
+                jnp.asarray(looks), jnp.asarray(swaps),
             )
-        entry = (problem, inputs_d, orders, orders_d, alphas_d, looks_d, s_new, n_zones)
+        entry = (
+            problem, inputs_d, orders, swaps, orders_d, alphas_d, looks_d,
+            swaps_d, s_new, n_zones,
+        )
         with self._cache_lock:
             self._device_cache.clear()  # hold at most one problem resident
             self._device_cache[key] = entry
@@ -635,10 +648,12 @@ class TPUSolver(Solver):
         from ..parallel import round_up_portfolio
 
         k = round_up_portfolio(self.portfolio, self._ensure_mesh())
-        orders, alphas, looks = make_orders(sizes, count.astype(np.float64), k, self.seed)
+        orders, alphas, looks, swaps = make_orders(
+            sizes, count.astype(np.float64), k, self.seed
+        )
 
         s_new = self._estimate_slots(problem)
-        return inputs, orders, alphas, looks, s_new, n_zones
+        return inputs, orders, alphas, looks, swaps, s_new, n_zones
 
     def _estimate_slots(self, problem: EncodedProblem) -> int:
         if problem.O == 0:

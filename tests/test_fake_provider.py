@@ -151,3 +151,8 @@ def test_set_catalog_invalidates_caches():
     after = provider.get_instance_types(prov)
     assert after is not before
     assert len(after) == len(new_cat)
+    # pricing object identity survives (PricingController holds a reference)
+    pricing_before = provider.pricing
+    provider.set_catalog(generate_catalog(n_types=8))
+    assert provider.pricing is pricing_before
+    assert provider.pricing.update_spot_prices()  # refreshes still drive it
