@@ -41,8 +41,18 @@ class PricingProvider:
 
     def __init__(self, catalog: Sequence[InstanceType]):
         self._lock = threading.Lock()
-        # static fallback tables, captured from the catalog the way the
-        # reference bakes zz_generated.pricing.go at codegen time
+        self._tick = 0
+        self._od_tick = 0
+        self.version = 0  # seqnum: bumps on every successful refresh
+        self.api_available = True  # fake outage switch
+        self.last_spot_update: float = 0.0
+        self.last_od_update: float = 0.0
+        self._set_fallback(catalog)
+
+    def _set_fallback(self, catalog: Sequence[InstanceType]) -> None:
+        """(Re)build the static fallback tables from a catalog — captured the
+        way the reference bakes zz_generated.pricing.go at codegen time —
+        and reset live prices onto them. Callers hold the lock or own init."""
         self._fallback_od: Dict[str, float] = {}
         self._fallback_spot: Dict[Tuple[str, str], float] = {}
         for it in catalog:
@@ -53,12 +63,6 @@ class PricingProvider:
                     self._fallback_spot[(it.name, o.zone)] = o.price
         self._od: Dict[str, float] = dict(self._fallback_od)
         self._spot: Dict[Tuple[str, str], float] = dict(self._fallback_spot)
-        self._tick = 0
-        self._od_tick = 0
-        self.version = 0  # seqnum: bumps on every successful refresh
-        self.api_available = True  # fake outage switch
-        self.last_spot_update: float = 0.0
-        self.last_od_update: float = 0.0
 
     # -- lookups (pricing.go OnDemandPrice/SpotPrice) -----------------------
     def on_demand_price(self, instance_type: str) -> Optional[float]:
@@ -129,16 +133,7 @@ class PricingProvider:
         so controllers holding a reference (PricingController) keep driving
         the live price book after a catalog swap."""
         with self._lock:
-            self._fallback_od = {}
-            self._fallback_spot = {}
-            for it in catalog:
-                for o in it.offerings:
-                    if o.capacity_type == wk.CAPACITY_TYPE_ON_DEMAND:
-                        self._fallback_od[it.name] = o.price
-                    else:
-                        self._fallback_spot[(it.name, o.zone)] = o.price
-            self._od = dict(self._fallback_od)
-            self._spot = dict(self._fallback_spot)
+            self._set_fallback(catalog)
             self.version += 1
 
 

@@ -385,7 +385,12 @@ def pack_solve_fused(
     b1 = jnp.argmin(c1).astype(jnp.int32)
     seed = orders[b1]  # [T]
     orders2 = seed[swaps]  # [K, T]
-    c2, u2, ex2, no2, na2, ys2 = jax.vmap(run)(orders2, alphas, looks)
+    # phase 2 is a neighborhood search AROUND the winner: every perturbation
+    # runs under the winner's scoring config, so pattern 0 (identity) exactly
+    # re-anchors the phase-1 winner
+    alphas2 = jnp.full_like(alphas, alphas[b1])
+    looks2 = jnp.full_like(looks, looks[b1])
+    c2, u2, ex2, no2, na2, ys2 = jax.vmap(run)(orders2, alphas2, looks2)
 
     costs = jnp.concatenate([c1, c2])
     best = jnp.argmin(costs).astype(jnp.int32)
@@ -467,9 +472,13 @@ def make_orders(
         orders[i] = np.argsort(key, kind="stable").astype(np.int32)
         alphas[i] = base_alphas[i % len(base_alphas)]
         looks[i] = i % 2 == 1
+    # Padding groups (count 0) sort to the trailing positions of every order,
+    # so transpositions only draw from the REAL-group prefix — a swap among
+    # padding positions would be a no-op member.
+    n_real = max(int(np.count_nonzero(count)), 1)
     swaps = np.tile(np.arange(g, dtype=np.int32), (k, 1))
     for i in range(1, k):
         for _ in range(1 + int(rng.integers(0, 4))):
-            a, b = rng.integers(0, g, size=2)
+            a, b = rng.integers(0, n_real, size=2)
             swaps[i, [a, b]] = swaps[i, [b, a]]
     return orders, alphas, looks, swaps

@@ -488,7 +488,9 @@ class TPUSolver(Solver):
                 return None
             result = self._decode(problem, order, new_opt, new_active, ys)
             result.stats["backend"] = 1.0
-            result.stats["portfolio_best"] = float(int(np.argmin(costs)))
+            idx = int(np.argmin(costs))
+            result.stats["portfolio_phase"] = float(idx >= k)
+            result.stats["portfolio_best"] = float(idx % k)
             result.stats["validated_counts"] = 1.0
             return result
         except Exception:
@@ -536,7 +538,11 @@ class TPUSolver(Solver):
         result = self._decode(problem, order, new_opt, new_active, ys)
         result.stats["solve_s"] = t_solve
         result.stats["backend"] = 1.0
-        result.stats["portfolio_best"] = float(int(np.argmin(costs)))
+        # winner identity in (phase, member) space: phase 1 = the K host
+        # orderings, phase 2 = winner-seeded perturbations
+        idx = int(np.argmin(costs))
+        result.stats["portfolio_phase"] = float(idx >= k)
+        result.stats["portfolio_best"] = float(idx % k)
         result.stats["validated_counts"] = 1.0
         return result
 
