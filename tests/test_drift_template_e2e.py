@@ -5,7 +5,6 @@ Closes the loop on launchtemplate.go:89-135 + isAMIDrifted + the
 deprovisioning drift flow."""
 
 from karpenter_tpu.api import ObjectMeta, Pod, Provisioner, Resources
-from karpenter_tpu.api import labels as wk
 from karpenter_tpu.api.objects import NodeTemplate
 from karpenter_tpu.api.settings import Settings
 from karpenter_tpu.cloudprovider import FakeCloudProvider, generate_catalog
@@ -13,9 +12,12 @@ from karpenter_tpu.operator import Operator
 
 
 def test_template_drift_replacement_end_to_end():
+    from karpenter_tpu.utils.cache import FakeClock
+
     provider = FakeCloudProvider(catalog=generate_catalog(n_types=20))
     op = Operator.new(
         provider=provider,
+        clock=FakeClock(start=100_000.0),
         settings=Settings(
             batch_idle_duration=0, batch_max_duration=0,
             consolidation_validation_ttl=0, stabilization_window=0,
@@ -50,7 +52,7 @@ def test_template_drift_replacement_end_to_end():
     # deprovisioning replaces drifted capacity without stranding pods
     for _ in range(20):
         op.step()
-        op.clock.step(30) if hasattr(op.clock, "step") else None
+        op.clock.step(30)
         live = set(op.cluster.nodes)
         if live and not (live & old_nodes):
             break
