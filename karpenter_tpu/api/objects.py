@@ -158,6 +158,11 @@ class Pod:
             return True
         return False
 
+    def invalidate_scheduling_cache(self) -> None:
+        """Drop the cached scheduling signature; call after mutating any
+        scheduling-relevant field in place (cluster.update does)."""
+        self.__dict__.pop("_sched_sig", None)
+
     def relaxed_clone(self) -> "Pod":
         """A copy of this pod with one more preference relaxed — solvers use
         clones so a what-if simulation (consolidation) or a transient
@@ -199,6 +204,11 @@ class Node:
     @property
     def labels(self) -> Dict[str, str]:
         return self.meta.labels
+
+    def invalidate_scheduling_cache(self) -> None:
+        """Drop the cached requirement surface; call after mutating the
+        node's labels in place (cluster.update does)."""
+        self.__dict__.pop("_req_surface", None)
 
     def zone(self) -> str:
         return self.meta.labels.get(wk.ZONE, "")

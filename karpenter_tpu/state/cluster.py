@@ -117,8 +117,10 @@ class Cluster:
     def update(self, obj) -> None:
         """Re-announce a mutated object (bump version, fire watches)."""
         if isinstance(obj, Pod):
+            obj.invalidate_scheduling_cache()  # scheduling identity may have changed
             self._put(self.pods, obj, obj.name)
         elif isinstance(obj, Node):
+            obj.invalidate_scheduling_cache()  # label surface may have changed
             self._put(self.nodes, obj, obj.name)
         elif isinstance(obj, Machine):
             self._put(self.machines, obj, obj.name)
