@@ -22,7 +22,7 @@ scalar caps interpreted inside the packing scan (see ``jax_solver.py``).
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass, field, fields as dataclass_fields
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -275,6 +275,23 @@ def build_options(
         and all(cd is d for cd, d in zip(cached[1], daemonsets))
     ):
         return cached[2]
+    # Identity miss (fresh objects): fall back to CONTENT equality — a
+    # provider may rebuild its instance-type lists with identical data (cache
+    # invalidation, process restart), and re-flattening 2310 offerings plus
+    # rebuilding the requirement table costs ~50ms the launch options don't
+    # actually depend on. The content key covers everything the options are
+    # built from: type spec surface + offerings + provisioner generation.
+    ckey = _options_content_key(provisioners, daemonsets)
+    ccached = _options_content_cache.get(ckey)
+    if ccached is not None:
+        # refresh the identity cache so the NEXT call hits the cheap path
+        _options_cache.clear()
+        _options_cache[key] = (
+            [(p, t) for p, t in provisioners],
+            list(daemonsets),
+            ccached,
+        )
+        return ccached
 
     options: List[LaunchOption] = []
     offering_reqs: Dict[tuple, Requirements] = {}  # (zone, ct, prov) interning
@@ -332,7 +349,93 @@ def build_options(
         list(daemonsets),
         options,
     )
+    _options_content_cache.clear()
+    _options_content_cache[ckey] = options
     return options
+
+
+_options_content_cache: Dict[tuple, list] = {}
+
+
+def _options_content_key(
+    provisioners: Sequence[Tuple[Provisioner, Sequence[InstanceType]]],
+    daemonsets: Sequence[Pod],
+) -> tuple:
+    """Value-equality key over everything build_options reads: per type the
+    name + capacity + offering tuples, per provisioner its generation, and
+    the daemonsets' scheduling signatures (their overhead feeds allocatable).
+    ~3ms at 400 types — vs ~50ms of re-flattening it guards."""
+    prov_part = []
+    for p, types in provisioners:
+        type_part = tuple(
+            (
+                it.name,
+                tuple(sorted(it.capacity.items())),
+                # allocatable folds in the overhead math — a changed
+                # kube-reserved/eviction threshold MUST miss the cache
+                tuple(sorted(it.allocatable().items())),
+                tuple(
+                    sorted(
+                        (r.key, r.complement, tuple(sorted(r.values)),
+                         r.greater_than, r.less_than)
+                        for r in it.requirements
+                    )
+                ),
+                tuple(
+                    (o.zone, o.capacity_type, o.price, o.available)
+                    for o in it.offerings
+                ),
+            )
+            for it in types
+        )
+        prov_part.append((_provisioner_sig(p), type_part))
+    ds_part = tuple(_signature(d) for d in daemonsets)
+    return (tuple(prov_part), ds_part)
+
+
+def _provisioner_sig(p: Provisioner) -> tuple:
+    """Value signature over EVERY Provisioner field a cached LaunchOption's
+    embedded provisioner object is later read for (requirements/labels/taints
+    at option build; weight at the gate; kubelet/startupTaints/limits/
+    node_template_ref at launch) — a content hit must be safe to serve to all
+    of them."""
+    req_sig = tuple(
+        sorted(
+            (r.key, r.complement, tuple(sorted(r.values)), r.greater_than, r.less_than)
+            for r in p.requirements
+        )
+    )
+    return (
+        p.name,
+        p.weight,
+        req_sig,
+        tuple(sorted(p.labels.items())),
+        tuple(t.as_tuple() for t in p.taints),
+        tuple(t.as_tuple() for t in p.startup_taints),
+        _kubelet_sig(p.kubelet),
+        tuple(sorted(p.limits.items())) if p.limits is not None else None,
+        p.consolidation_enabled,
+        p.ttl_seconds_after_empty,
+        p.ttl_seconds_until_expired,
+        p.node_template_ref,
+    )
+
+
+def _kubelet_sig(kc) -> tuple:
+    """Every KubeletConfiguration field, rendered hashable generically so a
+    future field addition is covered automatically (the cached provisioner's
+    whole kubelet object rides onto launched Machines)."""
+    out = []
+    for f in dataclass_fields(kc):
+        v = getattr(kc, f.name)
+        if isinstance(v, dict):
+            v = tuple(sorted(v.items()))
+        elif isinstance(v, list):
+            v = tuple(v)
+        elif isinstance(v, Resources):
+            v = tuple(sorted(v.items()))
+        out.append((f.name, v))
+    return tuple(out)
 
 
 def _daemonset_overhead(

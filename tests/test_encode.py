@@ -122,3 +122,43 @@ class TestEncode:
         pods = make_pods(1, cpu="10000")
         prob = encode(pods, setup(20))
         assert not prob.compat.any()
+
+
+class TestOptionsContentCache:
+    def test_content_equal_catalogs_hit(self):
+        from karpenter_tpu.api import ObjectMeta, Provisioner
+        from karpenter_tpu.cloudprovider import generate_catalog
+        from karpenter_tpu.solver.encode import build_options
+
+        p = Provisioner(meta=ObjectMeta(name="d"))
+        o1 = build_options([(p, generate_catalog(n_types=10))], ())
+        o2 = build_options([(p, generate_catalog(n_types=10))], ())
+        assert o2 is o1  # byte-identical content, fresh objects
+
+    def test_kubelet_or_overhead_change_misses(self):
+        """A changed kubelet config or instance-type overhead MUST miss —
+        cached options embed provisioner/allocatable data both feed."""
+        import dataclasses
+
+        from karpenter_tpu.api import ObjectMeta, Provisioner
+        from karpenter_tpu.api.objects import KubeletConfiguration
+        from karpenter_tpu.api.resources import Resources
+        from karpenter_tpu.cloudprovider import generate_catalog
+        from karpenter_tpu.solver.encode import build_options
+
+        p = Provisioner(meta=ObjectMeta(name="d"))
+        o1 = build_options([(p, generate_catalog(n_types=10))], ())
+        p2 = Provisioner(
+            meta=ObjectMeta(name="d"),
+            kubelet=KubeletConfiguration(eviction_hard={"memory.available": "200Mi"}),
+        )
+        o2 = build_options([(p2, generate_catalog(n_types=10))], ())
+        assert o2 is not o1
+        cat = generate_catalog(n_types=10)
+        new_oh = dataclasses.replace(
+            cat[0].overhead,
+            kube_reserved=cat[0].overhead.kube_reserved + Resources(cpu="1"),
+        )
+        cat[0] = dataclasses.replace(cat[0], overhead=new_oh)
+        o3 = build_options([(p, cat)], ())
+        assert o3 is not o1
