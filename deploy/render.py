@@ -6,8 +6,13 @@ global-settings ConfigMap — ``deployment.yaml:96-104``) and
 ``charts/karpenter-crd``. This renderer produces the equivalent manifests for
 the TPU operator, parameterized like chart values:
 
-    python deploy/render.py --cluster-name prod --replicas 2 > manifests.yaml
+    python deploy/render.py --cluster-name prod > manifests.yaml
     python deploy/render.py --out-dir deploy/manifests   # one file per object
+
+Replicas default to 1: the file-lease leader election only provides mutual
+exclusion across pods when ``--leader-elect-lease`` points at a shared
+(ReadWriteMany) volume, which the default pod-local path is not. Pass
+``--replicas 2`` only with such a volume mounted (utils/leaderelection.py).
 """
 
 from __future__ import annotations
@@ -171,7 +176,8 @@ def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--cluster-name", default="karpenter-tpu")
     ap.add_argument("--namespace", default="karpenter-tpu")
-    ap.add_argument("--replicas", type=int, default=2)
+    # 1 until the lease lives on a shared volume (see module docstring)
+    ap.add_argument("--replicas", type=int, default=1)
     ap.add_argument("--image", default="karpenter-tpu:latest")
     ap.add_argument("--out-dir", default=None)
     args = ap.parse_args()

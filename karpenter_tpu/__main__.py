@@ -21,6 +21,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--cluster-name", default=None, help="cluster identity")
     p.add_argument("--metrics-port", type=int, default=8080,
                    help="serve /metrics,/healthz,/readyz on this port (0=ephemeral, -1=off)")
+    p.add_argument("--metrics-bind", default="0.0.0.0",
+                   help="bind address for the metrics/health server (pod probes "
+                        "and Prometheus connect to the pod IP, not loopback)")
     p.add_argument("--leader-elect", action="store_true",
                    help="enable leader election before running loops")
     p.add_argument("--leader-elect-lease", default="/tmp/karpenter-tpu-leader",
@@ -71,21 +74,36 @@ def main(argv=None) -> int:
     for sig in (signal.SIGINT, signal.SIGTERM):
         signal.signal(sig, lambda *_: stop.set())
 
+    # The HTTP surface comes up BEFORE leader election: a standby replica must
+    # answer /healthz (alive) and /readyz (not ready — not leader) or the
+    # kubelet liveness probe restart-loops it. The reference likewise serves
+    # manager endpoints regardless of leadership (cmd/controller/main.go:33-71).
+    http_server = None
+    if args.metrics_port >= 0:
+        from .utils.httpserver import OperatorHTTPServer
+
+        http_server = OperatorHTTPServer(
+            port=args.metrics_port,
+            host=args.metrics_bind,
+            ready_check=lambda: elector is None or elector.is_leader,
+        ).start()
+
     if args.leader_elect:
         from .utils.leaderelection import LeaderElector
 
-        elector = LeaderElector(args.leader_elect_lease)
+        # on_lost=stop.set: a deposed leader must stop reconciling, not just
+        # flip /readyz — two live reconcilers is split-brain (the reference's
+        # controller-runtime exits the process on lost leadership)
+        elector = LeaderElector(args.leader_elect_lease, on_lost=stop.set)
         kv(log, logging.INFO, "waiting for leadership", lease=args.leader_elect_lease)
         if not elector.acquire(stop=stop):
+            if http_server is not None:
+                http_server.stop()
             return 0  # stopped before becoming leader
         kv(log, logging.INFO, "became leader", identity=elector.identity)
 
     try:
-        op.run(
-            stop,
-            tick=args.tick,
-            http_port=args.metrics_port if args.metrics_port >= 0 else None,
-        )
+        op.run(stop, tick=args.tick, http_server=http_server)
     finally:
         if elector is not None:
             elector.release()

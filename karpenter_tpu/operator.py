@@ -132,16 +132,22 @@ class Operator:
 
     # -- continuous run -----------------------------------------------------
     def run(
-        self, stop: threading.Event, tick: float = 0.25, http_port: Optional[int] = None
+        self,
+        stop: threading.Event,
+        tick: float = 0.25,
+        http_port: Optional[int] = None,
+        http_server: Optional[object] = None,
     ) -> None:
         """Drive the loops until `stop` is set. Cadences follow the reference:
         provisioning honors its batch window; slow loops (nodetemplate 5m, GC 5m,
         drift 5m) tick on their own schedule. ``http_port`` serves /metrics,
         /healthz and /readyz for the lifetime of the loop (the reference's
         manager endpoints, cmd/controller/main.go:33-71); 0 picks a free port,
-        exposed as ``self.http_server.port``."""
-        self.http_server = None
-        if http_port is not None:
+        exposed as ``self.http_server.port``. Alternatively pass an already
+        started ``http_server`` (the entrypoint starts one before leader
+        election so standbys answer probes); it is adopted and stopped here."""
+        self.http_server = http_server
+        if self.http_server is None and http_port is not None:
             from .utils.httpserver import OperatorHTTPServer
 
             self.http_server = OperatorHTTPServer(port=http_port).start()

@@ -23,6 +23,7 @@ class OperatorHTTPServer:
         registry: Optional[Registry] = None,
         ready_check: Optional[Callable[[], bool]] = None,
         healthy_check: Optional[Callable[[], bool]] = None,
+        host: str = "127.0.0.1",
     ):
         self.registry = registry or REGISTRY
         self.ready_check = ready_check or (lambda: True)
@@ -57,7 +58,9 @@ class OperatorHTTPServer:
             def log_message(self, fmt, *args) -> None:  # quiet by default
                 pass
 
-        self._server = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        # Default loopback for tests; the operator entrypoint passes 0.0.0.0 so
+        # kubelet probes (pod IP) and Prometheus scrapes reach the pod.
+        self._server = ThreadingHTTPServer((host, port), Handler)
         self._thread: Optional[threading.Thread] = None
 
     @property

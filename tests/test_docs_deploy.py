@@ -48,7 +48,7 @@ def test_checked_in_manifests_current():
 
     objs = render.render_all(
         {"cluster_name": "karpenter-tpu", "namespace": "karpenter-tpu",
-         "replicas": 2, "image": "karpenter-tpu:latest"}
+         "replicas": 1, "image": "karpenter-tpu:latest"}
     )
     mdir = os.path.join(ROOT, "deploy", "manifests")
     for obj in objs:

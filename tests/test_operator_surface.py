@@ -79,6 +79,71 @@ class TestHTTPServer:
             stop.set()
             t.join(timeout=10)
 
+    def test_standby_serves_probes_before_leadership(self, tmp_path):
+        """ADVICE r3: a replica waiting for leadership must answer /healthz 200
+        and /readyz 503, then flip ready once it becomes leader. Runs the real
+        entrypoint in a subprocess (main() installs signal handlers)."""
+        import os
+        import signal
+        import socket
+        import subprocess
+        import sys
+        import time
+
+        from karpenter_tpu.utils.leaderelection import LeaderElector
+
+        lease = str(tmp_path / "lease")
+        holder = LeaderElector(lease, identity="holder", lease_duration=60.0)
+        assert holder.try_acquire()
+
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "karpenter_tpu",
+             "--leader-elect", "--leader-elect-lease", lease,
+             "--metrics-port", str(port), "--metrics-bind", "127.0.0.1",
+             "--cluster-name", "standby-test"],
+            env=os.environ.copy(),
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        )
+        try:
+            deadline = time.time() + 60
+            while time.time() < deadline:
+                try:
+                    assert _get(port, "/healthz")[0] == 200
+                    break
+                except (urllib.error.URLError, ConnectionError, OSError):
+                    assert proc.poll() is None, "entrypoint exited early"
+                    time.sleep(0.2)
+            else:
+                raise AssertionError("standby never served /healthz")
+            try:
+                _get(port, "/readyz")
+                raise AssertionError("standby reported ready while not leader")
+            except urllib.error.HTTPError as e:
+                assert e.code == 503
+            holder.release()  # hand over leadership
+            deadline = time.time() + 30
+            while time.time() < deadline:
+                try:
+                    if _get(port, "/readyz")[0] == 200:
+                        break
+                except urllib.error.HTTPError:
+                    time.sleep(0.2)
+            else:
+                raise AssertionError("replica never became ready after takeover")
+        finally:
+            if proc.poll() is None:
+                proc.send_signal(signal.SIGTERM)
+                try:
+                    proc.wait(timeout=15)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+            if holder.is_leader:
+                holder.release()
+
 
 import urllib.error  # noqa: E402
 

@@ -122,6 +122,53 @@ class TestLeaderElection:
         assert not a.try_acquire()  # a lost it
         b.release()
 
+    def test_racing_contenders_yield_one_leader(self, tmp_path):
+        """ADVICE r3: the read-check-write must be atomic — under the flock,
+        N contenders racing for a free lease produce exactly one holder."""
+        import threading as th
+
+        lease = str(tmp_path / "lease")
+        electors = [
+            LeaderElector(lease, identity=f"c{i}", lease_duration=5.0)
+            for i in range(8)
+        ]
+        barrier = th.Barrier(len(electors))
+        results = [False] * len(electors)
+
+        def contend(i):
+            barrier.wait()
+            results[i] = electors[i].try_acquire()
+
+        threads = [th.Thread(target=contend, args=(i,)) for i in range(len(electors))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert sum(results) == 1
+        winner = results.index(True)
+        electors[winner].release()
+
+    def test_lost_leadership_fires_on_lost(self, tmp_path):
+        """A deposed leader must signal its run loop to stop (split-brain
+        guard): the renewal heartbeat invokes on_lost when the lease shows a
+        different live holder."""
+        import json
+        import threading as th
+
+        lease = str(tmp_path / "lease")
+        lost = th.Event()
+        a = LeaderElector(
+            lease, identity="a", lease_duration=5.0, renew_interval=0.05,
+            on_lost=lost.set,
+        )
+        assert a.acquire()
+        # usurp the lease out from under a (as a post-expiry steal would)
+        with open(lease, "w") as f:
+            json.dump({"holder": "b", "renewed": time.time(), "duration": 5.0}, f)
+        assert lost.wait(timeout=5.0)
+        assert not a.is_leader
+        a._stop.set()
+
 
 class TestContextDiscovery:
     def test_discover_wires_cluster_identity(self):
