@@ -311,6 +311,12 @@ class TPUSolver(Solver):
         self._cache_lock = threading.Lock()
         self._warmed_problems: dict = {}
         self._race_fails = 0
+        # breaker half-open probe: when the race breaker is open (>=3 missed
+        # deadlines) we still re-probe the device once per interval — a
+        # transient stall (GC pause, compile storm) must not disable racing
+        # for the process lifetime (round-3 verdict item 8)
+        self._race_retry_interval_s = 5.0
+        self._race_retry_at = 0.0
 
     def _ensure_mesh(self):
         if self.mesh is None and self.auto_mesh:
@@ -442,9 +448,13 @@ class TPUSolver(Solver):
         if warmed[1].is_alive():
             return None  # still compiling
         if self._race_fails >= 3:
-            # the device never answers inside the budget (tunneled, overloaded):
-            # stop dispatching — the host path owns this link
-            return None
+            # the device hasn't answered inside the budget (tunneled,
+            # overloaded): the host path owns this link, but re-probe once per
+            # interval so a recovered device resumes racing
+            now = time.monotonic()
+            if now < self._race_retry_at:
+                return None
+            self._race_retry_at = now + self._race_retry_interval_s
         try:
             (inputs, orders, swaps, orders_d, alphas_d, looks_d, swaps_d,
              s_new, n_zones) = self._device_inputs(problem)

@@ -259,6 +259,82 @@ class TestTPUSolver:
         assert result.cost <= lb * 1.3
 
 
+class TestRaceBreaker:
+    """Round-3 verdict item 8: 3 missed race deadlines must not disable the
+    kernel race forever — the breaker goes half-open and re-probes on a clock."""
+
+    def _solver_with_warm_done(self, problem):
+        import threading
+
+        s = TPUSolver()
+        done = threading.Thread(target=lambda: None)
+        done.start()
+        done.join()
+        s._warmed_problems[id(problem)] = (problem, done)  # warm phase complete
+        return s
+
+    def test_open_breaker_reprobes_after_interval(self, provs, monkeypatch):
+        pods = make_pods(4, cpu="250m")
+        problem = encode(pods, provs)
+        s = self._solver_with_warm_done(problem)
+        attempts = []
+
+        def fake_inputs(p):
+            attempts.append(p)
+            raise RuntimeError("stop before real dispatch")
+
+        monkeypatch.setattr(s, "_device_inputs", fake_inputs)
+        s._race_fails = 3
+        import time as _t
+
+        s._race_retry_at = _t.monotonic() + 60  # interval not yet elapsed
+        assert s._dispatch_async(problem) is None
+        assert attempts == []  # breaker open: no device touch
+        s._race_retry_at = 0.0  # interval elapsed
+        assert s._dispatch_async(problem) is None  # fake raises, but...
+        assert len(attempts) == 1  # ...the half-open probe DID dispatch
+        assert s._race_retry_at > 0  # and re-armed the interval
+
+    def test_successful_poll_closes_breaker(self, provs):
+        pods = make_pods(4, cpu="250m")
+        problem = encode(pods, provs)
+        s = TPUSolver()
+        s._race_fails = 3
+
+        class ReadyBuf:
+            def is_ready(self):
+                return True
+
+            def __array__(self, *a, **k):
+                raise RuntimeError("decode aborted by test")
+
+        dispatched = (ReadyBuf(), np.zeros((2, 3), np.int32), np.zeros((2, 3), np.int32),
+                      4, 3, None)
+        import time as _t
+
+        s._poll_dispatch(problem, dispatched, deadline=_t.perf_counter() + 1.0,
+                         host_cost=1.0)
+        assert s._race_fails == 0  # a device that answers re-closes the breaker
+
+    def test_missed_deadline_counts_a_fail(self, provs):
+        pods = make_pods(4, cpu="250m")
+        problem = encode(pods, provs)
+        s = TPUSolver()
+
+        class NeverReady:
+            def is_ready(self):
+                return False
+
+        dispatched = (NeverReady(), np.zeros((2, 3), np.int32),
+                      np.zeros((2, 3), np.int32), 4, 3, None)
+        import time as _t
+
+        assert s._poll_dispatch(problem, dispatched,
+                                deadline=_t.perf_counter() + 0.01,
+                                host_cost=1.0) is None
+        assert s._race_fails == 1
+
+
 class TestMeshSharding:
     def test_mesh_sharded_matches_single_device(self):
         """The production kernel shards its portfolio axis over the mesh; the
