@@ -169,21 +169,33 @@ class InterruptionController:
         self.unavailable_offerings = unavailable_offerings or UnavailableOfferings()
         self.recorder = recorder or Recorder()
         self.parsers = ParserRegistry()
-        # instance-id -> node-name map, cached across poll batches and
-        # invalidated by node watch events: rebuilding it per 10-message batch
-        # is O(nodes) and turns a 15k-node interruption storm into O(N^2).
-        # The generation counter closes the check-then-act race: a build only
-        # publishes if no node event landed while it ran.
+        # instance-id -> node-name map, built lazily once and then maintained
+        # INCREMENTALLY by node watch events. Mere invalidation is not enough:
+        # a storm deletes nodes every batch, so an invalidated map would be
+        # rebuilt O(nodes) per batch — O(N^2) across a 15k-node storm (this was
+        # ~2/3 of the round-3 throughput sag at the top size). The generation
+        # counter closes the build-vs-event race: a full build only publishes
+        # if no node event landed while it ran; events patch a published map
+        # in place under the lock.
         self._id_map: Optional[Dict[str, str]] = None
         self._id_gen = 0
+        self._id_lock = threading.Lock()
+        self._pool = None  # persistent worker pool (created on first batch)
         cluster.watch(self._on_event)
 
     def _on_event(self, event: str, obj) -> None:
         from ..api.objects import Node
 
         if isinstance(obj, Node):
-            self._id_gen += 1
-            self._id_map = None
+            with self._id_lock:
+                self._id_gen += 1
+                if self._id_map is None or not obj.provider_id:
+                    return
+                iid = obj.provider_id.rsplit("/", 1)[-1]
+                if event == "DELETED":
+                    self._id_map.pop(iid, None)
+                else:  # ADDED / MODIFIED — provider identity is stable per node
+                    self._id_map[iid] = obj.name
 
     #: concurrent message workers, matching the reference's 10-way
     #: reconciler (controller.go:101 MaxConcurrentReconciles)
@@ -217,29 +229,44 @@ class InterruptionController:
         if len(messages) == 1:
             handled = one(messages[0])
         else:
-            from concurrent.futures import ThreadPoolExecutor
+            # persistent pool: spinning up + joining 10 threads per 100-message
+            # batch cost ~8ms/batch — a visible slice of storm throughput
+            if self._pool is None:
+                from concurrent.futures import ThreadPoolExecutor
 
-            with ThreadPoolExecutor(max_workers=min(self.WORKERS, len(messages))) as pool:
-                handled = sum(pool.map(one, messages))
+                self._pool = ThreadPoolExecutor(
+                    max_workers=self.WORKERS,
+                    thread_name_prefix="interruption-worker",
+                )
+            handled = sum(self._pool.map(one, messages))
         if acted:
             # ONE drain pass for the whole batch (delete_node marks nodes;
             # the termination finalizer serializes the actual work)
             self.termination.reconcile()
         return handled
 
+    def close(self) -> None:
+        """Release the worker pool (the operator calls this on shutdown; the
+        watch ref pins this controller, so threads won't die with GC)."""
+        if self._pool is not None:
+            self._pool.shutdown(wait=False)
+            self._pool = None
+
     def _instance_id_map(self) -> Dict[str, str]:
         """instance id -> node name, parsed from providerIDs
-        (makeInstanceIDMap, controller.go:240-259); watch-invalidated cache."""
+        (makeInstanceIDMap, controller.go:240-259); watch-maintained cache."""
         cached = self._id_map
         if cached is not None:
             return cached
-        gen = self._id_gen
+        with self._id_lock:
+            gen = self._id_gen
         out = {}
         for node in list(self.cluster.nodes.values()):
             if node.provider_id:
                 out[node.provider_id.rsplit("/", 1)[-1]] = node.name
-        if self._id_gen == gen:
-            self._id_map = out  # no node event raced the build
+        with self._id_lock:
+            if self._id_gen == gen:
+                self._id_map = out  # no node event raced the build
         return out
 
     def _handle(self, parsed: ParsedMessage, node_by_instance: Dict[str, str]) -> bool:

@@ -79,8 +79,10 @@ class Operator:
         )
         interruption = None
         if settings.interruption_queue_name is not None:
+            # NOT `queue or FakeQueue()`: FakeQueue has __len__, so an empty
+            # caller-supplied queue is falsy and would be silently replaced
             interruption = InterruptionController(
-                cluster, queue or FakeQueue(), termination,
+                cluster, queue if queue is not None else FakeQueue(), termination,
                 unavailable_offerings=getattr(provider, "unavailable_offerings", None),
                 recorder=recorder,
             )
@@ -154,10 +156,21 @@ class Operator:
         try:
             self._run_loop(stop, tick)
         finally:
+            self.close()
+
+    def close(self) -> None:
+        """Release held resources (HTTP port, interruption worker pool).
+        run() calls this on exit; step()-driven code (tests, simulations)
+        should call it too — the cluster watch pins controllers against GC,
+        so an unclosed worker pool outlives the operator object."""
+        try:
             # ALWAYS release the port — a crashed loop must not keep serving
             # ready probes (or block a supervised restart with EADDRINUSE)
-            if self.http_server is not None:
+            if getattr(self, "http_server", None) is not None:
                 self.http_server.stop()
+        finally:
+            if self.interruption is not None:
+                self.interruption.close()
 
     def _run_loop(self, stop: threading.Event, tick: float) -> None:
         from .controllers.kit import SingletonController

@@ -33,6 +33,21 @@ def make_operator(provisioner=None, **settings_kw):
     return op, clock
 
 
+def test_operator_uses_caller_supplied_empty_queue():
+    """Regression: FakeQueue defines __len__, so an EMPTY caller queue is falsy
+    — `queue or FakeQueue()` silently replaced it and the operator never saw
+    messages sent to the caller's queue."""
+    from karpenter_tpu.controllers.interruption import FakeQueue
+
+    queue = FakeQueue()  # empty at wiring time, like the real operator boot
+    op = Operator.new(
+        provider=FakeCloudProvider(catalog=generate_catalog(n_types=10)),
+        settings=Settings(interruption_queue_name="q"),
+        queue=queue,
+    )
+    assert op.interruption.queue is queue
+
+
 class TestLifecycle:
     def test_provision_interrupt_reprovision(self):
         op, clock = make_operator()
