@@ -26,6 +26,11 @@ class Settings:
     # deprovisioning knobs (reference designs/consolidation.md:59-67)
     consolidation_validation_ttl: float = 15.0
     stabilization_window: float = 300.0
+    # wall-clock budget for the multi-node consolidation sweep: each subset is
+    # a full reschedule simulation, so the search degrades to fewer subsets
+    # under load instead of running unbounded as the fleet grows. 0 disables
+    # the multi-node sweep entirely (single-node consolidation still runs).
+    consolidation_timeout: float = 2.0
 
     def validate(self) -> None:
         if not self.cluster_name:
@@ -34,6 +39,8 @@ class Settings:
             raise ValueError("invalid batch durations")
         if not 0 <= self.vm_memory_overhead_percent < 1:
             raise ValueError("vmMemoryOverheadPercent must be in [0,1)")
+        if self.consolidation_timeout < 0:
+            raise ValueError("consolidationTimeout must be >= 0 (0 disables the multi-node sweep)")
 
     # -- config system (reference: karpenter-global-settings ConfigMap,
     # settings.go:40-93; env/flag ingestion in the operator bootstrap) -------

@@ -125,6 +125,22 @@ class FakeCloudProvider(CloudProvider):
             batch_executor=self._execute_fleet,
             options=BatcherOptions(idle_timeout=0.035, max_timeout=1.0, max_items=1000),
         )
+        # Terminate/Describe batchers (reference batches all three hot calls:
+        # terminateinstances.go:36-38 and describeinstances.go:37-39, both
+        # 100ms idle / 1s max / 500 items). Counters record BACKEND calls —
+        # a 200-instance consolidation should bump terminate_calls once.
+        self.terminate_calls = 0
+        self.describe_calls = 0
+        self._terminate_batcher = Batcher(
+            request_hasher=lambda m: "terminate",  # all terminations merge
+            batch_executor=self._execute_terminate,
+            options=BatcherOptions(idle_timeout=0.1, max_timeout=1.0, max_items=500),
+        )
+        self._describe_batcher = Batcher(
+            request_hasher=lambda pid: "describe",  # one filter shape here
+            batch_executor=self._execute_describe,
+            options=BatcherOptions(idle_timeout=0.1, max_timeout=1.0, max_items=500),
+        )
 
     # -- test injection ----------------------------------------------------
     def set_catalog(self, catalog: List[InstanceType]) -> None:
@@ -420,16 +436,65 @@ class FakeCloudProvider(CloudProvider):
 
     def delete(self, machine: Machine) -> None:
         with self._lock:
-            instance_id = _instance_id(machine.status.provider_id)
-            self.delete_calls.append(instance_id)
-            if instance_id not in self.instances:
-                raise MachineNotFoundError(f"instance {instance_id} not found")
-            instance = self.instances[instance_id]
-            instance.state = "terminated"
-            subnet_id = instance.tags.get("subnet")
-            if subnet_id:
-                self.subnet_provider.release_ip(subnet_id)
-            del self.instances[instance_id]
+            self.terminate_calls += 1  # an unbatched TerminateInstances call
+            self._delete_locked(machine)
+
+    def _delete_locked(self, machine: Machine) -> None:
+        instance_id = _instance_id(machine.status.provider_id)
+        self.delete_calls.append(instance_id)
+        if instance_id not in self.instances:
+            raise MachineNotFoundError(f"instance {instance_id} not found")
+        instance = self.instances[instance_id]
+        instance.state = "terminated"
+        subnet_id = instance.tags.get("subnet")
+        if subnet_id:
+            self.subnet_provider.release_ip(subnet_id)
+        del self.instances[instance_id]
+
+    def delete_batched(self, machine: Machine) -> None:
+        """delete() through the terminate batcher: concurrent callers coalesce
+        into one TerminateInstances call (terminateinstances.go:40-52)."""
+        result = self._terminate_batcher.add(machine)
+        if isinstance(result, BaseException):
+            raise result
+
+    def delete_many(self, machines: Sequence[Machine]) -> List[Optional[Exception]]:
+        """One TerminateInstances call for a caller-aggregated set (the
+        termination finalizer knows its whole teardown set up front, so it
+        needs no batching window)."""
+        return self._execute_terminate(machines)
+
+    def _execute_terminate(self, machines: Sequence[Machine]) -> List[Optional[Exception]]:
+        out: List[Optional[Exception]] = []
+        with self._lock:
+            self.terminate_calls += 1  # ONE backend call for the whole set
+            for m in machines:
+                try:
+                    self._delete_locked(m)
+                    out.append(None)
+                except Exception as e:  # noqa: BLE001 - per-item isolation
+                    out.append(e)
+        return out
+
+    def get_batched(self, provider_id: str) -> Machine:
+        """get() through the describe batcher: concurrent point lookups share
+        one DescribeInstances call (describeinstances.go:46-52)."""
+        result = self._describe_batcher.add(provider_id)
+        if isinstance(result, BaseException):
+            raise result
+        return result
+
+    def _execute_describe(self, provider_ids: Sequence[str]) -> List[object]:
+        out: List[object] = []
+        with self._lock:
+            self.describe_calls += 1
+            for pid in provider_ids:
+                instance = self.instances.get(_instance_id(pid))
+                if instance is None:
+                    out.append(MachineNotFoundError(f"{pid} not found"))
+                else:
+                    out.append(self._instance_to_machine(instance))
+        return out
 
     def get(self, provider_id: str) -> Machine:
         with self._lock:

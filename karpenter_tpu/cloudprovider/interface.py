@@ -86,6 +86,22 @@ class CloudProvider(abc.ABC):
     @abc.abstractmethod
     def delete(self, machine: Machine) -> None: ...
 
+    def delete_many(self, machines: List[Machine]) -> List[Optional[Exception]]:
+        """Terminate a known set in as few backend calls as the provider can
+        manage (reference batches TerminateInstances at 100ms/1s/500,
+        pkg/batcher/terminateinstances.go:36-38). Returns one entry per
+        machine: None on success, the exception otherwise — a partial failure
+        must not abort the rest of the set. Base implementation loops
+        ``delete``; providers override with a real batch call."""
+        out: List[Optional[Exception]] = []
+        for m in machines:
+            try:
+                self.delete(m)
+                out.append(None)
+            except Exception as e:  # noqa: BLE001 - per-item fault isolation
+                out.append(e)
+        return out
+
     @abc.abstractmethod
     def get(self, provider_id: str) -> Machine: ...
 

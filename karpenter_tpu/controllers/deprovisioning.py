@@ -298,18 +298,30 @@ class DeprovisioningController:
         cheaper replacement (designs/deprovisioning.md one-cheaper-replacement).
         Every prefix size is evaluated and the MAX-SAVINGS feasible subset wins —
         not the first feasible one. Spot nodes may be deleted in a subset; they
-        only rule out the replacement variant (deprovisioning.md:83-85)."""
+        only rule out the replacement variant (deprovisioning.md:83-85).
+
+        The sweep is DEADLINE-BOUNDED (settings.consolidation_timeout): each
+        prefix is a full reschedule simulation, so on a large fleet the search
+        degrades to fewer (largest-first) subsets instead of stalling the
+        deprovisioning loop; truncation is counted and the sweep duration
+        observed in karpenter_tpu_consolidation_sweep_seconds."""
         best = None
+        t0 = time.monotonic()
+        deadline = t0 + self.settings.consolidation_timeout
         # heuristic subset cap (the reference consolidates over a bounded
-        # candidate subset, designs/consolidation.md): each prefix is a full
-        # reschedule simulation, so the search is capped at the 25
-        # cheapest-to-disrupt nodes
+        # candidate subset, designs/consolidation.md): the search starts at the
+        # 25 cheapest-to-disrupt nodes; largest prefixes first, so a deadline
+        # hit keeps the highest-savings candidates already evaluated
         for k in range(min(len(candidates), 25), 1, -1):
+            if time.monotonic() >= deadline:
+                metrics.CONSOLIDATION_SWEEP_TRUNCATED.inc()
+                break
             action = self._evaluate_subset(candidates[:k])
             if action is None:
                 continue
             if best is None or action.savings > best.savings + 1e-9:
                 best = action
+        metrics.CONSOLIDATION_SWEEP.observe(time.monotonic() - t0)
         return best
 
     def _evaluate_subset(self, subset: List[Node]) -> Optional[PlannedAction]:

@@ -41,6 +41,7 @@ class GarbageCollectionController:
         Returns {"adopted": [...], "collected": [...]}."""
         adopted: List[str] = []
         collected: List[str] = []
+        orphans: List[object] = []
         known_ids = {
             m.status.provider_id for m in self.cluster.machines.values() if m.status.provider_id
         }
@@ -61,10 +62,15 @@ class GarbageCollectionController:
                 continue
             if age < MIN_AGE_SECONDS:
                 continue  # too young: launch may still be registering
-            try:
-                self.provider.delete(machine)
-            except MachineNotFoundError:
-                pass
+            orphans.append(machine)
+        # one batched TerminateInstances call for the whole orphan sweep
+        # (reference batches terminate, terminateinstances.go:36-38); empty
+        # sweeps must not issue (or count) a backend call
+        results = self.provider.delete_many(orphans) if orphans else []
+        for machine, err in zip(orphans, results):
+            if err is not None and not isinstance(err, MachineNotFoundError):
+                continue  # transient failure: retry next pass
+            pid = machine.status.provider_id
             # also remove any node object pointing at the dead instance
             for node in list(self.cluster.nodes.values()):
                 if node.provider_id == pid:

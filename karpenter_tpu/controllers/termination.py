@@ -71,8 +71,13 @@ class TerminationController:
 
     def reconcile(self) -> List[str]:
         """Advance every deleting node through the finalizer; returns names of
-        nodes fully removed this pass."""
+        nodes fully removed this pass. Cordon/drain run per node; instance
+        teardown is AGGREGATED across the pass into one provider
+        ``delete_many`` call — a 200-node consolidation or interruption storm
+        issues a handful of TerminateInstances batches, not 200 singles
+        (reference: terminateinstances.go batches at 100ms/1s/500)."""
         removed = []
+        teardown: List[Node] = []
         with self._pending_lock:
             pending = sorted(self._pending)
         for name in pending:
@@ -85,34 +90,55 @@ class TerminationController:
                 self.cluster.delete_node(node.name)  # DELETED event de-queues
                 removed.append(node.name)
                 continue
-            if self._finalize(node):
-                removed.append(node.name)
+            if self._cordon_and_drain(node):
+                teardown.append(node)
+        removed.extend(self._teardown(teardown))
         return removed
 
     # -- finalizer steps ---------------------------------------------------
-    def _finalize(self, node: Node) -> bool:
+    def _cordon_and_drain(self, node: Node) -> bool:
+        """True when the node is fully drained and ready for instance teardown."""
         if not node.unschedulable:
             node.unschedulable = True  # cordon
             self.cluster.update(node)
             self.recorder.publish("Cordoned", "cordoned for termination",
                                   object_name=node.name, object_kind="Node")
         blocked = self._drain(node)
-        if blocked:
-            return False  # retry next reconcile (eviction queue semantics)
-        # instance teardown
-        machine = self.cluster.machine_for_node(node)
-        if machine is not None:
-            try:
-                self.provider.delete(machine)
-            except MachineNotFoundError:
-                pass  # already gone (interruption etc.)
+        return not blocked  # blocked: retry next reconcile (eviction queue semantics)
+
+    def _teardown(self, nodes: List[Node]) -> List[str]:
+        """Delete the instances behind ``nodes`` (one batched provider call),
+        then drop finalizers and node objects for the successes. A failed
+        delete leaves its node pending for the next pass."""
+        if not nodes:
+            return []
+        machines = [self.cluster.machine_for_node(n) for n in nodes]
+        with_machine = [(n, m) for n, m in zip(nodes, machines) if m is not None]
+        results = self.provider.delete_many([m for _, m in with_machine])
+        failed: set = set()
+        for (node, machine), err in zip(with_machine, results):
+            if err is not None and not isinstance(err, MachineNotFoundError):
+                # transient cloud failure: keep the node pending and retry
+                self.recorder.publish(
+                    "TerminationFailed", f"instance delete failed: {err}",
+                    object_name=node.name, object_kind="Node", type="Warning",
+                )
+                failed.add(node.name)
+                continue
             self.cluster.delete_machine(machine.name)
-        node.meta.finalizers = [f for f in node.meta.finalizers if f != wk.TERMINATION_FINALIZER]
-        self.cluster.delete_node(node.name)
-        metrics.NODES_TERMINATED.inc({"provisioner": node.provisioner_name() or ""})
-        self.recorder.publish("Terminated", "node terminated",
-                              object_name=node.name, object_kind="Node")
-        return True
+        removed = []
+        for node in nodes:
+            if node.name in failed:
+                continue
+            node.meta.finalizers = [
+                f for f in node.meta.finalizers if f != wk.TERMINATION_FINALIZER
+            ]
+            self.cluster.delete_node(node.name)
+            metrics.NODES_TERMINATED.inc({"provisioner": node.provisioner_name() or ""})
+            self.recorder.publish("Terminated", "node terminated",
+                                  object_name=node.name, object_kind="Node")
+            removed.append(node.name)
+        return removed
 
     def _drain(self, node: Node) -> List[Pod]:
         """Evict all evictable pods; returns pods still blocking the drain."""

@@ -162,6 +162,12 @@ PROVISIONING_DURATION = Histogram(
 DEPROVISIONING_ACTIONS = Counter(
     "karpenter_tpu_deprovisioning_actions_total", registry=REGISTRY
 )
+CONSOLIDATION_SWEEP = Histogram(
+    "karpenter_tpu_consolidation_sweep_seconds", registry=REGISTRY
+)
+CONSOLIDATION_SWEEP_TRUNCATED = Counter(
+    "karpenter_tpu_consolidation_sweep_truncated_total", registry=REGISTRY
+)
 INTERRUPTION_MESSAGES = Counter(
     "karpenter_tpu_interruption_messages_total", registry=REGISTRY
 )

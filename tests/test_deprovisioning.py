@@ -281,3 +281,30 @@ class TestMultiNodeFidelity:
         assert action is not None
         assert action.reason == "consolidation-delete"
         assert len(action.nodes) >= 2
+
+
+class TestSweepDeadline:
+    def test_exhausted_budget_truncates_multi_node_sweep(self):
+        """consolidation_timeout bounds the subset sweep: with a zero budget the
+        multi-node search yields nothing (counted as truncated) but the
+        single-node path still consolidates."""
+        from karpenter_tpu.utils import metrics as M
+
+        cluster, provider, ctl, deprov, clock = make_env(
+            make_provisioner(consolidation_enabled=True), validation_ttl=0.0
+        )
+        deprov.settings.consolidation_timeout = 0.0
+        _sparse_two_nodes(cluster, provider)
+        before = M.CONSOLIDATION_SWEEP_TRUNCATED.value()
+        assert deprov._try_multi_node(deprov._consolidatable()) is None
+        assert M.CONSOLIDATION_SWEEP_TRUNCATED.value() == before + 1
+        action = deprov.reconcile()  # single-node fallback still acts
+        assert action is not None and action.reason.startswith("consolidation")
+
+    def test_generous_budget_keeps_multi_node_result(self):
+        cluster, provider, ctl, deprov, clock = make_env(
+            make_provisioner(consolidation_enabled=True), validation_ttl=0.0
+        )
+        deprov.settings.consolidation_timeout = 30.0
+        _sparse_two_nodes(cluster, provider)
+        assert deprov._try_multi_node(deprov._consolidatable()) is not None

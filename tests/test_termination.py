@@ -98,3 +98,99 @@ class TestTermination:
     def test_delete_unknown_node(self, env):
         cluster, provider, ctl, term, clock = env
         assert not term.delete_node("nope")
+
+
+class TestBatchedTeardown:
+    """Reference batches TerminateInstances (terminateinstances.go:36-38);
+    the termination pass must aggregate its whole teardown set into one
+    backend call, and a partial failure must not strand the rest."""
+
+    def test_mass_termination_is_one_backend_call(self, env):
+        cluster, provider, ctl, term, clock = env
+        provision(cluster, ctl, 40, cpu="2")
+        assert len(cluster.nodes) >= 3
+        before = provider.terminate_calls
+        for name in list(cluster.nodes):
+            term.delete_node(name)
+        term.reconcile()
+        assert len(cluster.nodes) == 0
+        assert provider.terminate_calls == before + 1  # ONE TerminateInstances
+
+    def test_partial_failure_keeps_node_pending(self, env):
+        cluster, provider, ctl, term, clock = env
+        provision(cluster, ctl, 20, cpu="2")
+        names = sorted(cluster.nodes)
+        assert len(names) >= 2
+        victim = names[0]
+        real_delete_many = provider.delete_many
+
+        def flaky(machines):
+            results = real_delete_many(machines)
+            out = []
+            for m, r in zip(machines, results):
+                node = next((n for n in cluster.nodes.values()
+                             if n.provider_id == m.status.provider_id), None)
+                out.append(RuntimeError("api throttled") if node and node.name == victim else r)
+            return out
+
+        provider.delete_many = flaky
+        for name in names:
+            term.delete_node(name)
+        removed = term.reconcile()
+        assert victim not in removed
+        assert victim in cluster.nodes  # stays pending for retry
+        assert set(removed) == set(names) - {victim}
+        provider.delete_many = real_delete_many
+        assert term.reconcile() == [victim]  # retried next pass
+
+
+class TestProviderBatchers:
+    def test_concurrent_delete_batched_coalesce(self):
+        import threading
+
+        provider = FakeCloudProvider(catalog=generate_catalog(n_types=10))
+        from karpenter_tpu.api import Machine, Requirement, Requirements
+
+        machines = []
+        for i in range(12):
+            m = Machine(meta=ObjectMeta(name=f"m-{i}"), provisioner_name="default",
+                        requirements=Requirements([]), requests=Resources(cpu="100m"))
+            machines.append(provider.create(m))
+        before = provider.terminate_calls
+        threads = [threading.Thread(target=provider.delete_batched, args=(m,))
+                   for m in machines]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(provider.instances) == 0
+        assert provider.terminate_calls == before + 1
+
+    def test_concurrent_get_batched_coalesce(self):
+        import threading
+
+        provider = FakeCloudProvider(catalog=generate_catalog(n_types=10))
+        from karpenter_tpu.api import Machine, Requirements
+
+        pids = []
+        for i in range(8):
+            m = Machine(meta=ObjectMeta(name=f"m-{i}"), provisioner_name="default",
+                        requirements=Requirements([]), requests=Resources(cpu="100m"))
+            pids.append(provider.create(m).status.provider_id)
+        before = provider.describe_calls
+        out = [None] * len(pids)
+
+        def fetch(i):
+            out[i] = provider.get_batched(pids[i])
+
+        threads = [threading.Thread(target=fetch, args=(i,)) for i in range(len(pids))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert provider.describe_calls == before + 1
+        assert all(o is not None for o in out)
+        from karpenter_tpu.cloudprovider.interface import MachineNotFoundError
+
+        with pytest.raises(MachineNotFoundError):
+            provider.get_batched("fake:///zone-a/i-99999999")
