@@ -31,6 +31,7 @@ from .interface import (
     CloudProviderError,
     Image,
     InsufficientCapacityError,
+    WindowedBatchers,
     Instance,
     MachineNotFoundError,
     SecurityGroup,
@@ -41,7 +42,7 @@ from .types import InstanceType, Offering
 OfferingKey = Tuple[str, str, str]  # (instance_type, zone, capacity_type)
 
 
-class FakeCloudProvider(CloudProvider):
+class FakeCloudProvider(WindowedBatchers, CloudProvider):
     def __init__(
         self,
         catalog: Optional[List[InstanceType]] = None,
@@ -125,22 +126,12 @@ class FakeCloudProvider(CloudProvider):
             batch_executor=self._execute_fleet,
             options=BatcherOptions(idle_timeout=0.035, max_timeout=1.0, max_items=1000),
         )
-        # Terminate/Describe batchers (reference batches all three hot calls:
-        # terminateinstances.go:36-38 and describeinstances.go:37-39, both
-        # 100ms idle / 1s max / 500 items). Counters record BACKEND calls —
-        # a 200-instance consolidation should bump terminate_calls once.
+        # Terminate/Describe batching comes from the WindowedBatchers mixin
+        # (reference batches all three hot calls, terminateinstances.go:36-38,
+        # describeinstances.go:37-39). Counters record BACKEND calls — a
+        # 200-instance consolidation should bump terminate_calls once.
         self.terminate_calls = 0
         self.describe_calls = 0
-        self._terminate_batcher = Batcher(
-            request_hasher=lambda m: "terminate",  # all terminations merge
-            batch_executor=self._execute_terminate,
-            options=BatcherOptions(idle_timeout=0.1, max_timeout=1.0, max_items=500),
-        )
-        self._describe_batcher = Batcher(
-            request_hasher=lambda pid: "describe",  # one filter shape here
-            batch_executor=self._execute_describe,
-            options=BatcherOptions(idle_timeout=0.1, max_timeout=1.0, max_items=500),
-        )
 
     # -- test injection ----------------------------------------------------
     def set_catalog(self, catalog: List[InstanceType]) -> None:
@@ -263,96 +254,45 @@ class FakeCloudProvider(CloudProvider):
         return out
 
     def create(self, machine: Machine) -> Machine:
+        """Launch through the shared policy module (launchpolicy.py): price
+        ordering, spot-vs-OD, top-N truncation and the ICE fallback walk are
+        provider-agnostic; this fake contributes only its instance store, its
+        injected ICE pools, and subnet IP accounting."""
+        from .launchpolicy import candidate_offerings, launch_with_fallback
+
         with self._lock:
             if self.next_errors:
                 raise self.next_errors.pop(0)
             self.create_calls.append(machine)
-            candidates = self._candidate_offerings(machine)
+            candidates = candidate_offerings(
+                machine.requirements,
+                machine.requests,
+                self.catalog,
+                price=self.pricing.price,
+                is_unavailable=self.unavailable_offerings.is_unavailable,
+                max_instance_types=self.max_instance_types,
+            )
             if not candidates:
                 raise InsufficientCapacityError(
                     f"no compatible offerings for machine {machine.name}"
                 )
-            attempted: List[OfferingKey] = []
-            for it, offering in candidates:
-                key = (it.name, offering.zone, offering.capacity_type)
-                self.launch_attempts += 1
-                if key in self.insufficient_capacity_pools:
-                    # ICE: blacklist for 3m and fall through to next-cheapest
-                    # (instance.go:400-406).
-                    self.unavailable_offerings.mark_unavailable(*key, reason="ICE")
-                    attempted.append(key)
-                    continue
-                try:
-                    return self._launch(machine, it, offering)
-                except InsufficientCapacityError:
-                    # subnet IP exhaustion in this zone: mask the offering so
-                    # the next solve routes around it, and try the next
-                    # candidate (same treatment as an ICE, instance.go:400-406)
-                    self.unavailable_offerings.mark_unavailable(
-                        *key, reason="ip-exhaustion"
-                    )
-                    attempted.append(key)
-                    continue
-            raise InsufficientCapacityError(
-                f"all offerings exhausted for machine {machine.name}", offerings=attempted
-            )
 
-    def _candidate_offerings(
-        self, machine: Machine
-    ) -> List[Tuple[InstanceType, Offering]]:
-        reqs = machine.requirements
-        types = [
-            it
-            for it in self.catalog
-            if it.requirements.compatible(reqs) and machine.requests.fits(it.allocatable())
-        ]
-        # Capacity-type choice: spot when the machine allows it and any spot offering
-        # exists, else on-demand (instance.go:411-424).
-        ct_req = reqs.get(wk.CAPACITY_TYPE)
-        use_spot = ct_req.has(wk.CAPACITY_TYPE_SPOT) and any(
-            o.capacity_type == wk.CAPACITY_TYPE_SPOT and o.available
-            for it in types
-            for o in it.offerings
-        )
-        chosen_ct = wk.CAPACITY_TYPE_SPOT if use_spot else wk.CAPACITY_TYPE_ON_DEMAND
-        zone_req = reqs.get(wk.ZONE)
-        # ONE pass collects launchable offerings into the chosen-capacity list
-        # and (for the spot-vs-OD comparison) the on-demand alternative list,
-        # priced LIVE (pricing.go feeds instance.go's price-ordered launch
-        # list), so the two can never use different filter rules.
-        priced: List[Tuple[float, InstanceType, Offering]] = []
-        od_candidates: List[Tuple[float, InstanceType, Offering]] = []
-        for it in types:
-            for o in it.offerings:
-                if not o.available or not zone_req.has(o.zone):
-                    continue
-                if self.unavailable_offerings.is_unavailable(it.name, o.zone, o.capacity_type):
-                    continue
-                p = self.pricing.price(it.name, o.zone, o.capacity_type)
-                entry = (p if p is not None else o.price, it, o)
-                if o.capacity_type == chosen_ct:
-                    priced.append(entry)
-                elif o.capacity_type == wk.CAPACITY_TYPE_ON_DEMAND:
-                    od_candidates.append(entry)
-        if (
-            chosen_ct == wk.CAPACITY_TYPE_SPOT
-            and ct_req.has(wk.CAPACITY_TYPE_ON_DEMAND)
-            and od_candidates
-        ):
-            # Spot offerings pricier than the cheapest LAUNCHABLE on-demand are
-            # strictly worse (pay more AND risk reclaim) — drop them
-            # (instance.go:486-508 filterInstanceTypes). Only applies when the
-            # machine may actually use on-demand; spot-pinned machines keep
-            # their offerings regardless of price.
-            cheapest_od = min(e[0] for e in od_candidates)
-            filtered = [e for e in priced if e[0] < cheapest_od]
-            # all spot overpriced: launch on-demand instead of paying a spot
-            # premium for reclaim risk
-            priced = filtered if filtered else od_candidates
-        priced.sort(key=lambda p: p[0])
-        # Reference truncates the launch request to the cheapest 60 types
-        # (instance.go:55,90-92); we bound offerings similarly.
-        return [(it, o) for _, it, o in priced[: self.max_instance_types]]
+            def try_launch(it: InstanceType, offering: Offering) -> Machine:
+                self.launch_attempts += 1
+                key = (it.name, offering.zone, offering.capacity_type)
+                if key in self.insufficient_capacity_pools:
+                    # injected ICE: blacklisted by the fallback walk
+                    raise InsufficientCapacityError(f"ICE pool {key}")
+                return self._launch(machine, it, offering)
+
+            return launch_with_fallback(
+                machine,
+                candidates,
+                try_launch,
+                lambda t, z, c, reason: self.unavailable_offerings.mark_unavailable(
+                    t, z, c, reason=reason
+                ),
+            )
 
     def _resolve_launch_config(self, machine: Machine, it: InstanceType):
         """NodeTemplate -> resolved launch config for this machine+type, or None
@@ -451,13 +391,6 @@ class FakeCloudProvider(CloudProvider):
             self.subnet_provider.release_ip(subnet_id)
         del self.instances[instance_id]
 
-    def delete_batched(self, machine: Machine) -> None:
-        """delete() through the terminate batcher: concurrent callers coalesce
-        into one TerminateInstances call (terminateinstances.go:40-52)."""
-        result = self._terminate_batcher.add(machine)
-        if isinstance(result, BaseException):
-            raise result
-
     def delete_many(self, machines: Sequence[Machine]) -> List[Optional[Exception]]:
         """One TerminateInstances call for a caller-aggregated set (the
         termination finalizer knows its whole teardown set up front, so it
@@ -475,14 +408,6 @@ class FakeCloudProvider(CloudProvider):
                 except Exception as e:  # noqa: BLE001 - per-item isolation
                     out.append(e)
         return out
-
-    def get_batched(self, provider_id: str) -> Machine:
-        """get() through the describe batcher: concurrent point lookups share
-        one DescribeInstances call (describeinstances.go:46-52)."""
-        result = self._describe_batcher.add(provider_id)
-        if isinstance(result, BaseException):
-            raise result
-        return result
 
     def _execute_describe(self, provider_ids: Sequence[str]) -> List[object]:
         out: List[object] = []
@@ -590,6 +515,7 @@ class FakeCloudProvider(CloudProvider):
         m = Machine(
             meta=ObjectMeta(
                 name=instance.id,
+                creation_timestamp=instance.created,  # GC's too-young guard
                 labels={
                     **it.requirements.labels(),
                     wk.INSTANCE_TYPE: instance.instance_type,

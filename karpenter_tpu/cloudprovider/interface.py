@@ -26,9 +26,15 @@ class InsufficientCapacityError(CloudProviderError):
     Mirrors the reference's unfulfillable-capacity error taxonomy
     (/root/reference/pkg/errors/errors.go:31-64)."""
 
-    def __init__(self, message: str, offerings: List[tuple] | None = None):
+    def __init__(
+        self,
+        message: str,
+        offerings: List[tuple] | None = None,
+        reason: str = "ICE",
+    ):
         super().__init__(message)
         self.offerings = offerings or []  # [(instance_type, zone, capacity_type)]
+        self.reason = reason  # ICE-cache mark reason (e.g. "ICE", "ip-exhaustion")
 
 
 class MachineNotFoundError(CloudProviderError):
@@ -76,6 +82,61 @@ class Instance:
     launch_template: str = ""
     image_family: str = ""
     image_variant: str = ""
+
+
+class WindowedBatchers:
+    """Shared plumbing for the windowed Terminate/Describe batchers
+    (reference windows 100ms/1s/500, ``pkg/batcher/{terminateinstances,
+    describeinstances}.go:36-39``). A provider mixes this in and supplies
+    ``_execute_terminate(machines)`` / ``_execute_describe(provider_ids)``
+    (one backend call each, per-item results); concurrent point callers then
+    coalesce through ``delete_batched`` / ``get_batched``."""
+
+    _TERMINATE_OPTS = dict(idle_timeout=0.1, max_timeout=1.0, max_items=500)
+    _DESCRIBE_OPTS = dict(idle_timeout=0.1, max_timeout=1.0, max_items=500)
+
+    @property
+    def _terminate_batcher(self):
+        b = getattr(self, "_terminate_batcher_obj", None)
+        if b is None:
+            from ..utils.batcher import Batcher, BatcherOptions
+
+            b = Batcher(
+                request_hasher=lambda m: "terminate",  # all terminations merge
+                batch_executor=self._execute_terminate,
+                options=BatcherOptions(**self._TERMINATE_OPTS),
+            )
+            self._terminate_batcher_obj = b
+        return b
+
+    @property
+    def _describe_batcher(self):
+        b = getattr(self, "_describe_batcher_obj", None)
+        if b is None:
+            from ..utils.batcher import Batcher, BatcherOptions
+
+            b = Batcher(
+                request_hasher=lambda pid: "describe",  # one filter shape here
+                batch_executor=self._execute_describe,
+                options=BatcherOptions(**self._DESCRIBE_OPTS),
+            )
+            self._describe_batcher_obj = b
+        return b
+
+    def delete_batched(self, machine: Machine) -> None:
+        """delete() through the terminate batcher: concurrent callers coalesce
+        into one TerminateInstances call (terminateinstances.go:40-52)."""
+        result = self._terminate_batcher.add(machine)
+        if isinstance(result, BaseException):
+            raise result
+
+    def get_batched(self, provider_id: str) -> Machine:
+        """get() through the describe batcher: concurrent point lookups share
+        one DescribeInstances call (describeinstances.go:46-52)."""
+        result = self._describe_batcher.add(provider_id)
+        if isinstance(result, BaseException):
+            raise result
+        return result
 
 
 class CloudProvider(abc.ABC):

@@ -56,7 +56,8 @@ class SubnetProvider:
                     best, best_free = s, free
             if best is None or best_free < need_ips:
                 raise InsufficientCapacityError(
-                    f"no subnet in {zone} has {need_ips} free IPs"
+                    f"no subnet in {zone} has {need_ips} free IPs",
+                    reason="ip-exhaustion",
                 )
             self._inflight[best.id] = self._inflight.get(best.id, 0) + need_ips
             return best

@@ -50,8 +50,12 @@ class GarbageCollectionController:
             if pid in known_ids:
                 continue
             provisioner_name = machine.provisioner_name
+            # the machine's creation stamp comes from the provider's instance
+            # conversion (carried on meta), so the too-young launch guard works
+            # for ANY provider, not only the fake's instance_for hook
             instance = getattr(self.provider, "instance_for", lambda m: None)(machine)
-            age = self.clock.now() - (instance.created if instance else 0.0)
+            created = instance.created if instance else machine.meta.creation_timestamp
+            age = self.clock.now() - created
             if provisioner_name and provisioner_name in self.cluster.provisioners:
                 # adoption: create the Machine object and mark it linked
                 machine.meta.annotations[LINK_ANNOTATION] = pid

