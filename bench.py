@@ -109,6 +109,47 @@ def config_10k_topology():
     return pods, [(prov, generate_catalog(n_types=150))], []
 
 
+def config_10k_crossgroup():
+    """10k pods with CROSS-GROUP constraints (round-4 verdict item 1): web
+    services colocated with their database at hostname, and a frontend tier
+    whose zone spread counts all frontend services jointly. Must run on the
+    tensor path (backend kernel, fallback 0)."""
+    from karpenter_tpu.api import ObjectMeta, PodAffinityTerm, Provisioner, TopologySpreadConstraint
+    from karpenter_tpu.api import labels as wk
+    from karpenter_tpu.cloudprovider import generate_catalog
+
+    shapes = []
+    for i in range(4):
+        shapes.append(
+            (f"db{i}", 150, "1", "2Gi", {"labels": {"app": f"db{i}", "tier": "data"}})
+        )
+        # web service i rides on db service i's nodes (cross-group hostname
+        # colocation: scheduling.md "run with" another service's pods); the
+        # web mem/cpu blend matches the db's, so the LB (which cannot price
+        # affinity) and the constrained optimum want the same node family
+        shapes.append(
+            (f"web{i}", 600, "250m", "512Mi",
+             {"labels": {"app": f"web{i}"},
+              "affinity": [PodAffinityTerm({"app": f"db{i}"}, wk.HOSTNAME)]})
+        )
+    # frontend tier: every service spreads over zones counting the WHOLE tier
+    # (cross-group spread selector {tier: front} matches all four services)
+    front_spread = [
+        TopologySpreadConstraint(max_skew=1, topology_key=wk.ZONE,
+                                 label_selector={"tier": "front"})
+    ]
+    for i in range(4):
+        shapes.append(
+            (f"front{i}", 1500, ["250m", "500m"][i % 2], ["512Mi", "1Gi"][i % 2],
+             {"labels": {"app": f"front{i}", "tier": "front"},
+              "spread": front_spread})
+        )
+    shapes.append(("filler", 1000, "500m", "1Gi", {}))
+    pods = _pods(shapes)
+    prov = Provisioner(meta=ObjectMeta(name="default"))
+    return pods, [(prov, generate_catalog(n_types=150))], []
+
+
 def config_20k_repack():
     """Consolidation-shaped: 2k in-flight nodes, 20k pods repacked to min cost."""
     from karpenter_tpu.api import Node, ObjectMeta, Provisioner, Resources
@@ -181,6 +222,7 @@ CONFIGS = [
     ("1k_basic", config_1k),
     ("5k_constrained", config_5k_constrained),
     ("10k_topology", config_10k_topology),
+    ("10k_crossgroup", config_10k_crossgroup),
     ("20k_repack", config_20k_repack),
     ("50k_full", config_50k_full),
 ]
@@ -424,6 +466,7 @@ def bench_config(name, make, repeats=REPEATS):
         "unschedulable": len(result.unschedulable),
         "violations": len(violations),
         "backend": backend,
+        "oracle_fallbacks": int(result.stats.get("fallback", 0)),
     }
 
 

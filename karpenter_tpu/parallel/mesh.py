@@ -33,22 +33,24 @@ def shard_portfolio(
     orders: jax.Array,
     alphas: jax.Array,
     looks: jax.Array,
+    rsvs: jax.Array,
     swaps: jax.Array,
 ):
     """Place portfolio members across the mesh; problem tensors replicate.
 
-    orders/alphas/looks/swaps lead with the portfolio axis; K must divide
-    evenly by mesh size (make_orders rounds K up to a multiple of the device
-    count when sharding).
+    orders/alphas/looks/rsvs/swaps lead with the portfolio axis; K must
+    divide evenly by mesh size (make_orders rounds K up to a multiple of the
+    device count when sharding).
     """
     member = NamedSharding(mesh, P(PORTFOLIO_AXIS))
     replicated = NamedSharding(mesh, P())
     orders = jax.device_put(orders, member)
     alphas = jax.device_put(alphas, member)
     looks = jax.device_put(looks, member)
+    rsvs = jax.device_put(rsvs, member)
     swaps = jax.device_put(swaps, member)
     inputs = jax.tree.map(lambda x: jax.device_put(x, replicated), inputs)
-    return inputs, orders, alphas, looks, swaps
+    return inputs, orders, alphas, looks, rsvs, swaps
 
 
 def round_up_portfolio(k: int, mesh: Optional[Mesh]) -> int:

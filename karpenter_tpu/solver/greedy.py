@@ -53,6 +53,39 @@ class GreedyPacker:
         ]
         self._seed_counts = [len(e.pods) for e in problem.existing]
         self.n_existing = len(self.nodes)
+        # admission-symmetry fast path: scan the anti-term inventory once so
+        # constraint-free problems skip the per-placement reverse checks
+        carriers = [g.pods[0] for g in problem.groups] + [
+            p for e in problem.existing for p in e.pods
+        ]
+        self._any_anti_host = any(
+            t.anti and t.topology_key == wk.HOSTNAME
+            for p in carriers
+            for t in p.affinity_terms
+        )
+        # zone-level symmetry runs off incremental per-(zone, term) carrier
+        # counts, not a rescan of every pod in the zone per placement (that
+        # is quadratic in batch size): unique anti-zone terms by signature,
+        # counts seeded from bound pods and bumped by _try_place.
+        self._anti_zone_terms: Dict[tuple, object] = {}
+        for p in carriers:
+            for t in p.affinity_terms:
+                if t.anti and t.topology_key == wk.ZONE:
+                    sig = tuple(sorted(dict(t.label_selector).items()))
+                    self._anti_zone_terms.setdefault(sig, t)
+        self._zone_carriers: Dict[tuple, int] = {}  # (zone, sig) -> carriers
+        for node in self.nodes:
+            for q in node.pods:
+                self._bump_zone_carriers(q, node.zone)
+
+    def _bump_zone_carriers(self, pod: Pod, zone: str) -> None:
+        if not self._anti_zone_terms:
+            return
+        for t in pod.affinity_terms:
+            if t.anti and t.topology_key == wk.ZONE:
+                sig = tuple(sorted(dict(t.label_selector).items()))
+                key = (zone, sig)
+                self._zone_carriers[key] = self._zone_carriers.get(key, 0) + 1
 
     # -- constraint checks against the evolving assignment ------------------
     def _spread_ok(self, pod: Pod, node: _SimNode) -> bool:
@@ -78,6 +111,16 @@ class GreedyPacker:
         return True
 
     def _affinity_ok(self, pod: Pod, node: _SimNode) -> bool:
+        # admission symmetry (k8s InterPodAffinity): a pod may not join a
+        # domain holding a pod whose required ANTI term selects it
+        if self._any_anti_host:
+            for other in node.pods:
+                for t2 in other.affinity_terms:
+                    if t2.anti and t2.topology_key == wk.HOSTNAME and t2.selects(pod):
+                        return False
+        for sig, t2 in self._anti_zone_terms.items():
+            if self._zone_carriers.get((node.zone, sig), 0) and t2.selects(pod):
+                return False
         for term in pod.affinity_terms:
             matching_domains = set()
             any_match = False
@@ -116,6 +159,7 @@ class GreedyPacker:
             return False
         node.rem -= demand
         node.pods.append(pod)
+        self._bump_zone_carriers(pod, node.zone)
         return True
 
     def solve(self) -> SolveResult:
@@ -136,7 +180,12 @@ class GreedyPacker:
         # price), mirroring how the reference packs the batch into a hypothetical
         # node and then picks the cheapest instance type that holds it — not
         # "cheapest node that fits one pod", which shreds batches across minimum
-        # nodes (bin-packing.md:16-43).
+        # nodes (bin-packing.md:16-43). Sizing uses the co-packing demand
+        # (encode.sizing_demand): providers of hostname-affinity requirers
+        # reserve room for them, as the reference's hypothetical node does.
+        from .encode import sizing_demand
+
+        size_d = sizing_demand(p)
         remaining = {gi: g.count for gi, g in enumerate(p.groups)}
         units_cache: Dict[int, np.ndarray] = {}
         for size, gi, pod in pod_order:
@@ -151,14 +200,27 @@ class GreedyPacker:
                 continue
             units = units_cache.get(gi)
             if units is None:
+                sd = size_d[gi].astype(np.float64)
                 with np.errstate(divide="ignore", invalid="ignore"):
                     per_axis = np.where(
-                        demand[None, :] > 0,
-                        np.floor(p.alloc / np.maximum(demand[None, :], 1e-30) + 1e-9),
+                        sd[None, :] > 0,
+                        np.floor(p.alloc / np.maximum(sd[None, :], 1e-30) + 1e-9),
                         np.inf,
                     )
                 units = np.min(per_axis, axis=1)
                 units = np.where(np.isfinite(units), units, 0).astype(np.int64)
+                if size_d is not p.demand:
+                    # a reserve so large it zeroes a real fit degrades to one
+                    # provider pod per node (max requirer headroom)
+                    with np.errstate(divide="ignore", invalid="ignore"):
+                        real_axis = np.where(
+                            demand[None, :] > 0,
+                            np.floor(p.alloc / np.maximum(demand[None, :], 1e-30) + 1e-9),
+                            np.inf,
+                        )
+                    real_units = np.min(real_axis, axis=1)
+                    real_units = np.where(np.isfinite(real_units), real_units, 0)
+                    units = np.where((units == 0) & (real_units > 0), 1, units)
                 units_cache[gi] = units
             want = max(remaining[gi], 1)
             with np.errstate(divide="ignore", invalid="ignore"):

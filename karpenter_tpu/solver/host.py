@@ -48,11 +48,21 @@ _EPS = 1e-9
 def lp_safe(problem: EncodedProblem) -> bool:
     """True when every group's constraints are expressible in the LP: plain
     resource demands + compat masks only. Spread/anti-affinity/colocation caps
-    are per-assignment constraints the LP relaxation would silently violate."""
+    — and cross-group relation bits (incl. seeds) — are per-assignment
+    constraints the LP relaxation would silently violate."""
     from .encode import BIG_CAP
 
+    rel_active = any(
+        a is not None and np.any(a)
+        for a in (
+            problem.rel_set, problem.rel_host_forbid, problem.rel_host_need,
+            problem.rel_zone_forbid, problem.rel_zone_need,
+            problem.rel_slot_bits, problem.rel_zone_bits,
+        )
+    )
     return bool(
-        np.all(problem.node_cap >= BIG_CAP)
+        not rel_active
+        and np.all(problem.node_cap >= BIG_CAP)
         and np.all(problem.zone_cap >= BIG_CAP)
         and np.all(problem.zone_skew == 0)
         and not np.any(problem.colocate)

@@ -60,6 +60,12 @@ LOOKAHEAD_FLOOR = np.float32(0.25)
 
 class PackInputs(NamedTuple):
     demand: jax.Array  # [G, R] f32 per-pod demand (normalized)
+    # node-SIZING demand: demand plus a per-pod reserve for hostname-affinity
+    # requirers that can only live on this group's nodes (the reference sizes
+    # an in-flight node by packing ALL co-schedulable pending pods,
+    # bin-packing.md:16-43). Equals `demand` when no such relations exist.
+    # Fill-time capacity checks always use the real `demand`.
+    demand_units: jax.Array  # [G, R] f32
     count: jax.Array  # [G] i32
     node_cap: jax.Array  # [G] i32
     # Per-(group, zone) NEW-pod quotas, host-computed: water-filled spread
@@ -76,12 +82,26 @@ class PackInputs(NamedTuple):
     ex_zone: jax.Array  # [E] i32
     ex_compat: jax.Array  # [G, E] bool
     ex_valid: jax.Array  # [E] bool
+    # Cross-group relation bitmasks (encode._build_relations): presence bits
+    # carried per slot and per zone through the scan; all-zero when the
+    # problem has no cross-group (anti-)affinity terms.
+    rel_set: jax.Array  # [G] i32 bits a group's placement sets on its domain
+    rel_host_forbid: jax.Array  # [G] i32 slot bits that forbid placement
+    rel_host_need: jax.Array  # [G] i32 slot bits ALL required to place
+    rel_zone_forbid: jax.Array  # [G] i32
+    rel_zone_need: jax.Array  # [G] i32
+    rel_slot_bits: jax.Array  # [E] i32 seed bits of existing nodes
+    rel_zone_bits: jax.Array  # [Z] i32 seed bits per zone
 
 
 class _Shared(NamedTuple):
     """Order-independent precompute, shared by every portfolio member."""
 
     units: jax.Array  # [G, O] i32 pods-per-fresh-node (node_cap/coloc/compat applied)
+    # reserve-sized variant (demand_units): members with the reserve flag size
+    # provider nodes with requirer headroom; equals `units` when no reserve
+    units_rsv: jax.Array  # [G, O] i32
+    rsv_group: jax.Array  # [G] bool — group carries a requirer reserve
     lam: jax.Array  # [G] f32 cheapest per-pod rate of each group
     quota: jax.Array  # [G, Z] i32 per-zone placement quota (IBIG when unlimited)
     zone_limited: jax.Array  # [G] bool
@@ -114,14 +134,38 @@ def _shared_precompute(inputs: PackInputs, s_new: int, n_zones: int) -> _Shared:
     cnt = inputs.count
 
     # units[g, o]: whole pods per fresh node, capped by per-node topology caps.
-    safe = jnp.where(d[:, None, :] > 0, inputs.alloc[None, :, :] / jnp.maximum(d[:, None, :], 1e-30), INF)
-    units = jnp.clip(jnp.floor(jnp.min(safe, axis=-1) + 1e-4), 0, IBIG).astype(jnp.int32)
-    units = jnp.minimum(units, inputs.node_cap[:, None])
+    # Two sizing variants: raw demand, and demand_units (real demand +
+    # requirer reserve — a reserve so large it would zero a feasible pairing
+    # degrades to 1 pod/node: one provider per node, max requirer headroom).
+    # Portfolio members choose per the rsv flag; the argmin compares true
+    # costs, so whichever sizing packs cheaper wins.
+    def _sized_units(dd):
+        safe = jnp.where(
+            dd[:, None, :] > 0,
+            inputs.alloc[None, :, :] / jnp.maximum(dd[:, None, :], 1e-30),
+            INF,
+        )
+        return jnp.clip(jnp.floor(jnp.min(safe, axis=-1) + 1e-4), 0, IBIG).astype(jnp.int32)
+
     ok = inputs.compat & inputs.opt_valid[None, :]
-    units = jnp.where(ok, units, 0)
-    units = jnp.where(
-        inputs.colocate[:, None], jnp.where(units >= cnt[:, None], units, 0), units
-    )
+
+    def _finish(un):
+        un = jnp.minimum(un, inputs.node_cap[:, None])
+        un = jnp.where(ok, un, 0)
+        return jnp.where(
+            inputs.colocate[:, None], jnp.where(un >= cnt[:, None], un, 0), un
+        )
+
+    units_raw = _sized_units(d)
+    units_rsv = _sized_units(inputs.demand_units)
+    # An option that cannot hold even ONE provider pod plus its reserve stays
+    # 0 for reserve members — opening it would strand the requirers it was
+    # sized for. Only when NO option fits the reserve does the group fall back
+    # to raw sizing (provider pods still place; requirers take what's left).
+    row_fits = jnp.any((units_rsv > 0) & ok, axis=1, keepdims=True)  # [G, 1]
+    units_rsv = jnp.where(~row_fits & (units_raw > 0), units_raw, units_rsv)
+    units = _finish(units_raw)
+    units_rsv = _finish(units_rsv)
 
     units_f = units.astype(jnp.float32)
     rate = jnp.where(units > 0, inputs.price[None, :] / jnp.maximum(units_f, 1.0), INF)
@@ -156,8 +200,11 @@ def _shared_precompute(inputs: PackInputs, s_new: int, n_zones: int) -> _Shared:
         [ex_ok, jnp.zeros((G, s_new), bool)], axis=1
     )  # [G, E+S]
     is_new = jnp.arange(E + s_new) >= E
+    rsv_group = jnp.any(inputs.demand_units != inputs.demand, axis=1)  # [G]
     return _Shared(
         units=units,
+        units_rsv=units_rsv,
+        rsv_group=rsv_group,
         lam=lam,
         quota=quota,
         zone_limited=zone_limited,
@@ -184,6 +231,7 @@ def _pack_member(
     order: jax.Array,  # [T] permutation of group indices
     alpha: jax.Array,  # scalar: tiebreak preference
     look: jax.Array,  # scalar bool: lookahead scoring on
+    rsv: jax.Array,  # scalar bool: reserve-sized units (co-pack providers)
     s_new: int,
     n_zones: int,
 ):
@@ -227,18 +275,33 @@ def _pack_member(
     slot_active0 = jnp.concatenate(
         [inputs.ex_valid, jnp.zeros((s_new,), bool)], axis=0
     )
+    slot_bits0 = jnp.concatenate(
+        [inputs.rel_slot_bits, jnp.zeros((s_new,), jnp.int32)], axis=0
+    )
+    zone_bits0 = inputs.rel_zone_bits[:n_zones]
 
     def step(carry, t):
-        slot_rem, slot_opt, slot_zone, slot_active, unplaced, exhausted = carry
+        (slot_rem, slot_opt, slot_zone, slot_active, slot_bits, zone_bits,
+         unplaced, exhausted) = carry
         g = order[t]
         d = inputs.demand[g]
         cnt = inputs.count[g]
         cap = inputs.node_cap[g]
         coloc = inputs.colocate[g]
-        zl = shared.zone_limited[g]
-        q = shared.quota[g]  # [Z]
-        u = shared.units[g]  # [O]
+        u = jnp.where(rsv, shared.units_rsv[g], shared.units[g])  # [O]
         pe = price_t[t]  # [O] effective price for scoring only
+        hf = inputs.rel_host_forbid[g]
+        hn = inputs.rel_host_need[g]
+        zf = inputs.rel_zone_forbid[g]
+        zn = inputs.rel_zone_need[g]
+        # relation-eligible zones (anti: no conflicting bits; need: provider
+        # bits present); all-True when the group carries no relation bits
+        zone_rel_ok = ((zone_bits & zf) == 0) & ((zone_bits & zn) == zn)  # [Z]
+        q = jnp.where(zone_rel_ok, shared.quota[g], 0)  # [Z]
+        # zone-related groups route their wants through the zone buckets even
+        # without a spread quota — the unrestricted bucket can't express
+        # "only zones where the provider landed"
+        zl = shared.zone_limited[g] | (zf != 0) | (zn != 0)
 
         # ---- fill open capacity (existing nodes first, then opened slots) ----
         opt_c = jnp.clip(slot_opt, 0, O - 1)
@@ -247,7 +310,21 @@ def _pack_member(
             inputs.compat[g, opt_c] & (slot_opt >= 0) & slot_active,
             shared.exok_pad[g],
         )
-        fit = jnp.where(comp, jnp.minimum(_units(slot_rem, d), cap), 0)
+        # cross-group relations: slot-level bits (hostname terms) and the
+        # slot's zone bits (zone terms) gate the fill
+        zb_slot = zone_bits[slot_zone]  # [NS]
+        rel_ok = (
+            ((slot_bits & hf) == 0)
+            & ((slot_bits & hn) == hn)
+            & ((zb_slot & zf) == 0)
+            & ((zb_slot & zn) == zn)
+        )
+        comp = comp & rel_ok
+        # reserve members FIT provider pods with their requirer reserve too:
+        # a provider squeezing into another node's leftovers would otherwise
+        # bring an obligation (its requirers) the node cannot host
+        d_fit = jnp.where(rsv & shared.rsv_group[g], inputs.demand_units[g], d)
+        fit = jnp.where(comp, jnp.minimum(_units(slot_rem, d_fit), cap), 0)
         # zone quotas, batched over the zone axis
         zmask = slot_zone[None, :] == zidx[:, None]  # [Z, NS]
         zfit = jnp.where(zmask, fit[None, :], 0)
@@ -270,6 +347,9 @@ def _pack_member(
             jnp.concatenate([want_z, jnp.zeros((1,), jnp.int32)]),
             jnp.concatenate([jnp.zeros((n_zones,), jnp.int32), left[None]]),
         )  # [Zb]
+        # hostname-need groups cannot open fresh nodes (an empty node has no
+        # provider pod); their unfilled remainder strands into the penalty
+        want = jnp.where(hn == 0, want, 0)
 
         # ---- per-bucket option choice: lump vs mixed ----------------------
         safe_u = jnp.maximum(u, 1)
@@ -279,7 +359,15 @@ def _pack_member(
         k_all = -(-wb // safe_u[None, :])  # ceil
         lump_score = jnp.where(okb & (wb > 0), k_all.astype(jnp.float32) * pe[None, :], INF)
         o_lump, cost_lump = _argmin_tiebreak(lump_score, units_f, alpha)
-        rate = jnp.where(okb, pe[None, :] / jnp.maximum(units_f, 1.0)[None, :], INF)
+        # mixed full-segment candidates must fit within the want (u <= want):
+        # a rate-best node LARGER than the want gives n_full = 0, degenerating
+        # mixed to the lump — the genuine two-piece mix (full nodes of a
+        # mid-size type + one small tail node) needs u <= want
+        rate = jnp.where(
+            okb & (u[None, :] <= wb),
+            pe[None, :] / jnp.maximum(units_f, 1.0)[None, :],
+            INF,
+        )
         o_rate, best_rate = _argmin_tiebreak(rate, units_f, alpha)
         c_rate = u[o_rate]  # [Zb]
         n_full = want // jnp.maximum(c_rate, 1)
@@ -334,11 +422,24 @@ def _pack_member(
         unplaced = unplaced + left
         exhausted = exhausted | ((left > 0) & (total_open > jnp.sum(free.astype(jnp.int32))))
         ys = place + fill
-        return (slot_rem, slot_opt, slot_zone, slot_active, unplaced, exhausted), ys
+        # publish this group's presence bits on every domain it landed in —
+        # later groups' relation gates read them
+        sm = inputs.rel_set[g]
+        slot_bits = jnp.where(ys > 0, slot_bits | sm, slot_bits)
+        zmask2 = slot_zone[None, :] == zidx[:, None]  # [Z, NS] (post-open zones)
+        zplaced2 = jnp.sum(jnp.where(zmask2, ys[None, :], 0), axis=1)  # [Z]
+        zone_bits = jnp.where(zplaced2 > 0, zone_bits | sm, zone_bits)
+        return (
+            slot_rem, slot_opt, slot_zone, slot_active, slot_bits, zone_bits,
+            unplaced, exhausted,
+        ), ys
 
-    carry0 = (slot_rem0, slot_opt0, slot_zone0, slot_active0, jnp.int32(0), jnp.bool_(False))
+    carry0 = (
+        slot_rem0, slot_opt0, slot_zone0, slot_active0, slot_bits0, zone_bits0,
+        jnp.int32(0), jnp.bool_(False),
+    )
     carry, ys = lax.scan(step, carry0, jnp.arange(T, dtype=jnp.int32))
-    slot_rem, slot_opt, slot_zone, slot_active, unplaced, exhausted = carry
+    slot_rem, slot_opt, slot_zone, slot_active, _, _, unplaced, exhausted = carry
     new_opt = slot_opt[E:]
     new_active = slot_active[E:] & (new_opt >= 0)
     node_prices = jnp.where(new_active, inputs.price[jnp.clip(new_opt, 0, O - 1)], 0.0)
@@ -352,6 +453,7 @@ def pack_solve_fused(
     orders: jax.Array,
     alphas: jax.Array,
     looks: jax.Array,
+    rsvs: jax.Array,
     swaps: jax.Array,
     s_new: int,
     n_zones: int,
@@ -378,10 +480,10 @@ def pack_solve_fused(
     """
     shared = _shared_precompute(inputs, s_new, n_zones)
 
-    def run(o, a, l):
-        return _pack_member(inputs, shared, o, a, l, s_new, n_zones)
+    def run(o, a, l, rv):
+        return _pack_member(inputs, shared, o, a, l, rv, s_new, n_zones)
 
-    c1, u1, ex1, no1, na1, ys1 = jax.vmap(run)(orders, alphas, looks)
+    c1, u1, ex1, no1, na1, ys1 = jax.vmap(run)(orders, alphas, looks, rsvs)
     b1 = jnp.argmin(c1).astype(jnp.int32)
     seed = orders[b1]  # [T]
     orders2 = seed[swaps]  # [K, T]
@@ -390,7 +492,8 @@ def pack_solve_fused(
     # re-anchors the phase-1 winner
     alphas2 = jnp.full_like(alphas, alphas[b1])
     looks2 = jnp.full_like(looks, looks[b1])
-    c2, u2, ex2, no2, na2, ys2 = jax.vmap(run)(orders2, alphas2, looks2)
+    rsvs2 = jnp.full_like(rsvs, rsvs[b1])
+    c2, u2, ex2, no2, na2, ys2 = jax.vmap(run)(orders2, alphas2, looks2, rsvs2)
 
     costs = jnp.concatenate([c1, c2])
     best = jnp.argmin(costs).astype(jnp.int32)
@@ -441,8 +544,9 @@ def unpack_solve_fused(
 
 
 def make_orders(
-    sizes: np.ndarray, count: np.ndarray, k: int, seed: int = 0
-) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    sizes: np.ndarray, count: np.ndarray, k: int, seed: int = 0,
+    layer: Optional[np.ndarray] = None, has_reserve: bool = False,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
     """Portfolio construction: K × (group ordering, tiebreak exponent,
     lookahead) plus K phase-2 swap patterns.
 
@@ -469,7 +573,13 @@ def make_orders(
             key = -sizes * count  # total-footprint descending
         else:
             key = -sizes * rng.uniform(0.6, 1.4, size=g)
-        orders[i] = np.argsort(key, kind="stable").astype(np.int32)
+        perm = np.argsort(key, kind="stable").astype(np.int32)
+        if layer is not None:
+            # cross-group required affinity: providers (lower layer) must be
+            # scanned before their requirers; stable within a layer, so the
+            # member's size ordering survives
+            perm = perm[np.argsort(layer[perm], kind="stable")]
+        orders[i] = perm
         alphas[i] = base_alphas[i % len(base_alphas)]
         looks[i] = i % 2 == 1
     # Padding groups (count 0) sort to the trailing positions of every order,
@@ -481,4 +591,10 @@ def make_orders(
         for _ in range(1 + int(rng.integers(0, 4))):
             a, b = rng.integers(0, n_real, size=2)
             swaps[i, [a, b]] = swaps[i, [b, a]]
-    return orders, alphas, looks, swaps
+    # reserve-sized members: when hostname-affinity requirers exist, half the
+    # portfolio sizes provider nodes with requirer headroom and half uses raw
+    # sizing — the true-cost argmin picks whichever packs cheaper
+    rsvs = np.zeros((k,), bool)
+    if has_reserve:
+        rsvs[::2] = True
+    return orders, alphas, looks, rsvs, swaps

@@ -98,6 +98,8 @@ def _zone_quotas(problem: EncodedProblem, n_zones: int) -> np.ndarray:
                 avail[:, z] |= problem.ex_compat[:, ex_in_zone].any(axis=1)
     seeds = problem.zone_seed
     occupied = problem.zone_occupied
+    families = problem.zone_spread_members or [[] for _ in range(G)]
+    done_families: set = set()
     for g in range(G):
         if spread[g]:
             s = (
@@ -105,7 +107,36 @@ def _zone_quotas(problem: EncodedProblem, n_zones: int) -> np.ndarray:
                 if seeds is not None
                 else np.zeros(n_zones, np.int64)
             )
-            quota[g] = _water_fill(int(problem.count[g]), s, avail[g])
+            fam = [m for m in families[g] if m != g]
+            if fam:
+                # CROSS-GROUP spread: the constraint counts the whole family's
+                # pods, so water-fill the family TOTAL (seeds already count
+                # every selector-matching bound pod) and split each zone's cap
+                # among members proportionally to their counts — every member,
+                # constraint-less ones included, inherits its share as a cap.
+                # Canonical (sorted) member order, one pass per distinct
+                # family: the split's top-up tiebreak is order-dependent, so
+                # anchor-dependent recomputation would min() incompatible
+                # splits together and strand feasible pods.
+                members = sorted([g] + fam)
+                key = tuple(members)
+                if key not in done_families:
+                    done_families.add(key)
+                    total = int(sum(problem.count[m] for m in members))
+                    avail_joint = np.any(avail[members], axis=0)
+                    joint = _water_fill(total, s, avail_joint)
+                    for m, share in zip(
+                        members,
+                        _split_family_caps(
+                            joint, [int(problem.count[m]) for m in members],
+                            [avail[m] for m in members],
+                        ),
+                    ):
+                        quota[m] = np.minimum(quota[m], share)
+            else:
+                quota[g] = np.minimum(
+                    quota[g], _water_fill(int(problem.count[g]), s, avail[g])
+                )
         if capped[g]:
             occ = (
                 occupied[g, :n_zones].astype(np.int64)
@@ -116,6 +147,38 @@ def _zone_quotas(problem: EncodedProblem, n_zones: int) -> np.ndarray:
                 quota[g], np.maximum(int(problem.zone_cap[g]) - occ, 0)
             )
     return np.clip(quota, 0, _IBIG).astype(np.int32)
+
+
+def _split_family_caps(
+    joint: np.ndarray, counts: List[int], avails: List[np.ndarray]
+) -> List[np.ndarray]:
+    """Split a family's per-zone joint caps among members: floor-proportional
+    to each member's count, then top-ups drawn from a SHARED remaining-cap
+    pool (so member shares can never sum past the joint cap in any zone —
+    that sum bound is what keeps the family skew at the water level). Members
+    with fewer available zones top up first; a member left short strands pods
+    into the validator/penalty path rather than violating the constraint."""
+    total = sum(counts)
+    if total <= 0:
+        return [np.zeros_like(joint) for _ in counts]
+    shares = [
+        np.where(av, (joint * c) // total, 0) for c, av in zip(counts, avails)
+    ]
+    rem = joint - np.sum(shares, axis=0)
+    order = sorted(range(len(counts)), key=lambda i: int(avails[i].sum()))
+    for i in order:
+        want = counts[i] - int(shares[i].sum())
+        if want <= 0:
+            continue
+        head = np.where(avails[i], rem, 0)
+        for z in np.argsort(-head, kind="stable"):
+            if want <= 0:
+                break
+            take = min(int(head[z]), want)
+            shares[i][z] += take
+            rem[z] -= take
+            want -= take
+    return shares
 
 
 # Cheap per-axis bound for the hot path; the tight LP bound lives in bounds.py.
@@ -238,28 +301,13 @@ class GreedySolver(Solver):
         return result
 
 
-def _has_cross_group_constraints(problem: EncodedProblem) -> bool:
-    """True when a spread/affinity selector reaches across pod groups — the tensor
-    path models those constraints per-group, so such problems use the oracle."""
-    groups = problem.groups
-    for gi, g in enumerate(groups):
-        rep = g.pods[0]
-        selectors = [c.label_selector for c in rep.topology_spread] + [
-            t.label_selector for t in rep.affinity_terms
-        ]
-        for sel in selectors:
-            if not sel:
-                continue
-            for gj, other in enumerate(groups):
-                if gi == gj:
-                    continue
-                if all(other.pods[0].meta.labels.get(k) == v for k, v in sel.items()):
-                    return True
-        # cross-group required affinity on another group's labels
-        for t in rep.affinity_terms:
-            if not t.anti and not t.selects(rep):
-                return True
-    return False
+def _tensor_path_unsupported(problem: EncodedProblem) -> Optional[str]:
+    """Constraint shapes the tensor path cannot express (round-4: cross-group
+    (anti-)affinity and cross-group spread are now first-class — relation
+    bitmasks and joint quota families; see encode._build_relations). What
+    remains oracle-only: relation-bit exhaustion, non-hostname/zone topology
+    keys, and cyclic required-affinity families."""
+    return problem.rel_unsupported
 
 
 class TPUSolver(Solver):
@@ -366,7 +414,7 @@ class TPUSolver(Solver):
                 unschedulable=[p.name for g in problem.groups for p in g.pods],
                 stats={"backend": 1.0},
             )
-        if _has_cross_group_constraints(problem):
+        if _tensor_path_unsupported(problem) is not None:
             result = self._fallback.solve(problem)
             result.stats["fallback"] = 1.0
             return result
@@ -456,10 +504,10 @@ class TPUSolver(Solver):
                 return None
             self._race_retry_at = now + self._race_retry_interval_s
         try:
-            (inputs, orders, swaps, orders_d, alphas_d, looks_d, swaps_d,
-             s_new, n_zones) = self._device_inputs(problem)
+            (inputs, orders, swaps, orders_d, alphas_d, looks_d, rsvs_d,
+             swaps_d, s_new, n_zones) = self._device_inputs(problem)
             buf = pack_solve_fused(
-                inputs, orders_d, alphas_d, looks_d, swaps_d, s_new, n_zones
+                inputs, orders_d, alphas_d, looks_d, rsvs_d, swaps_d, s_new, n_zones
             )
             return (buf, orders, swaps, s_new, n_zones, inputs)
         except Exception:
@@ -508,7 +556,7 @@ class TPUSolver(Solver):
 
     def _solve_kernel(self, problem: EncodedProblem) -> Optional[SolveResult]:
         t0 = time.perf_counter()
-        (inputs, orders, swaps, orders_d, alphas_d, looks_d, swaps_d,
+        (inputs, orders, swaps, orders_d, alphas_d, looks_d, rsvs_d, swaps_d,
          s_new, n_zones) = self._device_inputs(problem)
         k = orders.shape[0]
         Gp = inputs.count.shape[0]
@@ -519,7 +567,7 @@ class TPUSolver(Solver):
             # the winner's assignments packed into one int32 buffer.
             buf = np.asarray(
                 pack_solve_fused(
-                    inputs, orders_d, alphas_d, looks_d, swaps_d, s_new, n_zones
+                    inputs, orders_d, alphas_d, looks_d, rsvs_d, swaps_d, s_new, n_zones
                 )
             )
             order, unplaced, costs, exhausted, new_opt, new_active, ys = unpack_solve_fused(
@@ -532,7 +580,7 @@ class TPUSolver(Solver):
                 with self._cache_lock:
                     self._device_cache[id(problem)] = (
                         problem, inputs, orders, swaps, orders_d, alphas_d,
-                        looks_d, swaps_d, s_new, n_zones,
+                        looks_d, rsvs_d, swaps_d, s_new, n_zones,
                     )
                 continue
             break
@@ -569,28 +617,29 @@ class TPUSolver(Solver):
             cached = self._device_cache.get(key)
             if cached is not None and cached[0] is problem:
                 return cached[1:]
-        inputs, orders, alphas, looks, swaps, s_new, n_zones = self._prepare(problem)
+        inputs, orders, alphas, looks, rsvs, swaps, s_new, n_zones = self._prepare(problem)
         mesh = self._ensure_mesh()
         if mesh is not None:
             from ..parallel import shard_portfolio
 
-            inputs_d, orders_d, alphas_d, looks_d, swaps_d = shard_portfolio(
+            inputs_d, orders_d, alphas_d, looks_d, rsvs_d, swaps_d = shard_portfolio(
                 mesh,
                 jax.tree.map(jnp.asarray, inputs),
                 jnp.asarray(orders),
                 jnp.asarray(alphas),
                 jnp.asarray(looks),
+                jnp.asarray(rsvs),
                 jnp.asarray(swaps),
             )
         else:
             inputs_d = jax.tree.map(jnp.asarray, inputs)
-            orders_d, alphas_d, looks_d, swaps_d = (
+            orders_d, alphas_d, looks_d, rsvs_d, swaps_d = (
                 jnp.asarray(orders), jnp.asarray(alphas),
-                jnp.asarray(looks), jnp.asarray(swaps),
+                jnp.asarray(looks), jnp.asarray(rsvs), jnp.asarray(swaps),
             )
         entry = (
             problem, inputs_d, orders, swaps, orders_d, alphas_d, looks_d,
-            swaps_d, s_new, n_zones,
+            rsvs_d, swaps_d, s_new, n_zones,
         )
         with self._cache_lock:
             self._device_cache.clear()  # hold at most one problem resident
@@ -640,8 +689,39 @@ class TPUSolver(Solver):
         price[:O] = problem.price
         opt_zone[:O] = problem.opt_zone
         opt_valid[:O] = True
+        # cross-group relation bits (zeros when inactive — the masks are
+        # no-ops in the kernel and compile to the same program structure)
+        rel_set = np.zeros((Gp,), np.int32)
+        rel_host_forbid = np.zeros((Gp,), np.int32)
+        rel_host_need = np.zeros((Gp,), np.int32)
+        rel_zone_forbid = np.zeros((Gp,), np.int32)
+        rel_zone_need = np.zeros((Gp,), np.int32)
+        rel_slot_bits = np.zeros((Ep,), np.int32)
+        rel_zone_bits = np.zeros((n_zones,), np.int32)
+        if problem.rel_set is not None and G:
+            rel_set[:G] = problem.rel_set
+            rel_host_forbid[:G] = problem.rel_host_forbid
+            rel_host_need[:G] = problem.rel_host_need
+            rel_zone_forbid[:G] = problem.rel_zone_forbid
+            rel_zone_need[:G] = problem.rel_zone_need
+            if E:
+                rel_slot_bits[:E] = problem.rel_slot_bits
+            nz = min(n_zones, len(problem.rel_zone_bits))
+            rel_zone_bits[:nz] = problem.rel_zone_bits[:nz]
+        # provider node-sizing reserve: a hostname-affinity requirer can only
+        # live on its providers' nodes, so the providers' SIZING demand
+        # carries the requirers' total demand spread over provider pods
+        # (the reference co-packs pending pods into the hypothetical node)
+        from .encode import sizing_demand
+
+        demand_units = demand
+        sd = sizing_demand(problem)
+        if sd is not problem.demand:
+            demand_units = np.zeros((Gp, R), np.float32)
+            demand_units[:G] = sd / scale
         inputs = PackInputs(
             demand=demand,
+            demand_units=demand_units,
             count=count,
             node_cap=node_cap,
             quota=quota,
@@ -655,6 +735,13 @@ class TPUSolver(Solver):
             ex_zone=ex_zone,
             ex_compat=ex_compat,
             ex_valid=ex_valid,
+            rel_set=rel_set,
+            rel_host_forbid=rel_host_forbid,
+            rel_host_need=rel_host_need,
+            rel_zone_forbid=rel_zone_forbid,
+            rel_zone_need=rel_zone_need,
+            rel_slot_bits=rel_slot_bits,
+            rel_zone_bits=rel_zone_bits,
         )
 
         sizes = np.zeros((Gp,), np.float64)
@@ -664,12 +751,17 @@ class TPUSolver(Solver):
         from ..parallel import round_up_portfolio
 
         k = round_up_portfolio(self.portfolio, self._ensure_mesh())
-        orders, alphas, looks, swaps = make_orders(
-            sizes, count.astype(np.float64), k, self.seed
+        layer = None
+        if problem.rel_layer is not None and problem.rel_layer.any():
+            layer = np.full((Gp,), np.iinfo(np.int32).max, np.int64)
+            layer[:G] = problem.rel_layer  # padding groups sort last
+        orders, alphas, looks, rsvs, swaps = make_orders(
+            sizes, count.astype(np.float64), k, self.seed, layer=layer,
+            has_reserve=demand_units is not demand,
         )
 
         s_new = self._estimate_slots(problem)
-        return inputs, orders, alphas, looks, swaps, s_new, n_zones
+        return inputs, orders, alphas, looks, rsvs, swaps, s_new, n_zones
 
     def _estimate_slots(self, problem: EncodedProblem) -> int:
         if problem.O == 0:

@@ -127,8 +127,8 @@ def check_topology(problem: EncodedProblem, agg: Dict[tuple, int]) -> List[str]:
     # lifetime, not on every kernel solve (validate_counts is hot-path).
     memo = problem.__dict__.setdefault("_seed_count_memo", {})
 
-    def seed_counts(owner, selects, key_is_host: bool) -> Dict[str, int]:
-        key = (id(owner), key_is_host)
+    def seed_counts(owner, selects, key_is_host: bool, tag: str = "") -> Dict[str, int]:
+        key = (id(owner), key_is_host, tag)
         cached = memo.get(key)
         if cached is not None:
             return cached
@@ -142,7 +142,24 @@ def check_topology(problem: EncodedProblem, agg: Dict[tuple, int]) -> List[str]:
     for gi, g in enumerate(problem.groups):
         rep = reps[gi]
         for c in rep.effective_spread():
-            selected_groups = [gj for gj, r in enumerate(reps) if c.selects(r)]
+            # the skew counts selector-matching pods of groups that THEMSELVES
+            # carry an equivalent constraint (plus bound pods): a non-carrying
+            # matching service is only admission-checked at ITS OWN placements
+            # (k8s enforces spread at the carrying pod's admission), so its
+            # in-batch pods cannot retroactively violate this group's skew
+            selected_groups = [
+                gj
+                for gj, r in enumerate(reps)
+                if c.selects(r)
+                and (
+                    gj == gi
+                    or any(
+                        c2.topology_key == c.topology_key
+                        and dict(c2.label_selector) == dict(c.label_selector)
+                        for c2 in r.effective_spread()
+                    )
+                )
+            ]
             new_counts: Dict[str, int] = defaultdict(int)
             for (gj, host, zone), n in agg.items():
                 if gj in selected_groups:
@@ -152,21 +169,28 @@ def check_topology(problem: EncodedProblem, agg: Dict[tuple, int]) -> List[str]:
             if seed_pods:
                 for key, n in seed_counts(c, c.selects, c.topology_key == wk.HOSTNAME).items():
                     counts[key] += n
-            if new_counts:
-                # Only domains RECEIVING new pods can violate: pre-existing
-                # seed skew (pods placed before a zone existed, drained hosts)
-                # is not fixable by a scale-up batch — the per-pod admission
-                # rule the reference scheduler applies compares the receiving
-                # domain's new total against the global min.
+            # Only domains receiving new pods OF THE CONSTRAINT CARRIER can
+            # violate: k8s enforces a spread at the carrying pod's admission
+            # only — a non-carrying matching service legally piling into some
+            # other domain afterwards is not this group's violation. Counts
+            # still include every selector-matching pod (the cross-group
+            # semantics); pre-existing seed skew is likewise not fixable by a
+            # scale-up batch.
+            own_domains = {
+                (host if c.topology_key == wk.HOSTNAME else zone)
+                for (gj, host, zone), n in agg.items()
+                if gj == gi and n > 0
+            }
+            if own_domains:
                 if c.topology_key == wk.HOSTNAME:
-                    worst = max(counts[k] for k in new_counts)
+                    worst = max(counts[k] for k in own_domains)
                     if worst > c.max_skew:
                         violations.append(
                             f"group {gi} hostname spread skew {worst} > {c.max_skew}"
                         )
                 if c.topology_key == wk.ZONE:
                     floor_ = min([counts.get(z, 0) for z in problem.zones] or [0])
-                    worst = max(counts[k] for k in new_counts)
+                    worst = max(counts[k] for k in own_domains)
                     if worst - floor_ > c.max_skew:
                         violations.append(
                             f"group {gi} zone spread skew {worst - floor_} > {c.max_skew}"
@@ -178,7 +202,47 @@ def check_topology(problem: EncodedProblem, agg: Dict[tuple, int]) -> List[str]:
                 if gj == gi and n > 0
             }
             key_is_host = term.topology_key == wk.HOSTNAME
+            cross_groups = [
+                gj for gj, r in enumerate(reps) if gj != gi and term.selects(r)
+            ]
+            # domains holding pods the selector matches, excluding gi's own
+            # (the self-match cases have their own checks below)
+            cross_domains: Dict[str, int] = defaultdict(int)
+            for (gj, host, zone), n in agg.items():
+                if gj in cross_groups:
+                    cross_domains[host if key_is_host else zone] += n
+            if seed_pods:
+                for key, n in seed_counts(term, term.selects, key_is_host).items():
+                    cross_domains[key] += n
             if term.anti:
+                # cross-group / seeded anti-affinity is symmetric: no domain
+                # may hold both gi's pods and selector-matching pods
+                bad = my_domains & {k for k, n in cross_domains.items() if n > 0}
+                if bad:
+                    violations.append(
+                        f"group {gi} anti-affinity shares {sorted(bad)[:3]} with matching pods"
+                    )
+                if seed_pods and cross_groups:
+                    # ...including domains where a BOUND pod carries this term
+                    # (k8s admission symmetry): matching groups may not join
+                    from .encode import equivalent_affinity_term
+
+                    owner_seeded = seed_counts(
+                        term,
+                        lambda p: equivalent_affinity_term(term, p),
+                        key_is_host,
+                        tag="owner",
+                    )
+                    cross_new = {
+                        (host if key_is_host else zone)
+                        for (gj, host, zone), n in agg.items()
+                        if gj in cross_groups and n > 0
+                    }
+                    bad2 = cross_new & {k for k, n in owner_seeded.items() if n > 0}
+                    if bad2:
+                        violations.append(
+                            f"matching pods joined anti-affinity domains {sorted(bad2)[:3]} of group {gi}"
+                        )
                 if term.selects(rep):
                     domain_counts: Dict[str, int] = defaultdict(int)
                     for (gj, host, zone), n in agg.items():
@@ -201,6 +265,18 @@ def check_topology(problem: EncodedProblem, agg: Dict[tuple, int]) -> List[str]:
                     if seeded and not my_domains <= seeded:
                         violations.append(
                             f"group {gi} required self-affinity outside the existing domain"
+                        )
+            else:
+                # cross-group REQUIRED affinity: every domain receiving gi's
+                # pods must hold a selector-matching pod. Vacuous when nothing
+                # matches anywhere (the k8s bootstrap rule).
+                if any(n > 0 for n in cross_domains.values()):
+                    bare = my_domains - {
+                        k for k, n in cross_domains.items() if n > 0
+                    }
+                    if bare:
+                        violations.append(
+                            f"group {gi} required affinity unmet in {sorted(bare)[:3]}"
                         )
     return violations
 
