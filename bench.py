@@ -447,7 +447,7 @@ def bench_config(name, make, repeats=REPEATS):
     # tight LP-relaxation bound (bench-side instrumentation, not the hot path)
     lb = float(best_lower_bound(problem))
     eff = (lb / result.cost) if result.cost > 0 else 1.0
-    backend = {0.0: "greedy", 1.0: "kernel", 2.0: "host-lp"}.get(
+    backend = {0.0: "greedy", 1.0: "kernel", 2.0: "host-lp", 3.0: "host-ffd"}.get(
         result.stats.get("backend"), "?"
     )
     return {

@@ -336,7 +336,7 @@ class TPUSolver(Solver):
         portfolio: int = 8,
         seed: int = 0,
         max_slots: int = 1 << 15,
-        latency_budget_s: float = 0.08,
+        latency_budget_s: float = 0.1,
         mesh=None,
         auto_mesh: bool = True,
     ):
@@ -356,6 +356,7 @@ class TPUSolver(Solver):
         # Guarded by _cache_lock: the background warm thread and the main solve
         # path both touch it (advisor round-2 finding).
         self._device_cache: dict = {}
+        self._host_cache: dict = {}  # numpy inputs for the host FFD competitor
         self._cache_lock = threading.Lock()
         self._warmed_problems: dict = {}
         self._race_fails = 0
@@ -419,14 +420,14 @@ class TPUSolver(Solver):
             result.stats["fallback"] = 1.0
             return result
 
-        from .host import lp_safe, solve_host
+        from .host import solve_host
 
         quality = self.latency_budget_s > 1.0
         dispatched = None
-        if lp_safe(problem) and not quality and self.device_rtt() < self.latency_budget_s:
+        if not quality and self.device_rtt() < self.latency_budget_s:
             # Fire the kernel at the device BEFORE the host path runs: the
             # dispatch is non-blocking, so the TPU computes concurrently with
-            # the host LP and the poll below only pays the leftover wait.
+            # the host path and the poll below only pays the leftover wait.
             # Skipped when the measured device round-trip alone exceeds the
             # latency budget (a tunneled chip at ~120ms RTT can never answer a
             # sub-100ms race; the host path owns that link).
@@ -436,7 +437,19 @@ class TPUSolver(Solver):
             host_result = solve_host(problem)
         except Exception:
             host_result = None  # any host-path failure falls through to kernel
+        if host_result is None and not quality:
+            # topology shapes (non-LP-safe): the numpy grouped-FFD member is
+            # the host competitor — the tunneled device's RTT must never be
+            # the latency floor (round-4 verdict item 2)
+            try:
+                host_result = self._solve_host_pack(problem)
+            except Exception:
+                host_result = None
         if host_result is not None:
+            # comparisons carry the kernel's own unplaced penalty so a host
+            # member that STRANDS pods can never beat a complete kernel answer
+            # on raw node cost (round-4 review finding)
+            host_cmp = host_result.cost + 1e6 * len(host_result.unschedulable)
             if quality:
                 # quality mode (generous budget): synchronous race, compile and
                 # all — consolidation sweeps and tests that want the best answer
@@ -446,12 +459,11 @@ class TPUSolver(Solver):
                     problem,
                     dispatched,
                     deadline=t0 + self.latency_budget_s,
-                    host_cost=host_result.cost,
+                    host_cost=host_cmp,
                 )
-            if (
-                kernel_result is not None
-                and kernel_result.cost < host_result.cost
-                and len(kernel_result.unschedulable) <= len(host_result.unschedulable)
+            if kernel_result is not None and (
+                kernel_result.cost + 1e6 * len(kernel_result.unschedulable)
+                < host_cmp
             ):
                 kernel_result.stats["race_winner"] = 1.0
                 kernel_result.stats["total_solve_s"] = time.perf_counter() - t0
@@ -462,6 +474,73 @@ class TPUSolver(Solver):
         if result is None:
             result = self._fallback.solve(problem)
             result.stats["fallback"] = 1.0
+        return result
+
+    def _solve_host_pack(self, problem: EncodedProblem) -> Optional[SolveResult]:
+        """A small portfolio of numpy FFD members (FFD / footprint orderings
+        × lookahead) over the kernel's own prepared arrays — the
+        topology-capable host competitor. Count-validated and decoded exactly
+        like kernel output; None when invalid."""
+        from .host_pack import host_pack, host_shared
+
+        t0 = time.perf_counter()
+        key = id(problem)
+        with self._cache_lock:
+            cached = self._host_cache.get(key)
+        if cached is None or cached[0] is not problem:
+            # fill via _prepare DIRECTLY — no jax involvement: this all-numpy
+            # path must work (and stay fast) when the device is slow or dead
+            (inputs, orders, alphas, looks, _rsvs, _swaps, s_new, n_zones) = (
+                self._prepare(problem)
+            )
+            cached = (problem, inputs, orders, alphas, looks, s_new, n_zones, [None])
+            with self._cache_lock:
+                self._host_cache.clear()
+                self._host_cache[key] = cached
+        _, inputs, orders, alphas, looks, s_new, n_zones, shared_slot = cached
+        if shared_slot[0] is None:
+            shared_slot[0] = host_shared(inputs)
+        shared = shared_slot[0]
+        best = None
+        best_order = None
+        k = orders.shape[0]
+        grown = s_new
+        for mi in range(min(4, k)):
+            order = orders[mi]
+            sn = grown
+            out = None
+            while out is None and sn <= self.max_slots:
+                out = host_pack(
+                    inputs, shared, order, sn, n_zones,
+                    alpha=float(alphas[mi]), look=bool(looks[mi]),
+                )
+                if out is None:
+                    sn *= 2
+            grown = max(grown, min(sn, self.max_slots))
+            if out is None:
+                continue
+            new_opt, new_active, ys, unplaced = out
+            cost = float(
+                np.sum(np.asarray(inputs.price)[new_opt[new_active]])
+            ) + unplaced * 1e6
+            if best is None or cost < best[0]:
+                best = (cost, new_opt, new_active, ys, unplaced)
+                best_order = order
+        if grown > s_new:
+            # persist the grown slot budget: repeat solves of a cached
+            # problem must not re-pay the doubling ladder
+            entry = (problem, inputs, orders, alphas, looks, grown, n_zones, shared_slot)
+            with self._cache_lock:
+                if self._host_cache.get(key) is cached or key not in self._host_cache:
+                    self._host_cache[key] = entry
+        if best is None:
+            return None
+        _, new_opt, new_active, ys, unplaced = best
+        if validate_counts(problem, best_order, new_opt, new_active, ys):
+            return None
+        result = self._decode(problem, best_order, new_opt, new_active, ys)
+        result.stats["backend"] = 3.0  # host-ffd
+        result.stats["solve_s"] = time.perf_counter() - t0
         return result
 
     # -- async race ----------------------------------------------------------
@@ -618,6 +697,13 @@ class TPUSolver(Solver):
             if cached is not None and cached[0] is problem:
                 return cached[1:]
         inputs, orders, alphas, looks, rsvs, swaps, s_new, n_zones = self._prepare(problem)
+        with self._cache_lock:
+            # numpy copies for the host FFD race competitor (host_pack.py);
+            # the shared precompute slot starts empty and fills on first use
+            self._host_cache.clear()
+            self._host_cache[key] = (
+                problem, inputs, orders, alphas, looks, s_new, n_zones, [None],
+            )
         mesh = self._ensure_mesh()
         if mesh is not None:
             from ..parallel import shard_portfolio

@@ -200,9 +200,10 @@ class TestTPUSolver:
         result = TPUSolver().solve(problem)
         assert_feasible_and_complete(problem, result, 10)
         assert result.unschedulable == []
-        # must be solved on the TPU path, not silently fall back to greedy
+        # must be solved on a constraint-aware fast path (kernel or its host
+        # FFD race competitor), not silently fall back to the greedy oracle
         assert result.stats.get("fallback") is None
-        assert result.stats["backend"] == 1.0
+        assert result.stats["backend"] in (1.0, 3.0)
 
     def test_unschedulable_fast_no_slot_doubling(self, provs):
         # regression: pods unplaceable by *compatibility* must not trigger the
@@ -361,8 +362,10 @@ class TestMeshSharding:
             for i in range(40)
         ]
         problem = encode(pods, setup())
-        multi = TPUSolver(portfolio=8).solve(problem)  # auto-mesh over all devices
-        single = TPUSolver(portfolio=8, auto_mesh=False).solve(problem)
+        # quality mode pins both solves to the synchronous kernel (the race
+        # could otherwise return the host FFD competitor on either side)
+        multi = TPUSolver(portfolio=8, latency_budget_s=10.0).solve(problem)
+        single = TPUSolver(portfolio=8, auto_mesh=False, latency_budget_s=10.0).solve(problem)
         assert multi.stats.get("backend") == 1.0
         assert single.stats.get("backend") == 1.0
         assert multi.cost == pytest.approx(single.cost, rel=1e-5)
