@@ -161,9 +161,16 @@ def config_20k_repack():
     rng = np.random.default_rng(7)
     existing = []
     mids = [it for it in cat if 8 <= it.capacity["cpu"] <= 32]
-    for i in range(2000):
+    # 1500 in-flight nodes, but a retiring slice (cordoned — the traffic a
+    # consolidation/interruption wave produces) plus 50-90% utilization leave
+    # the fleet SHORT of the 20k-pod batch: existing capacity absorbs ~2/5 of
+    # the demand and the rest must open new cheaper nodes, so the LP bound is
+    # nonzero and efficiency is meaningful (round-4 verdict item 5; BASELINE
+    # config 4 "repack to minimize cost")
+    for i in range(1500):
         it = mids[int(rng.integers(0, len(mids)))]
         zone = ["zone-a", "zone-b", "zone-c"][i % 3]
+        retiring = i % 5 == 0  # every 5th node is draining
         node = Node(
             meta=ObjectMeta(
                 name=f"node-{i}",
@@ -173,9 +180,10 @@ def config_20k_repack():
             capacity=it.capacity,
             allocatable=it.allocatable(),
             ready=True,
+            unschedulable=retiring,
         )
-        # nodes arrive partially utilized
-        util = float(rng.uniform(0.2, 0.7))
+        # nodes arrive well-utilized
+        util = float(rng.uniform(0.5, 0.9))
         remaining = it.allocatable() * (1.0 - util)
         existing.append(ExistingNode(node=node, remaining=remaining))
     pods = _pods([

@@ -212,3 +212,56 @@ class TestDifferentialFuzz:
         assert res.cost <= greedy.cost * 1.001, (res.cost, greedy.cost)
         lb = best_lower_bound(p)
         assert res.cost >= lb - 1e-6
+
+
+class TestShapeMatchedRefill:
+    def test_ratio_matching_tiles_complementary_fragments(self):
+        """Two fragments — one cpu-rich, one mem-rich — and two pod shapes
+        that each fit only their matching fragment IN FULL. Shape-matched
+        best-fit refills everything; naive front-to-back order would burn the
+        wrong fragment on the wrong shape and strand pods."""
+        from karpenter_tpu.api import Provisioner
+
+        cat = generate_catalog(n_types=40)
+        cpu_rich = max(cat, key=lambda t: t.capacity["cpu"] / t.capacity["memory"])
+        mem_rich = max(cat, key=lambda t: t.capacity["memory"] / t.capacity["cpu"])
+        existing = [
+            _existing_node("cpuish", cpu_rich, util=0.3),
+            _existing_node("memish", mem_rich, util=0.3),
+        ]
+        prov = Provisioner(meta=ObjectMeta(name="d"))
+        # cpu-heavy pods sized to ~fill the cpu-rich fragment; mem-heavy ones
+        # to ~fill the mem-rich fragment
+        cpu_free = cpu_rich.allocatable().get("cpu") * 0.7
+        mem_free = mem_rich.allocatable().get("memory") * 0.7
+        n_cpu = int(cpu_free // 1)
+        n_mem = int(mem_free // (8 * 1024**3))
+        pods = _pods([("c", n_cpu, "1", "512Mi"), ("m", n_mem, "250m", "8Gi")])
+        p = encode(pods, [(prov, cat)], existing)
+        rem = p.count.astype(np.int64).copy()
+        placements, rem2, _ = H.refill_existing(
+            p, rem, p.ex_rem.astype(np.float64).copy()
+        )
+        # the shape-matched refill must absorb nearly everything
+        assert rem2.sum() <= max(1, (n_cpu + n_mem) // 10)
+
+
+class TestPlanCompaction:
+    def test_evacuate_deletes_node_fitting_in_fragments(self):
+        """A new node whose load fits into existing fragments is deleted by
+        the compaction pass (strictly cheaper plan)."""
+        cat = generate_catalog(n_types=20)
+        big = max(cat, key=lambda t: t.capacity["cpu"])
+        existing = [_existing_node("roomy", big, util=0.0)]
+        prov = Provisioner(meta=ObjectMeta(name="d"))
+        pods = _pods([("a", 4, "500m", "1Gi")])
+        p = encode(pods, [(prov, cat)], existing)
+        # hand-build a silly plan: everything on a new node, fragments unused
+        units, _ = H._units_rate(p)
+        j = int(np.argmax(units[0]))
+        opens = [H.Opened(option=j, nodes=1, ys=np.array([[4]], np.int64).T.reshape(1, 1))]
+        placements = np.zeros((1, 1), np.int64)
+        ex_rem = p.ex_rem.astype(np.float64).copy()
+        placements2, opens2 = H.evacuate_into_existing(p, placements, opens, ex_rem)
+        assert opens2 == []  # node deleted
+        assert placements2.sum() == 4  # pods moved to the fragment
