@@ -166,6 +166,9 @@ def make_instance_type(
     )
 
 
+_catalog_cache: Dict[tuple, List[InstanceType]] = {}
+
+
 def generate_catalog(
     n_types: Optional[int] = None,
     zones: Sequence[str] = DEFAULT_ZONES,
@@ -173,7 +176,20 @@ def generate_catalog(
     include_accelerators: bool = True,
 ) -> List[InstanceType]:
     """Deterministic catalog; ``n_types`` samples evenly across the size spectrum
-    so a truncated catalog still spans small through large types."""
+    so a truncated catalog still spans small through large types.
+
+    The output is memoized per parameter set (default kubelet only): this is
+    static data, and serving the SAME InstanceType objects across calls is
+    what a production types provider does (the reference's seqnum-keyed cache,
+    ``pkg/providers/instancetype/instancetype.go:95-107``) — it lets the
+    encoder's identity-validated caches short-circuit. Callers get a fresh
+    list (shallow copy) so list-level mutation can't leak between them."""
+    cache_key = None
+    if kubelet is None:
+        cache_key = (n_types, tuple(zones), include_accelerators)
+        hit = _catalog_cache.get(cache_key)
+        if hit is not None:
+            return list(hit)
     out: List[InstanceType] = []
     for gen in _GENERATIONS:
         gen_discount = 1.0 - 0.04 * (int(gen) - 5)  # newer generations slightly cheaper
@@ -226,6 +242,9 @@ def generate_catalog(
             # step > 1 under the n_types < len(out) guard, so indices are distinct
             step = (len(ranked) - 1) / (n_types - 1)
             out = [ranked[round(i * step)] for i in range(n_types)]
+    if cache_key is not None:
+        _catalog_cache[cache_key] = out
+        return list(out)
     return out
 
 

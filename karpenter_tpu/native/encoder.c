@@ -57,11 +57,31 @@ items_tuple(PyObject *d)
     return out;
 }
 
-/* True when attr is a non-empty sequence (list). -1 on error. */
-static int
-nonempty_list_attr(PyObject *obj, PyObject *name)
+/* Field read that prefers the instance dict we already hold: Pod is a plain
+ * dataclass, so every field is an instance-dict entry and the full attribute
+ * protocol (type MRO scan for a data descriptor, then the dict) is pure
+ * overhead x11 reads x50k pods. Falls back to GetAttr for exotic subclasses
+ * that turn a field into a property. Returns a NEW reference. */
+static PyObject *
+field_get(PyObject *obj, PyObject *idict, PyObject *name)
 {
-    PyObject *a = PyObject_GetAttr(obj, name);
+    if (idict != NULL) {
+        PyObject *v = PyDict_GetItemWithError(idict, name);
+        if (v != NULL) {
+            Py_INCREF(v);
+            return v;
+        }
+        if (PyErr_Occurred())
+            return NULL;
+    }
+    return PyObject_GetAttr(obj, name);
+}
+
+/* True when the field is a non-empty sequence (list). -1 on error. */
+static int
+nonempty_list_attr(PyObject *obj, PyObject *idict, PyObject *name)
+{
+    PyObject *a = field_get(obj, idict, name);
     Py_ssize_t n;
     if (a == NULL)
         return -1;
@@ -73,13 +93,15 @@ nonempty_list_attr(PyObject *obj, PyObject *name)
 }
 
 static PyObject *
-signature_for(PyObject *pod, PyObject *py_signature)
+signature_for(PyObject *pod, PyObject *py_signature, int *simple_out)
 {
     PyObject *dict, *sig, *meta = NULL, *labels = NULL, *requests = NULL,
              *r_map = NULL, *nodesel = NULL, *req_items = NULL,
              *sel_items = NULL, *lab_items = NULL, *empty;
     int complex_shape;
 
+    if (simple_out)
+        *simple_out = 0;
     /* cached? (written by either implementation) */
     dict = PyObject_GenericGetDict(pod, NULL);
     if (dict == NULL)
@@ -95,17 +117,17 @@ signature_for(PyObject *pod, PyObject *py_signature)
         return NULL;
     }
 
-    complex_shape = nonempty_list_attr(pod, s_required_affinity_terms);
+    complex_shape = nonempty_list_attr(pod, dict, s_required_affinity_terms);
     if (complex_shape == 0)
-        complex_shape = nonempty_list_attr(pod, s_tolerations);
+        complex_shape = nonempty_list_attr(pod, dict, s_tolerations);
     if (complex_shape == 0)
-        complex_shape = nonempty_list_attr(pod, s_topology_spread);
+        complex_shape = nonempty_list_attr(pod, dict, s_topology_spread);
     if (complex_shape == 0)
-        complex_shape = nonempty_list_attr(pod, s_affinity_terms);
+        complex_shape = nonempty_list_attr(pod, dict, s_affinity_terms);
     if (complex_shape == 0)
-        complex_shape = nonempty_list_attr(pod, s_preferred_affinity_terms);
+        complex_shape = nonempty_list_attr(pod, dict, s_preferred_affinity_terms);
     if (complex_shape == 0)
-        complex_shape = nonempty_list_attr(pod, s_volume_zones);
+        complex_shape = nonempty_list_attr(pod, dict, s_volume_zones);
     if (complex_shape < 0) {
         Py_DECREF(dict);
         return NULL;
@@ -116,16 +138,17 @@ signature_for(PyObject *pod, PyObject *py_signature)
         return PyObject_CallFunctionObjArgs(py_signature, pod, NULL);
     }
 
-    requests = PyObject_GetAttr(pod, s_requests);
+    requests = field_get(pod, dict, s_requests);
     if (requests == NULL)
         goto fail;
+    /* Resources uses __slots__ — _r is a member descriptor, not a dict entry */
     r_map = PyObject_GetAttr(requests, s_r);
     if (r_map == NULL)
         goto fail;
-    nodesel = PyObject_GetAttr(pod, s_node_selector);
+    nodesel = field_get(pod, dict, s_node_selector);
     if (nodesel == NULL)
         goto fail;
-    meta = PyObject_GetAttr(pod, s_meta);
+    meta = field_get(pod, dict, s_meta);
     if (meta == NULL)
         goto fail;
     labels = PyObject_GetAttr(meta, s_labels);
@@ -149,6 +172,8 @@ signature_for(PyObject *pod, PyObject *py_signature)
     if (sig == NULL)
         goto fail;
 
+    if (simple_out)
+        *simple_out = 1;
     if (PyDict_SetItem(dict, sig_key, sig) < 0) {
         Py_DECREF(sig);
         goto fail;
@@ -177,12 +202,107 @@ fail:
     return NULL;
 }
 
+/* Adjacency fast path: pods of one controller arrive in runs of identical
+ * spec. When the current pod's scheduling-relevant fields VALUE-equal the
+ * previous (simple-shape) pod's, it belongs to the same group — append and
+ * move on: no signature tuple, no instance-dict materialization, no bucket
+ * hash. Value equality can only MERGE what the insertion-ordered signature
+ * would split into equivalent groups (see encode._items_t), never mix
+ * distinct scheduling identities.
+ *
+ * prev_* are borrowed caches of the run leader's field objects. Returns 1 on
+ * match, 0 on mismatch (including complex shape), -1 on error. */
+static int
+matches_prev(PyObject *pod, PyObject *prev_r, PyObject *prev_sel,
+             PyObject *prev_labels)
+{
+    PyObject *requests, *r_map, *nodesel, *meta, *labels;
+    int eq, complex_shape;
+
+    complex_shape = nonempty_list_attr(pod, NULL, s_required_affinity_terms);
+    if (complex_shape == 0)
+        complex_shape = nonempty_list_attr(pod, NULL, s_tolerations);
+    if (complex_shape == 0)
+        complex_shape = nonempty_list_attr(pod, NULL, s_topology_spread);
+    if (complex_shape == 0)
+        complex_shape = nonempty_list_attr(pod, NULL, s_affinity_terms);
+    if (complex_shape == 0)
+        complex_shape = nonempty_list_attr(pod, NULL, s_preferred_affinity_terms);
+    if (complex_shape == 0)
+        complex_shape = nonempty_list_attr(pod, NULL, s_volume_zones);
+    if (complex_shape != 0)
+        return complex_shape < 0 ? -1 : 0;
+
+    requests = PyObject_GetAttr(pod, s_requests);
+    if (requests == NULL)
+        return -1;
+    r_map = PyObject_GetAttr(requests, s_r);
+    Py_DECREF(requests);
+    if (r_map == NULL)
+        return -1;
+    eq = PyObject_RichCompareBool(r_map, prev_r, Py_EQ);
+    Py_DECREF(r_map);
+    if (eq != 1)
+        return eq;
+
+    nodesel = PyObject_GetAttr(pod, s_node_selector);
+    if (nodesel == NULL)
+        return -1;
+    eq = PyObject_RichCompareBool(nodesel, prev_sel, Py_EQ);
+    Py_DECREF(nodesel);
+    if (eq != 1)
+        return eq;
+
+    meta = PyObject_GetAttr(pod, s_meta);
+    if (meta == NULL)
+        return -1;
+    labels = PyObject_GetAttr(meta, s_labels);
+    Py_DECREF(meta);
+    if (labels == NULL)
+        return -1;
+    eq = PyObject_RichCompareBool(labels, prev_labels, Py_EQ);
+    Py_DECREF(labels);
+    return eq;
+}
+
+/* Cache the run leader's comparison fields. Returns 0 ok, -1 error. */
+static int
+load_prev(PyObject *pod, PyObject **prev_r, PyObject **prev_sel,
+          PyObject **prev_labels)
+{
+    PyObject *requests, *meta;
+
+    Py_CLEAR(*prev_r);
+    Py_CLEAR(*prev_sel);
+    Py_CLEAR(*prev_labels);
+    requests = PyObject_GetAttr(pod, s_requests);
+    if (requests == NULL)
+        return -1;
+    *prev_r = PyObject_GetAttr(requests, s_r);
+    Py_DECREF(requests);
+    if (*prev_r == NULL)
+        return -1;
+    *prev_sel = PyObject_GetAttr(pod, s_node_selector);
+    if (*prev_sel == NULL)
+        return -1;
+    meta = PyObject_GetAttr(pod, s_meta);
+    if (meta == NULL)
+        return -1;
+    *prev_labels = PyObject_GetAttr(meta, s_labels);
+    Py_DECREF(meta);
+    if (*prev_labels == NULL)
+        return -1;
+    return 0;
+}
+
 /* group_pods(pods, py_signature) -> list of lists of pods, in first-seen
  * signature order. */
 static PyObject *
 group_pods_c(PyObject *self, PyObject *args)
 {
     PyObject *pods, *py_signature, *buckets = NULL, *order = NULL, *seq = NULL;
+    PyObject *prev_r = NULL, *prev_sel = NULL, *prev_labels = NULL;
+    PyObject *prev_members = NULL; /* borrowed (owned by order) */
     Py_ssize_t n, i;
 
     if (!PyArg_ParseTuple(args, "OO", &pods, &py_signature))
@@ -198,8 +318,20 @@ group_pods_c(PyObject *self, PyObject *args)
 
     for (i = 0; i < n; i++) {
         PyObject *pod = PySequence_Fast_GET_ITEM(seq, i); /* borrowed */
-        PyObject *sig = signature_for(pod, py_signature);
-        PyObject *members;
+        PyObject *sig, *members;
+        int simple = 0;
+
+        if (prev_members != NULL) {
+            int same = matches_prev(pod, prev_r, prev_sel, prev_labels);
+            if (same < 0)
+                goto fail;
+            if (same) {
+                if (PyList_Append(prev_members, pod) < 0)
+                    goto fail;
+                continue;
+            }
+        }
+        sig = signature_for(pod, py_signature, &simple);
         if (sig == NULL)
             goto fail;
         members = PyDict_GetItemWithError(buckets, sig); /* borrowed */
@@ -220,12 +352,28 @@ group_pods_c(PyObject *self, PyObject *args)
         Py_DECREF(sig);
         if (PyList_Append(members, pod) < 0)
             goto fail;
+        if (simple) {
+            if (load_prev(pod, &prev_r, &prev_sel, &prev_labels) < 0)
+                goto fail;
+            prev_members = members;
+        } else {
+            Py_CLEAR(prev_r);
+            Py_CLEAR(prev_sel);
+            Py_CLEAR(prev_labels);
+            prev_members = NULL;
+        }
     }
+    Py_XDECREF(prev_r);
+    Py_XDECREF(prev_sel);
+    Py_XDECREF(prev_labels);
     Py_DECREF(buckets);
     Py_DECREF(seq);
     return order;
 
 fail:
+    Py_XDECREF(prev_r);
+    Py_XDECREF(prev_sel);
+    Py_XDECREF(prev_labels);
     Py_XDECREF(buckets);
     Py_XDECREF(order);
     Py_XDECREF(seq);

@@ -367,30 +367,52 @@ def _options_content_key(
     ~3ms at 400 types — vs ~50ms of re-flattening it guards."""
     prov_part = []
     for p, types in provisioners:
-        type_part = tuple(
-            (
-                it.name,
-                tuple(sorted(it.capacity.items())),
-                # allocatable folds in the overhead math — a changed
-                # kube-reserved/eviction threshold MUST miss the cache
-                tuple(sorted(it.allocatable().items())),
-                tuple(
-                    sorted(
-                        (r.key, r.complement, tuple(sorted(r.values)),
-                         r.greater_than, r.less_than)
-                        for r in it.requirements
-                    )
-                ),
-                tuple(
-                    (o.zone, o.capacity_type, o.price, o.available)
-                    for o in it.offerings
-                ),
-            )
-            for it in types
-        )
+        type_part = tuple(_type_sig(it) for it in types)
         prov_part.append((_provisioner_sig(p), type_part))
     ds_part = tuple(_signature(d) for d in daemonsets)
     return (tuple(prov_part), ds_part)
+
+
+def _type_sig(it: InstanceType) -> tuple:
+    """Value signature of one InstanceType, stashed on the object and
+    validated against the identity of every component it reads (requirements,
+    offerings, capacity, overhead — all replaced wholesale on change via
+    ``with_offerings``/``dataclasses.replace``, Offering itself frozen). A
+    catalog provider that serves cached InstanceType objects then pays ~a dict
+    lookup per type for the whole content key instead of re-flattening
+    requirements and offerings every encode."""
+    cached = it.__dict__.get("_content_sig")
+    if (
+        cached is not None
+        and cached[0] is it.requirements
+        and cached[1] is it.capacity
+        and cached[2] is it.overhead
+        and len(cached[3]) == len(it.offerings)
+        and all(a is b for a, b in zip(cached[3], it.offerings))
+    ):
+        return cached[4]
+    sig = (
+        it.name,
+        tuple(sorted(it.capacity.items())),
+        # allocatable folds in the overhead math — a changed
+        # kube-reserved/eviction threshold MUST miss the cache
+        tuple(sorted(it.allocatable().items())),
+        tuple(
+            sorted(
+                (r.key, r.complement, tuple(sorted(r.values)),
+                 r.greater_than, r.less_than)
+                for r in it.requirements
+            )
+        ),
+        tuple(
+            (o.zone, o.capacity_type, o.price, o.available)
+            for o in it.offerings
+        ),
+    )
+    it.__dict__["_content_sig"] = (
+        it.requirements, it.capacity, it.overhead, tuple(it.offerings), sig,
+    )
+    return sig
 
 
 def _provisioner_sig(p: Provisioner) -> tuple:
@@ -481,6 +503,40 @@ def _maybe_compact_vocab() -> None:
         _VOCAB.clear()
         _VOCAB_GEN += 1
         _table_cache.clear()
+        _surface_cols.clear()
+        _ex_table_cache.clear()
+
+
+_surface_cols: Dict[int, tuple] = {}  # id(surface) -> (pin, vocab gen, cols)
+_SURFACE_COLS_MAX = 200_000  # bound: one entry per live interned surface
+
+
+def _surface_columns(reqs: Requirements) -> list:
+    """Column contributions of one requirement surface: [(key, (cplx, code,
+    num))]. Memoized by surface identity so a _ReqTable rebuild over N mostly
+    unchanged surfaces (the per-reconcile existing-node roster, the launch
+    options of an unchanged catalog) is a dict hit per surface instead of
+    re-deriving singleton codes requirement by requirement. Entries embed
+    vocab codes, so a compaction invalidates them (generation check)."""
+    e = _surface_cols.get(id(reqs))
+    if e is not None and e[0] is reqs and e[1] == _VOCAB_GEN:
+        return e[2]
+    cols = []
+    for r in reqs:
+        v = r.single_value()
+        if v is None:
+            props = (True, -1, np.nan)
+        else:
+            try:
+                num = float(int(v))
+            except ValueError:
+                num = np.nan
+            props = (False, _code(v), num)
+        cols.append((r.key, props))
+    if len(_surface_cols) >= _SURFACE_COLS_MAX:
+        _surface_cols.clear()
+    _surface_cols[id(reqs)] = (reqs, _VOCAB_GEN, cols)
+    return cols
 
 
 class _ReqTable:
@@ -498,34 +554,18 @@ class _ReqTable:
         self.n = len(surfaces)
         self.surfaces = list(surfaces)
         self.keys: Dict[str, tuple] = {}
-        # Requirement objects are heavily shared across surfaces (a merged
-        # (provisioner x type) requirement set is reused by all its offerings),
-        # so per-object properties are memoized by identity and the row arrays
-        # are filled with one vectorized scatter per key instead of 16k
-        # element-wise numpy writes.
-        memo: Dict[int, tuple] = {}  # id(r) -> (cplx, code, num); r pinned below
-        pins = []
+        # Per-surface column contributions are memoized module-wide
+        # (_surface_columns): surfaces are heavily shared AND stable across
+        # encodes (interned node surfaces, cached launch options), so a warm
+        # rebuild is a dict hit per surface plus the vectorized scatter below.
         per_key: Dict[str, tuple] = {}  # key -> (idx list, props list)
         for i, reqs in enumerate(surfaces):
-            for r in reqs:
-                e = memo.get(id(r))
-                if e is None:
-                    v = r.single_value()
-                    if v is None:
-                        e = (True, -1, np.nan)
-                    else:
-                        try:
-                            num = float(int(v))
-                        except ValueError:
-                            num = np.nan
-                        e = (False, _code(v), num)
-                    memo[id(r)] = e
-                    pins.append(r)  # keep r alive so ids stay unique
-                bucket = per_key.get(r.key)
+            for key, props in _surface_columns(reqs):
+                bucket = per_key.get(key)
                 if bucket is None:
-                    bucket = per_key[r.key] = ([], [])
+                    bucket = per_key[key] = ([], [])
                 bucket[0].append(i)
-                bucket[1].append(e)
+                bucket[1].append(props)
         for key, (idxs, props) in per_key.items():
             has = np.zeros(self.n, bool)
             codes = np.full(self.n, -1, np.int64)
@@ -583,6 +623,32 @@ class _ReqTable:
 # ---------------------------------------------------------------------------
 # Existing (in-flight) capacity
 # ---------------------------------------------------------------------------
+
+_ex_table_cache: Dict[tuple, tuple] = {}  # surface-id roster -> (pins, table, gen)
+
+
+def _get_surface_table(surfaces: Sequence[Requirements]) -> "_ReqTable":
+    """Requirement table over the existing-node roster, cached by the ordered
+    tuple of surface identities. Node surfaces are interned by name
+    (_node_surface), so an unchanged roster — the common consecutive-reconcile
+    case, including a re-listed set of value-equal Node objects — hits without
+    rebuilding; any add/remove/label-change produces a different key and
+    rebuilds from the per-surface column memo (delta cost, not full re-derive).
+    One-generation cache, like _options_cache: stale keys would pin dead
+    surface objects."""
+    key = tuple(map(id, surfaces))
+    e = _ex_table_cache.get(key)
+    if (
+        e is not None
+        and e[2] == _VOCAB_GEN
+        and all(a is b for a, b in zip(e[0], surfaces))
+    ):
+        return e[1]
+    table = _ReqTable(surfaces)
+    _ex_table_cache.clear()
+    _ex_table_cache[key] = (list(surfaces), table, _VOCAB_GEN)
+    return table
+
 
 @dataclass
 class ExistingNode:
@@ -778,7 +844,7 @@ def encode(
         for k, e in enumerate(existing):
             ex_rem[k] = _vector(e.remaining, axes)
             ex_zone[k] = zone_index.get(e.node.zone(), 0)
-        ex_table = _ReqTable([_node_surface(e.node) for e in existing])
+        ex_table = _get_surface_table([_node_surface(e.node) for e in existing])
         schedulable = np.array(
             [
                 not e.node.unschedulable and e.node.meta.deletion_timestamp is None
@@ -1134,17 +1200,36 @@ def sizing_demand(problem: "EncodedProblem") -> np.ndarray:
     return out
 
 
+_node_surface_intern: Dict[str, tuple] = {}  # node name -> (labels copy, surface)
+_NODE_SURFACE_MAX = 100_000  # bound for a long-lived operator's name churn
+
+
 def _node_surface(node: Node) -> Requirements:
     """The node's label surface as Requirements, cached on the node: 2000
     in-flight nodes cost ~85ms of Requirement construction per encode
     otherwise, every reconcile. Invalidation keys on the labels dict identity
     — node labels are stamped once at registration; any code replacing the
-    dict gets a fresh surface automatically."""
+    dict gets a fresh surface automatically.
+
+    A second, name-keyed intern layer serves value-equal re-listed Node
+    objects (informer refresh, restart re-adoption): a dict-equality check on
+    the labels (~1us) replaces full Requirement construction (~90us), and —
+    because the SAME surface object comes back — the downstream roster/table
+    caches keyed by surface identity keep hitting too."""
     cached = node.__dict__.get("_req_surface")
     if cached is not None and cached[0] is node.meta.labels:
         return cached[1]
     labels = node.meta.labels
-    surface = Requirements.from_labels(labels)
+    entry = _node_surface_intern.get(node.name)
+    if entry is not None and entry[0] == labels:
+        surface = entry[1]
+    else:
+        surface = Requirements.from_labels(labels)
+        if len(_node_surface_intern) >= _NODE_SURFACE_MAX:
+            _node_surface_intern.clear()
+        # store a copy: in-place mutation of the caller's dict must not be
+        # able to desynchronize the comparison reference
+        _node_surface_intern[node.name] = (dict(labels), surface)
     node.__dict__["_req_surface"] = (labels, surface)
     return surface
 

@@ -46,13 +46,18 @@ _EPS = 1e-9
 
 
 def _fit_rows(cap: np.ndarray, dg: np.ndarray) -> np.ndarray:
-    """Whole pods of per-pod demand ``dg`` fitting in each capacity row."""
+    """Whole pods of per-pod demand ``dg`` fitting in each capacity row.
+
+    Clamped at zero: capacity rows can be epsilon-NEGATIVE (a node packed to
+    float-exact capacity leaves alloc - load ~ -1e-7), and a negative fit fed
+    into the cumulative first-fit produces negative takes that still sum to
+    the wanted count — a silently corrupt plan."""
     with np.errstate(divide="ignore", invalid="ignore"):
         fit = np.min(
             np.where(dg[None, :] > 0, np.floor(cap / np.maximum(dg[None, :], 1e-30) + _EPS), np.inf),
             axis=1,
         )
-    return np.where(np.isfinite(fit), fit, 0.0)
+    return np.maximum(np.where(np.isfinite(fit), fit, 0.0), 0.0)
 
 
 def lp_safe(problem: EncodedProblem) -> bool:
@@ -580,9 +585,11 @@ def evacuate_into_existing(
             new_opt.append(op.option)
             new_ys.append(ys[:, j].astype(np.int64))
     N = len(new_opt)
+    if N == 0:
+        return placements, opens
     opt_arr = np.asarray(new_opt, np.int64)
-    ys_arr = np.stack(new_ys, axis=1) if N else np.zeros((G, 0), np.int64)
-    new_rem = alloc[opt_arr].copy() - (ys_arr.T.astype(np.float64) @ d) if N else np.zeros((0, d.shape[1]))
+    ys_arr = np.stack(new_ys, axis=1)
+    new_rem = alloc[opt_arr].copy() - (ys_arr.T.astype(np.float64) @ d)
     alive = np.ones(N, bool)
 
     for _ in range(rounds):
@@ -594,9 +601,7 @@ def evacuate_into_existing(
         n_try = max(4, int(alive.sum() * 0.15))
         tried = 0
         # cheap aggregate prefilter: total slack must cover the node's load
-        slack_total = (ex_rem.sum(axis=0) if E else 0.0) + (
-            new_rem[alive].sum(axis=0) if N else 0.0
-        )
+        slack_total = (ex_rem.sum(axis=0) if E else 0.0) + new_rem[alive].sum(axis=0)
         for j in np.argsort(dens):
             if tried >= n_try:
                 break
@@ -608,7 +613,7 @@ def evacuate_into_existing(
                 alive[j] = False
                 continue
             load = y.astype(np.float64) @ d
-            own_slack = new_rem[j] if N else 0.0
+            own_slack = new_rem[j]
             if np.any(load > slack_total - own_slack + 1e-9):
                 continue
             tried += 1
@@ -623,11 +628,10 @@ def evacuate_into_existing(
                 want = int(y[g])
                 dg = d[g]
                 fit_ex = _fit_rows(trial_ex, dg) if E else np.zeros(0)
-                fit_new = _fit_rows(trial_new, dg) if N else np.zeros(0)
                 fit_ex = (fit_ex * problem.ex_compat[g]).astype(np.int64) if E else fit_ex.astype(np.int64)
                 fit_new = np.where(
-                    others & problem.compat[g, opt_arr], fit_new, 0.0
-                ).astype(np.int64) if N else fit_new.astype(np.int64)
+                    others & problem.compat[g, opt_arr], _fit_rows(trial_new, dg), 0.0
+                ).astype(np.int64)
                 fit_all = np.concatenate([fit_ex, fit_new])
                 before = np.cumsum(fit_all) - fit_all
                 take = np.clip(want - before, 0, fit_all)
@@ -637,8 +641,7 @@ def evacuate_into_existing(
                 te, tn = take[:E], take[E:]
                 if E:
                     trial_ex -= te[:, None].astype(np.float64) * dg[None, :]
-                if N:
-                    trial_new -= tn[:, None].astype(np.float64) * dg[None, :]
+                trial_new -= tn[:, None].astype(np.float64) * dg[None, :]
                 takes_ex.append((g, te))
                 takes_new.append((g, tn))
             if not okay:

@@ -265,3 +265,24 @@ class TestPlanCompaction:
         placements2, opens2 = H.evacuate_into_existing(p, placements, opens, ex_rem)
         assert opens2 == []  # node deleted
         assert placements2.sum() == 4  # pods moved to the fragment
+
+    def test_negative_capacity_row_never_yields_negative_take(self):
+        """A node packed to float-exact capacity leaves an epsilon-NEGATIVE
+        remaining row; _fit_rows must clamp it to 0 or the cumulative
+        first-fit writes negative takes that still sum to the wanted count
+        (round-4 review finding)."""
+        cap = np.array([
+            [2.0, 4.0],      # fits 4 pods of (0.5, 1.0)
+            [-1e-7, -1e-7],  # exactly-full node: epsilon-negative
+            [5.0, 10.0],     # roomy
+        ])
+        dg = np.array([0.5, 1.0])
+        fit = H._fit_rows(cap, dg)
+        assert (fit >= 0).all(), fit
+        assert fit[1] == 0.0
+        # cumulative first-fit over these rows can never go negative
+        want = 5
+        before = np.cumsum(fit) - fit
+        take = np.clip(want - before, 0, fit)
+        assert (take >= 0).all()
+        assert take.sum() >= want

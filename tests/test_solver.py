@@ -363,9 +363,12 @@ class TestMeshSharding:
         ]
         problem = encode(pods, setup())
         # quality mode pins both solves to the synchronous kernel (the race
-        # could otherwise return the host FFD competitor on either side)
-        multi = TPUSolver(portfolio=8, latency_budget_s=10.0).solve(problem)
-        single = TPUSolver(portfolio=8, auto_mesh=False, latency_budget_s=10.0).solve(problem)
+        # could otherwise return the host FFD competitor on either side).
+        # portfolio=16 > 8 devices: each device carries a member BLOCK, so the
+        # equivalence also proves the block layout, not just one-member-per-chip
+        # (round-4 verdict item 10)
+        multi = TPUSolver(portfolio=16, latency_budget_s=10.0).solve(problem)
+        single = TPUSolver(portfolio=16, auto_mesh=False, latency_budget_s=10.0).solve(problem)
         assert multi.stats.get("backend") == 1.0
         assert single.stats.get("backend") == 1.0
         assert multi.cost == pytest.approx(single.cost, rel=1e-5)
