@@ -15,6 +15,7 @@ interface) with two backends:
 from __future__ import annotations
 
 import abc
+import dataclasses
 import math
 import threading
 import time
@@ -424,7 +425,23 @@ class TPUSolver(Solver):
 
         quality = self.latency_budget_s > 1.0
         dispatched = None
-        if not quality and self.device_rtt() < self.latency_budget_s:
+        # Per-problem race memory: when the kernel already lost a race on THIS
+        # problem, a repeat solve returns the (polished, cached) host answer
+        # immediately instead of burning the rest of the budget waiting on a
+        # device answer that is known to be no better. Any change to the
+        # cluster produces a new encode (new object) and races afresh.
+        kernel_hopeless = problem.__dict__.get("_race_kernel_lost", False)
+        # A kernel result that WON a race on this problem is deterministic for
+        # the unchanged problem: repeat solves compare the cached answer
+        # against the (still-improving) host plan instead of re-paying the
+        # device round-trip. Any cluster change re-encodes -> new object.
+        kernel_cached = problem.__dict__.get("_race_kernel_result")
+        if (
+            not quality
+            and not kernel_hopeless
+            and kernel_cached is None
+            and self.device_rtt() < self.latency_budget_s
+        ):
             # Fire the kernel at the device BEFORE the host path runs: the
             # dispatch is non-blocking, so the TPU computes concurrently with
             # the host path and the poll below only pays the leftover wait.
@@ -434,7 +451,11 @@ class TPUSolver(Solver):
             dispatched = self._dispatch_async(problem)
         host_result = None
         try:
-            host_result = solve_host(problem)
+            # the host path may spend budget left after a feasible plan exists
+            # on adaptive polish (pattern CG + ruin-recreate); quality mode
+            # gets a fixed generous cap instead of its multi-second budget
+            host_deadline = t0 + min(self.latency_budget_s * 0.85, 0.5)
+            host_result = solve_host(problem, deadline=host_deadline)
         except Exception:
             host_result = None  # any host-path failure falls through to kernel
         if host_result is None and not quality:
@@ -454,6 +475,14 @@ class TPUSolver(Solver):
                 # quality mode (generous budget): synchronous race, compile and
                 # all — consolidation sweeps and tests that want the best answer
                 kernel_result = self._solve_kernel(problem)
+            elif kernel_hopeless:
+                kernel_result = None
+            elif kernel_cached is not None:
+                # serve a fresh shell each time: the cached object's stats
+                # must not be rewritten under callers holding earlier returns
+                kernel_result = dataclasses.replace(
+                    kernel_cached, stats=dict(kernel_cached.stats)
+                )
             else:
                 kernel_result = self._poll_dispatch(
                     problem,
@@ -465,9 +494,19 @@ class TPUSolver(Solver):
                 kernel_result.cost + 1e6 * len(kernel_result.unschedulable)
                 < host_cmp
             ):
+                if not quality and kernel_cached is None:
+                    # cache a private copy whose stats nobody else mutates
+                    problem.__dict__["_race_kernel_result"] = dataclasses.replace(
+                        kernel_result, stats=dict(kernel_result.stats)
+                    )
                 kernel_result.stats["race_winner"] = 1.0
                 kernel_result.stats["total_solve_s"] = time.perf_counter() - t0
                 return kernel_result
+            if kernel_result is not None and not quality:
+                # the kernel delivered in time and still lost: remember, so
+                # repeat solves of this problem skip the wait entirely
+                problem.__dict__["_race_kernel_lost"] = True
+                problem.__dict__.pop("_race_kernel_result", None)
             host_result.stats["total_solve_s"] = time.perf_counter() - t0
             return host_result
         result = self._solve_kernel(problem)
@@ -620,8 +659,14 @@ class TPUSolver(Solver):
                 np.asarray(buf), k, s_new, Gp, Ep, orders, swaps
             )
             if unplaced > 0 or costs.min() >= host_cost:
+                # the device DID answer and lost on quality: remember per
+                # problem, so repeat solves return the host answer without
+                # re-paying this wait (distinct from a missed deadline, which
+                # the breaker handles — a late kernel might still win later)
+                problem.__dict__["_race_kernel_lost"] = True
                 return None  # decode + validation would be wasted host time
             if validate_counts(problem, order, new_opt, new_active, ys):
+                problem.__dict__["_race_kernel_lost"] = True
                 return None
             result = self._decode(problem, order, new_opt, new_active, ys)
             result.stats["backend"] = 1.0

@@ -1,0 +1,144 @@
+"""Pattern column generation (solver/patterns.py) + adaptive-tail behaviors.
+
+The crafted instance: pods demanding 2.0 cpu on a catalog whose 4-cpu type
+allocates ~3.92 cpu. Fractionally (assignment LP) two pods per node fit
+(2x2.0=4.0 > 3.92 only integrally); rounding strands ~0.42 cpu per node while
+a pattern-aware plan opens right-sized nodes instead. This is exactly the
+shape where lp_round plateaus and pattern CG recovers (round-4 verdict item 6).
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from karpenter_tpu.api import ObjectMeta, Pod, Provisioner, Resources
+from karpenter_tpu.cloudprovider import generate_catalog
+from karpenter_tpu.solver import TPUSolver, encode, validate
+from karpenter_tpu.solver import host as H
+from karpenter_tpu.solver import patterns as P
+from karpenter_tpu.solver.bounds import best_lower_bound
+
+from helpers import make_pods, make_provisioner
+
+
+def _mixed_problem(n=6000):
+    """A mix whose demand vectors don't tile the cheap nodes: big integrality gap."""
+    pods = []
+    shapes = [("big", "2", "512Mi"), ("mem", "500m", "4Gi"), ("tiny", "250m", "256Mi")]
+    for i in range(n):
+        name, cpu, mem = shapes[i % 3]
+        pods.append(
+            Pod(meta=ObjectMeta(name=f"{name}-{i}"), requests=Resources(cpu=cpu, memory=mem))
+        )
+    prov = Provisioner(meta=ObjectMeta(name="default"))
+    return encode(pods, [(prov, generate_catalog(n_types=60))])
+
+
+class TestPatternImprove:
+    def test_improves_and_stays_feasible(self):
+        p = _mixed_problem()
+        lb = float(best_lower_bound(p))
+        rem = p.count.astype(np.int64).copy()
+        plan = H.lp_solve(p, rem, [], topk=8)
+        opens, left, cost = H.lp_round(p, rem, plan, mode="nearest")
+        if left.sum():
+            tails, left, tc = H._finish_leftovers(p, left, opens, opt_subset=plan.cols)
+            opens += tails
+        inc_cost = sum(op.nodes * float(p.price[op.option]) for op in opens)
+        # first sight registers, second call banks + converges (generous deadline)
+        assert P.pattern_improve(p, rem, opens, inc_cost, plan.cols, plan.fun,
+                                 deadline=time.perf_counter() + 2.0) is None
+        out = P.pattern_improve(p, rem, opens, inc_cost, plan.cols, plan.fun,
+                                deadline=time.perf_counter() + 2.0)
+        assert out is not None, "pattern CG should beat plain rounding on this mix"
+        new_opens, new_cost = out
+        assert new_cost < inc_cost - 1e-9
+        # counts must balance EXACTLY against demand
+        placed = np.zeros(p.G, np.int64)
+        for op in new_opens:
+            ys = op.placements(p.G)
+            placed += ys.sum(axis=1)
+            # capacity per node respected
+            load = ys.T.astype(np.float64) @ p.demand.astype(np.float64)
+            assert np.all(load <= p.alloc[op.option][None, :] * (1 + 5e-4) + 1e-6)
+            # only compatible groups
+            assert not ys[~p.compat[:, op.option]].any()
+        assert (placed == rem).all()
+
+    def test_cached_rounding_served_on_repeat(self):
+        p = _mixed_problem(3000)
+        # full solve twice through the pool (min_pods gate: lower it)
+        rem = p.count.astype(np.int64).copy()
+        plan = H.lp_solve(p, rem, [], topk=8)
+        opens, left, cost = H.lp_round(p, rem, plan, mode="nearest")
+        if left.sum():
+            tails, left, tc = H._finish_leftovers(p, left, opens, opt_subset=plan.cols)
+            opens += tails
+        inc = sum(op.nodes * float(p.price[op.option]) for op in opens)
+        kw = dict(min_pods=100, deadline=time.perf_counter() + 3.0)
+        P.pattern_improve(p, rem, opens, inc, plan.cols, plan.fun, **kw)
+        out1 = P.pattern_improve(p, rem, opens, inc, plan.cols, plan.fun,
+                                 min_pods=100, deadline=time.perf_counter() + 3.0)
+        if out1 is None:
+            pytest.skip("mix rounds optimally already")
+        t0 = time.perf_counter()
+        out2 = P.pattern_improve(p, rem, opens, inc, plan.cols, plan.fun,
+                                 min_pods=100, deadline=time.perf_counter() + 3.0)
+        dt = time.perf_counter() - t0
+        assert out2 is not None and out2[1] == out1[1]
+        assert dt < 0.05, f"cached rounding should be ~instant, took {dt:.3f}s"
+
+    def test_gap_gate_skips_tight_incumbents(self):
+        p = _mixed_problem(5000)
+        rem = p.count.astype(np.int64).copy()
+        # incumbent pretending to be within 0.1% of the bound: no CG
+        out = P.pattern_improve(p, rem, [H.Opened(option=0, nodes=1, mix=np.ones(p.G, np.int64))],
+                                100.0, [0], 99.95, deadline=time.perf_counter() + 1.0)
+        assert out is None
+
+
+class TestSolveAdaptiveTail:
+    def test_repeat_solves_converge_efficiency(self):
+        """Through the full TPUSolver: repeated solves of the same problem
+        must reach >=0.97 efficiency on this gap-prone mix and keep p50 far
+        under the latency budget once warm."""
+        p = _mixed_problem()
+        lb = float(best_lower_bound(p))
+        s = TPUSolver(portfolio=4)
+        r = s.solve(p)
+        assert validate(p, r) == []
+        for _ in range(4):
+            r = s.solve(p)
+        assert validate(p, r) == []
+        assert lb / r.cost >= 0.97, f"efficiency {lb / r.cost:.4f} after adaptation"
+        times = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            r = s.solve(p)
+            times.append(time.perf_counter() - t0)
+        assert min(times) < 0.08, f"warm solves should be fast, got {times}"
+
+    def test_kernel_loss_memo_skips_wait(self, monkeypatch):
+        p = _mixed_problem(1000)
+        s = TPUSolver(portfolio=4)
+        s.solve(p)
+        p.__dict__["_race_kernel_lost"] = True
+        calls = []
+        monkeypatch.setattr(s, "_dispatch_async", lambda pr: calls.append(pr))
+        s.solve(p)
+        assert calls == []  # no dispatch for a problem the kernel lost
+
+    def test_warm_cache_invisible_to_results(self):
+        """The warm-solve pipeline cache may never change WHAT is returned:
+        fresh value-equal problems and warm repeats agree on cost."""
+        p1 = _mixed_problem(2000)
+        p2 = _mixed_problem(2000)
+        s = TPUSolver(portfolio=4)
+        r_cold = s.solve(p1)
+        r_warm = s.solve(p1)
+        r_fresh = s.solve(p2)
+        assert validate(p1, r_warm) == []
+        assert r_warm.cost <= r_cold.cost + 1e-9  # warm only improves
+        # fresh object without adaptation must match the cold answer
+        assert r_fresh.cost == pytest.approx(r_cold.cost, rel=1e-6)
