@@ -809,7 +809,10 @@ def solve_host(
                     no_gain = 0
                 else:
                     no_gain += 1
-            problem.__dict__["_rr_exhausted_at"] = best[2]
+            if no_gain >= 3:
+                # memoize only a sweep that ran DRY — a deadline cut (or a
+                # sweep that never started) must retry on the next solve
+                problem.__dict__["_rr_exhausted_at"] = best[2]
 
     if best is not None and best[1].sum() == 0:
         # snapshot BEFORE evacuate mutates placements/ex_rem in place

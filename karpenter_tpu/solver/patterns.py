@@ -43,9 +43,19 @@ except Exception:  # pragma: no cover
     _HAVE_SCIPY = False
 
 
-# One-generation pool cache: warm re-solves of the same problem reuse the
-# learned columns (warm-start CG) instead of re-pricing from scratch.
+# Pool cache: warm re-solves of the same problem reuse the learned columns
+# (warm-start CG) instead of re-pricing from scratch. Bounded FIFO with room
+# for a FEW problems — a reconcile loop alternating two stable pools must not
+# thrash each other's pools and re-pay the warmup spike every solve. Entries
+# pin their problem; the bound keeps that to a handful of encodes.
+_POOL_CACHE_MAX = 4
 _pool_cache: Dict[int, tuple] = {}
+
+
+def _cache_put(cache: Dict[int, tuple], key: int, value: tuple, cap: int) -> None:
+    if key not in cache and len(cache) >= cap:
+        cache.pop(next(iter(cache)))
+    cache[key] = value
 
 # Problems seen once: CG only engages from the SECOND solve of the same
 # problem — a one-shot solve (consolidation trial, cold batch) must not pay
@@ -259,8 +269,7 @@ def pattern_improve(
             _seen_problems[key] = problem  # first sight: free, no CG yet
             return None
         pool = _seed_pool(problem, incumbent)
-        _pool_cache.clear()
-        _pool_cache[key] = (problem, pool)
+        _cache_put(_pool_cache, key, (problem, pool), _POOL_CACHE_MAX)
         # One-time converge budget: the first banking solve of a repeated
         # problem may exceed the per-solve deadline (bounded), the way the
         # first solve pays jit compile — every subsequent solve then returns

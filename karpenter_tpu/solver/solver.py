@@ -466,6 +466,22 @@ class TPUSolver(Solver):
                 host_result = self._solve_host_pack(problem)
             except Exception:
                 host_result = None
+            if host_result is not None and not host_result.unschedulable:
+                # zone-decomposed pattern CG (topo.py): closes the FFD's
+                # integrality gap on spread shapes; engages on repeat solves,
+                # replaces the FFD answer only when strictly cheaper AND
+                # fully validated
+                try:
+                    from .topo import topo_improve
+
+                    improved = topo_improve(
+                        problem, self, host_result.cost,
+                        deadline=t0 + self.latency_budget_s * 0.85,
+                    )
+                    if improved is not None:
+                        host_result = improved
+                except Exception:
+                    pass  # the FFD answer stands
         if host_result is not None:
             # comparisons carry the kernel's own unplaced penalty so a host
             # member that STRANDS pods can never beat a complete kernel answer

@@ -1,0 +1,577 @@
+"""Topology-aware plan improvement: zone-decomposed pattern CG for spread shapes.
+
+The FFD portfolio (kernel + ``host_pack``) lands ~2% above the zone-split LP
+bound on spread-heavy mixes — first-fit cannot see that a 2.0-cpu pod pair
+strands 0.42 cpu on a 3.92-cpu node thousands of times. The LP-safe path
+fixes that with pattern column generation (``patterns.py``), but topology
+constraints (zone spread, hostname anti-affinity caps) are outside the plain
+master LP.
+
+This module brings patterns to those shapes by DECOMPOSING on the structure
+the constraints already impose:
+
+  * zone spread fixes per-(group, zone) demand: the kernel's own water-filled
+    quotas (``solver._zone_quotas``) ARE the split, so each zone becomes an
+    independent subproblem over that zone's launch options;
+  * per-node caps (hostname anti-affinity / spread ``maxSkew``) are natural
+    PATTERN constraints: a pattern is feasible iff k[g] <= node_cap[g] — the
+    formulation that is awkward for an assignment LP is trivial here;
+  * per-zone: CG with cap-respecting pricing, FLOOR the master (vertex
+    solutions keep the bulk; giant-node columns round coarsely, which is why
+    flooring only the bulk is safe and the rest is NOT rounded), and hand the
+    combined residual to the existing ``host_pack`` FFD portfolio with counts
+    and quotas patched down — FFD is excellent on the small remainder;
+  * finish with a capped, zone-preserving ruin-recreate: kill low value
+    density nodes, refill their pods into surviving same-zone slack, open
+    right-sized replacement nodes; every round is accepted only if counts
+    stay exact and cost strictly drops.
+
+The result replaces the incumbent only when the full name-level validator
+passes — topology constraints are subtle, and a cheaper-but-invalid plan must
+never escape. Unsupported shapes (existing capacity, colocation, cross-group
+relation bits) return None and the incumbent stands.
+
+Like ``patterns.py``, the work is gated to REPEAT solves of a problem and the
+finished plan is cached per problem object, so steady-state reconciles return
+the improved answer in ~ms while one-shot solves pay nothing.
+"""
+
+from __future__ import annotations
+
+import time
+import weakref
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .encode import EncodedProblem
+from .host import Opened, _units_rate, plan_cost
+
+try:  # pragma: no cover - scipy is baked into the image
+    from scipy.optimize import linprog
+
+    _HAVE_SCIPY = True
+except Exception:  # pragma: no cover
+    _HAVE_SCIPY = False
+
+_IBIG = np.int64(1 << 30)
+
+# id(problem) -> (problem, finished plan | None); bounded FIFO like
+# patterns._pool_cache so alternating stable problems keep their plans
+_STATE_CACHE_MAX = 4
+_state_cache: Dict[int, tuple] = {}
+_seen: "weakref.WeakValueDictionary[int, EncodedProblem]" = weakref.WeakValueDictionary()
+
+
+def _supported(problem: EncodedProblem) -> bool:
+    if problem.E or problem.O == 0:
+        return False
+    if np.any(problem.colocate):
+        return False
+    rel_active = any(
+        a is not None and np.any(a)
+        for a in (
+            problem.rel_set, problem.rel_host_forbid, problem.rel_host_need,
+            problem.rel_zone_forbid, problem.rel_zone_need,
+            problem.rel_slot_bits, problem.rel_zone_bits,
+        )
+    )
+    return not rel_active
+
+
+def _zone_split(problem: EncodedProblem, quota: np.ndarray) -> Optional[np.ndarray]:
+    """Per-(group, zone) demand [G, Z]. Spread groups take their water-filled
+    quota verbatim (it sums exactly to count); free / zone-capped groups are
+    split along the relaxed assignment LP's flows, capped by quota."""
+    from .host import lp_solve
+
+    G = problem.G
+    Z = quota.shape[1]
+    count = problem.count.astype(np.int64)
+    rem_gz = np.zeros((G, Z), np.int64)
+    lp_free: List[int] = []
+    for g in range(G):
+        q = quota[g]
+        if (q < _IBIG).all() and q.sum() == count[g]:
+            rem_gz[g] = q
+        else:
+            lp_free.append(g)
+    if lp_free:
+        plan = lp_solve(problem, count.copy(), [], topk=8)
+        if not hasattr(plan, "cols"):
+            return None
+        zone_of_col = problem.opt_zone[plan.cols]
+        for g in lp_free:
+            mask = plan.active[plan.gi] == g
+            flows = np.zeros(Z)
+            np.add.at(flows, zone_of_col[plan.oi[mask]], plan.x[mask])
+            if flows.sum() <= 0:
+                flows = np.ones(Z)
+            share = flows / flows.sum()
+            az = np.floor(share * count[g]).astype(np.int64)
+            residue = int(count[g] - az.sum())
+            for z in np.argsort(-(share * count[g] - az), kind="stable")[:residue]:
+                az[z] += 1
+            az = np.minimum(az, quota[g])
+            over = int(count[g] - az.sum())
+            zi = 0
+            while over > 0 and zi < 4 * Z:
+                z = zi % Z
+                head = int(quota[g][z] - az[z])
+                t = min(head, over)
+                az[z] += t
+                over -= t
+                zi += 1
+            if over > 0:
+                return None  # quota-infeasible split; incumbent stands
+            rem_gz[g] = az
+    return rem_gz
+
+
+def _greedy_pattern(problem, o: int, weights: np.ndarray, caps: np.ndarray) -> np.ndarray:
+    d = problem.demand.astype(np.float64)
+    a = problem.alloc.astype(np.float64)[o].copy()
+    G = problem.G
+    k = np.zeros(G, np.int64)
+    compat = problem.compat[:, o]
+    for _ in range(64):
+        fm = np.all(d <= a[None, :] + 1e-12, axis=1) & compat & (weights > 0) & (k < caps)
+        if not fm.any():
+            break
+        g = int(np.argmax(np.where(fm, weights, -1)))
+        with np.errstate(divide="ignore", invalid="ignore"):
+            m = np.min(np.where(d[g] > 0, a / np.maximum(d[g], 1e-30), np.inf))
+        m = max(1, int(min(np.floor(m + 1e-9), caps[g] - k[g])) // 2)
+        k[g] += m
+        a -= d[g] * m
+    return k
+
+
+def _price_patterns_capped(
+    problem, cols: np.ndarray, duals: np.ndarray, caps: np.ndarray,
+    cap_extra: Optional[np.ndarray] = None,
+) -> np.ndarray:
+    """Vectorized dual-guided knapsack with per-group caps (patterns.py's
+    pricing plus the node_cap constraint). ``cap_extra`` further limits per
+    pattern (e.g. to remaining demand for repair nodes)."""
+    d = problem.demand.astype(np.float64)
+    a = problem.alloc.astype(np.float64)[cols].copy()
+    compat = problem.compat[:, cols].T
+    O, G = compat.shape
+    lim = caps if cap_extra is None else np.minimum(caps, cap_extra)
+    k = np.zeros((O, G), np.int64)
+    pos = duals > 0
+    live = np.ones(O, bool)
+    for _ in range(48):
+        fits = np.all(d[None, :, :] <= a[:, None, :] + 1e-12, axis=2)
+        fits &= compat & pos[None, :] & (k < lim[None, :])
+        live &= fits.any(axis=1)
+        if not live.any():
+            break
+        scale = np.maximum(a, 1e-9)
+        lf = np.max(d[None, :, :] / scale[:, None, :], axis=2)
+        w = np.where(fits, duals[None, :] / np.maximum(lf, 1e-9), -1.0)
+        gs = np.argmax(w, axis=1)
+        ok = live & (np.take_along_axis(w, gs[:, None], 1)[:, 0] > 0)
+        if not ok.any():
+            break
+        dsel = d[gs]
+        with np.errstate(divide="ignore", invalid="ignore"):
+            m = np.min(np.where(dsel > 0, a / np.maximum(dsel, 1e-30), np.inf), axis=1)
+        m = np.where(np.isfinite(m), np.floor(m + 1e-9), 0)
+        room = lim[gs] - k[np.arange(O), gs]
+        m = (np.minimum(np.maximum(1, m // 4), room) * ok).astype(np.int64)
+        np.add.at(k, (np.arange(O), gs), m)
+        a -= dsel * m[:, None]
+        live &= m > 0
+    return k
+
+
+def _zone_bulk(
+    problem, z: int, rem_z: np.ndarray, caps: np.ndarray, deadline: Optional[float]
+) -> Tuple[List[Opened], np.ndarray]:
+    """CG on zone z's demand; FLOOR the converged master (the integral bulk at
+    LP rate); overserve trimmed to exactness. The fractional remainder is NOT
+    rounded here — the caller's FFD pass owns it."""
+    G = problem.G
+    d = problem.demand.astype(np.float64)
+    price = problem.price.astype(np.float64)
+    units, rate = _units_rate(problem)
+    cols_z = np.flatnonzero(problem.opt_zone == z)
+    cand = set()
+    for g in np.flatnonzero(rem_z > 0):
+        rz = rate[g, cols_z]
+        finite = np.isfinite(rz)
+        kt = min(10, int(finite.sum()))
+        if kt:
+            idx = np.argpartition(rz, kt - 1)[:kt]
+            cand.update(int(cols_z[j]) for j in idx if np.isfinite(rz[j]))
+    if not cand:
+        return [], np.zeros(G, np.int64)
+    cols = np.array(sorted(cand), np.int64)
+
+    pats: List[Tuple[int, np.ndarray]] = []
+    seen: set = set()
+
+    def add(o, k):
+        key = (int(o), k.tobytes())
+        if key not in seen and k.sum() > 0:
+            seen.add(key)
+            pats.append((int(o), k.astype(np.int64)))
+            return 1
+        return 0
+
+    for o in cols:
+        for w in (d[:, 0], d[:, 1], rem_z.astype(float)):
+            add(o, _greedy_pattern(problem, o, np.where(rem_z > 0, w, 0), caps))
+    for g in np.flatnonzero(rem_z > 0):
+        for o in cols:
+            if problem.compat[g, o]:
+                u = int(min(units[g, o], caps[g]))
+                if u >= 1:
+                    k = np.zeros(G, np.int64)
+                    k[g] = u
+                    add(o, k)
+    act = np.flatnonzero(rem_z > 0)
+
+    def master():
+        A = np.stack([k for _, k in pats], axis=1).astype(np.float64)
+        c = np.array([price[o] for o, _ in pats])
+        return linprog(
+            c, A_ub=-A[act], b_ub=-rem_z[act].astype(np.float64),
+            bounds=[(0.0, None)] * len(pats), method="highs-ds",
+        )
+
+    res = master()
+    if res.status != 0:
+        return [], np.zeros(G, np.int64)
+    for _ in range(10):
+        if deadline is not None and time.perf_counter() > deadline:
+            break
+        duals = np.zeros(G)
+        duals[act] = -np.asarray(res.ineqlin.marginals)
+        K = _price_patterns_capped(problem, cols, duals, caps)
+        vals = K @ duals
+        fresh = 0
+        for oi in np.flatnonzero(vals > price[cols] * (1 + 1e-6)):
+            fresh += add(int(cols[oi]), K[oi])
+        if fresh == 0:
+            break
+        res2 = master()
+        if res2.status != 0:
+            # res is now STALE relative to the grown pattern list (x shorter
+            # than the column set) — flooring it would shape-mismatch
+            return [], np.zeros(G, np.int64)
+        res = res2
+
+    x = np.asarray(res.x)
+    n_int = np.floor(x + 1e-9).astype(np.int64)
+    K_all = np.stack([k for _, k in pats], axis=1).astype(np.int64)
+    served = K_all @ n_int
+    over = np.maximum(served - rem_z, 0)
+    per_opt: Dict[int, List[np.ndarray]] = {}
+    for (o, k), n in zip(pats, n_int):
+        if n > 0:
+            per_opt.setdefault(o, []).append(np.repeat(k[:, None], n, axis=1))
+    opens: List[Opened] = []
+    served_exact = np.zeros(G, np.int64)
+    for o, blocks in per_opt.items():
+        ys = np.concatenate(blocks, axis=1)
+        for g in np.flatnonzero(over):
+            if over[g] == 0 or not ys[g].any():
+                continue
+            row = ys[g]
+            cum = np.cumsum(row)
+            drop = np.minimum(row, np.maximum(0, over[g] - (cum - row)))
+            ys[g] = row - drop
+            over[g] -= int(drop.sum())
+        keep = ys.sum(axis=0) > 0
+        ys = ys[:, keep]
+        if ys.shape[1]:
+            opens.append(Opened(option=o, nodes=ys.shape[1], ys=ys))
+            served_exact += ys.sum(axis=1)
+    return opens, served_exact
+
+
+def _residual_ffd(solver, problem, res_count: np.ndarray, res_quota: np.ndarray):
+    """Pack the residual demand with the host FFD portfolio on count/quota
+    patched inputs. Returns a list of (option, contents[G]) single nodes, or
+    None when no member places everything."""
+    from .host_pack import host_pack, host_shared
+
+    G = problem.G
+    inputs, orders, alphas, looks, rsvs, swaps, s_new, n_zones = solver._prepare(problem)
+    cnt2 = np.asarray(inputs.count).copy()
+    cnt2[:G] = res_count.astype(cnt2.dtype)
+    q2 = np.asarray(inputs.quota).copy()
+    q2[:G, :] = np.clip(res_quota[:, :n_zones], 0, np.iinfo(q2.dtype).max).astype(q2.dtype)
+    inputs2 = inputs._replace(count=cnt2, quota=q2)
+    shared = host_shared(inputs2)
+    price = problem.price.astype(np.float64)
+    orders_np = np.asarray(orders)
+    alphas_np = np.asarray(alphas)
+    looks_np = np.asarray(looks)
+    best = None
+    for mi in range(orders_np.shape[0]):
+        out = host_pack(
+            inputs2, shared, orders_np[mi], s_new, n_zones,
+            alpha=float(alphas_np[mi]), look=bool(looks_np[mi]),
+        )
+        if out is None:
+            continue
+        new_opt, new_active, ys, unplaced = out
+        if unplaced > 0:
+            continue
+        act = np.flatnonzero(new_active)
+        cost_m = float(price[new_opt[act]].sum())
+        if best is None or cost_m < best[0]:
+            best = (cost_m, new_opt, new_active, ys, orders_np[mi])
+    if best is None:
+        return None
+    _, new_opt, new_active, ys_slots, order_used = best
+    # ys columns cover [Ep existing (padded) slots] + [s_new new slots], while
+    # new_opt/new_active index the NEW slots only — offset by the PADDED
+    # existing count, not problem.E
+    ep = ys_slots.shape[1] - new_opt.shape[0]
+    nodes = []
+    for j in np.flatnonzero(new_active):
+        k = np.zeros(G, np.int64)
+        for t in range(order_used.shape[0]):
+            g = int(order_used[t])
+            if g < G and ys_slots[t, ep + j]:
+                k[g] += int(ys_slots[t, ep + j])
+        if k.sum():
+            nodes.append((int(new_opt[j]), k))
+    return nodes
+
+
+def _capped_rr(
+    problem, opt_arr: np.ndarray, ys_arr: np.ndarray, caps: np.ndarray,
+    deadline: Optional[float], rounds: int = 8, frac: float = 0.10,
+):
+    """Zone-preserving, cap-respecting ruin-recreate on flattened node arrays.
+    Freed pods re-enter THEIR zone (quota totals unchanged); refills respect
+    per-node caps; a round is accepted only when every freed pod is placed
+    (counts exact) AND cost strictly drops."""
+    d = problem.demand.astype(np.float64)
+    alloc = problem.alloc.astype(np.float64)
+    price = problem.price.astype(np.float64)
+    units, rate = _units_rate(problem)
+    lam = rate.min(axis=1)
+    lam = np.where(np.isfinite(lam), lam, 0.0)
+    G = problem.G
+    Z = int(problem.opt_zone.max()) + 1 if problem.O else 1
+
+    for _ in range(rounds):
+        if deadline is not None and time.perf_counter() > deadline:
+            break
+        N = opt_arr.shape[0]
+        if N <= 1:
+            break
+        dens = (lam @ ys_arr) / np.maximum(price[opt_arr], 1e-12)
+        kkill = max(4, int(N * frac))
+        kill_idx = np.argsort(dens, kind="stable")[:kkill]
+        keep = np.ones(N, bool)
+        keep[kill_idx] = False
+        freed_z = np.zeros((G, Z), np.int64)
+        for j in kill_idx:
+            freed_z[:, problem.opt_zone[opt_arr[j]]] += ys_arr[:, j]
+        trial_ys = ys_arr[:, keep].copy()
+        trial_opt = opt_arr[keep]
+        new_nodes: List[Tuple[int, np.ndarray]] = []
+        placed_all = True
+        slack = alloc[trial_opt] - (trial_ys.T.astype(np.float64) @ d)
+        fill_order = np.argsort(-(d[:, 0] + d[:, 1] / 2**30), kind="stable")
+        for z in range(Z):
+            rem_v = freed_z[:, z].copy()
+            if rem_v.sum() == 0:
+                continue
+            zmask = problem.opt_zone[trial_opt] == z
+            for j in np.flatnonzero(zmask):
+                if rem_v.sum() == 0:
+                    break
+                a = slack[j]
+                for g in fill_order:
+                    if rem_v[g] <= 0 or not problem.compat[g, trial_opt[j]]:
+                        continue
+                    while (
+                        rem_v[g] > 0
+                        and trial_ys[g, j] < caps[g]
+                        and np.all(d[g] <= a + 1e-12)
+                    ):
+                        trial_ys[g, j] += 1
+                        a -= d[g]
+                        rem_v[g] -= 1
+            cols_z = np.flatnonzero(problem.opt_zone == z)
+            guard = 0
+            while rem_v.sum() > 0 and guard < 512:
+                guard += 1
+                wl = np.where(rem_v > 0, lam, 0.0)
+                K = _price_patterns_capped(
+                    problem, cols_z, wl, caps, cap_extra=np.maximum(rem_v, 0)
+                )
+                K_lim = np.minimum(K, rem_v[None, :])
+                util = (K_lim @ lam) / np.maximum(price[cols_z], 1e-9)
+                oi = int(np.argmax(util))
+                if util[oi] <= 0:
+                    break
+                new_nodes.append((int(cols_z[oi]), K_lim[oi].copy()))
+                rem_v -= K_lim[oi]
+            if rem_v.sum() > 0:
+                placed_all = False
+                break
+        if not placed_all:
+            break
+        new_cost = float(price[trial_opt].sum()) + sum(price[o] for o, _ in new_nodes)
+        if new_cost >= float(price[opt_arr].sum()) - 1e-9:
+            break
+        if new_nodes:
+            opt_arr = np.concatenate(
+                [trial_opt, np.asarray([o for o, _ in new_nodes], np.int64)]
+            )
+            ys_arr = np.concatenate(
+                [trial_ys, np.stack([k for _, k in new_nodes], axis=1)], axis=1
+            )
+        else:
+            opt_arr, ys_arr = trial_opt, trial_ys
+    return opt_arr, ys_arr
+
+
+def topo_improve(
+    problem: EncodedProblem,
+    solver,
+    incumbent_cost: float,
+    deadline: Optional[float] = None,
+    min_pods: int = 2000,
+):
+    """Build a zone-decomposed pattern plan for a topology-constrained problem
+    and return a validated SolveResult when it strictly beats
+    ``incumbent_cost``; None otherwise.
+
+    Engages from the SECOND solve of the same problem (one-shot solves pay
+    ~nothing); the finished plan — or the fact that the build could not beat
+    FFD — is cached per problem, so the bounded build spike happens at most
+    once and steady-state re-solves are a dict hit."""
+    if not _HAVE_SCIPY or not _supported(problem):
+        return None
+    if problem.count.sum() < min_pods:
+        return None
+    key = id(problem)
+    cached = _state_cache.get(key)
+    if cached is not None and cached[0] is problem:
+        finished = cached[1]
+        if finished is None:
+            return None  # tried and failed; incumbent stands permanently
+        result, cost = finished
+        if cost >= incumbent_cost - 1e-9:
+            return None
+        # fresh shell per return: callers stamp stats (total_solve_s) on what
+        # we hand them, and that must never rewrite the cached object
+        import dataclasses
+
+        return dataclasses.replace(result, stats=dict(result.stats))
+    if _seen.get(key) is not problem:
+        _seen[key] = problem
+        return None
+    # one-time build, bounded like the pattern-CG warmup spike: steady-state
+    # latency is the contract, a single bounded spike buys the optimal plan
+    if deadline is not None:
+        deadline = max(deadline, time.perf_counter() + 0.6)
+
+    from .solver import _zone_quotas  # local import: solver imports this module's caller
+
+    G = problem.G
+    count = problem.count.astype(np.int64)
+    caps = np.minimum(problem.node_cap.astype(np.int64), _IBIG)
+    n_zones = len(problem.zones)
+    quota = _zone_quotas(problem, n_zones).astype(np.int64)
+
+    def finish(entry):
+        from .patterns import _cache_put
+
+        _cache_put(_state_cache, key, (problem, entry), _STATE_CACHE_MAX)
+        if entry is None:
+            return None
+        result, cost = entry
+        if cost >= incumbent_cost - 1e-9:
+            return None
+        import dataclasses
+
+        return dataclasses.replace(result, stats=dict(result.stats))
+
+    rem_gz = _zone_split(problem, quota)
+    if rem_gz is None:
+        return finish(None)
+
+    bulk_opens: List[Opened] = []
+    bulk_gz = np.zeros((G, n_zones), np.int64)
+    for z in range(n_zones):
+        rem_z = rem_gz[:, z]
+        if rem_z.sum() == 0:
+            continue
+        opens_z, served_z = _zone_bulk(problem, z, rem_z.copy(), caps, deadline)
+        # bulk must never exceed the zone demand (trim guarantees this)
+        if np.any(served_z > rem_z):
+            return finish(None)
+        bulk_opens.extend(opens_z)
+        bulk_gz[:, z] = served_z
+
+    res_count = count - bulk_gz.sum(axis=1)
+    if (res_count < 0).any():
+        return finish(None)
+    res_quota = np.where(
+        quota[:, :n_zones] < _IBIG,
+        np.maximum(quota[:, :n_zones] - bulk_gz, 0),
+        quota[:, :n_zones],
+    )
+    nodes: List[Tuple[int, np.ndarray]] = []
+    if res_count.sum() > 0:
+        packed = _residual_ffd(solver, problem, res_count, res_quota)
+        if packed is None:
+            return finish(None)
+        nodes = packed
+
+    # flatten: bulk columns + residual single nodes
+    cols_o: List[int] = []
+    ks: List[np.ndarray] = []
+    for op in bulk_opens:
+        ys = op.placements(G)
+        for j in range(ys.shape[1]):
+            cols_o.append(op.option)
+            ks.append(ys[:, j])
+    for o, k in nodes:
+        cols_o.append(o)
+        ks.append(k)
+    if not ks:
+        return finish(None)
+    opt_arr = np.asarray(cols_o, np.int64)
+    ys_arr = np.stack(ks, axis=1)
+
+    opt_arr, ys_arr = _capped_rr(problem, opt_arr, ys_arr, caps, deadline)
+
+    # exactness gate + full validation
+    if not np.array_equal(ys_arr.sum(axis=1), count):
+        return finish(None)
+    per_opt: Dict[int, List[np.ndarray]] = {}
+    for j in range(opt_arr.shape[0]):
+        if ys_arr[:, j].sum() > 0:
+            per_opt.setdefault(int(opt_arr[j]), []).append(ys_arr[:, j])
+    opens = [
+        Opened(option=o, nodes=len(cs), ys=np.stack(cs, axis=1))
+        for o, cs in per_opt.items()
+    ]
+    from .host import _check_counts, _decode
+    from .validate import validate
+
+    placements = np.zeros((G, problem.E), np.int64)
+    leftover = np.zeros(G, np.int64)
+    if _check_counts(problem, placements, opens, leftover):
+        return finish(None)
+    result = _decode(problem, placements, opens, leftover)
+    if validate(problem, result) != []:
+        return finish(None)
+    cost = plan_cost(problem, opens)
+    result.stats["backend"] = 2.0
+    result.stats["topo_patterns"] = 1.0
+    result.stats["validated_counts"] = 1.0
+    return finish((result, cost))
