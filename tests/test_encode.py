@@ -297,3 +297,67 @@ class TestAdjacencyGrouping:
         a = make_pods(3, labels={"app": "x"})
         b = make_pods(3, labels={"app": "y"})
         assert len(group_pods(a + b)) == 2
+
+
+class TestVocabCompactionInvalidation:
+    """Vocab compaction renumbers value codes; every cache embedding codes
+    (surface columns, roster tables, option tables) must invalidate, or stale
+    codes silently corrupt compat masks."""
+
+    @staticmethod
+    def _enc():
+        # the solver package re-exports encode() the FUNCTION under the same
+        # name as the module, so plain attribute imports resolve wrong
+        import importlib
+
+        return importlib.import_module("karpenter_tpu.solver.encode")
+
+    @staticmethod
+    def _node(name, zone="zone-a"):
+        from karpenter_tpu.api import Node, ObjectMeta
+
+        return Node(
+            meta=ObjectMeta(name=name, labels={wk.ZONE: zone, wk.INSTANCE_TYPE: "m5.large"}),
+            capacity={"cpu": 4, "memory": 8 * 1024**3, "pods": 58},
+            allocatable={"cpu": 3.5, "memory": 7 * 1024**3, "pods": 58},
+            ready=True,
+        )
+
+    def test_all_code_embedding_caches_invalidate(self, monkeypatch):
+        enc = self._enc()
+        node = self._node("vocab-n-1")
+        surface = enc._node_surface(node)
+        cols_before = enc._surface_columns(surface)
+        table_before = enc._get_surface_table([surface])
+        options = build_options(setup(3))
+        opt_table_before = enc._get_option_table(options)
+        # drop the threshold so the NEXT build boundary compacts — the real
+        # compaction path does the clearing (no manual global surgery)
+        monkeypatch.setattr(enc, "_VOCAB_MAX", 1)
+        enc._maybe_compact_vocab()
+        assert len(enc._VOCAB) == 0  # compacted
+        cols_after = enc._surface_columns(surface)
+        table_after = enc._get_surface_table([surface])
+        opt_table_after = enc._get_option_table(options)
+        assert cols_after is not cols_before  # rebuilt under the new generation
+        assert table_after is not table_before
+        assert opt_table_after is not opt_table_before
+        # and evaluation still works end-to-end after compaction
+        pods = make_pods(3, node_selector={wk.ZONE: "zone-a"})
+        prob = encode(pods, setup(5))
+        assert prob.compat.any()
+
+    def test_mixed_generation_reuse_never_serves_stale(self, monkeypatch):
+        """A surface interned before compaction must produce a fresh table
+        after it — same objects, new codes, correct eval."""
+        enc = self._enc()
+        node = self._node("vocab-n-2", zone="zone-b")
+        surface = enc._node_surface(node)
+        enc._get_surface_table([surface])
+        monkeypatch.setattr(enc, "_VOCAB_MAX", 1)
+        enc._maybe_compact_vocab()
+        table = enc._get_surface_table([surface])
+        ok = table.eval_requirement(Requirement.in_values(wk.ZONE, ["zone-b"]))
+        assert ok[0]  # correct answer under the fresh code generation
+        bad = table.eval_requirement(Requirement.in_values(wk.ZONE, ["zone-a"]))
+        assert not bad[0]
