@@ -76,6 +76,8 @@ class DeprovisioningController:
         self.recorder = recorder or Recorder()
         self.clock = clock or Clock()
         self.pending_action: Optional[PlannedAction] = None
+        # sweep-scoped existing-capacity snapshot (see _consolidation)
+        self._sweep_capacity = None
         # Stabilization window (designs/consolidation.md:59-67): consolidation
         # waits until the node population has been quiet for the whole window.
         self._last_node_change = float("-inf")
@@ -221,15 +223,23 @@ class DeprovisioningController:
         if not candidates:
             return None
         candidates.sort(key=self._disruption_cost)
-        # multi-node first (2..N cheapest-to-disrupt prefix), then single
-        multi = self._try_multi_node(candidates)
-        if multi is not None:
-            return multi
-        for node in candidates:
-            action = self._try_single_node(node)
-            if action is not None:
-                return action
-        return None
+        # The whole sweep is a READ-ONLY what-if over one cluster snapshot
+        # (the chosen action executes after), so the existing-capacity view is
+        # computed once here instead of once per candidate simulation —
+        # rebuilding it was the dominant cost of a 200-node sweep.
+        self._sweep_capacity = self.cluster.existing_capacity()
+        try:
+            # multi-node first (2..N cheapest-to-disrupt prefix), then single
+            multi = self._try_multi_node(candidates)
+            if multi is not None:
+                return multi
+            for node in candidates:
+                action = self._try_single_node(node)
+                if action is not None:
+                    return action
+            return None
+        finally:
+            self._sweep_capacity = None
 
     def _consolidatable(self) -> List[Node]:
         out = []
@@ -376,34 +386,60 @@ class DeprovisioningController:
         most `max_new` new nodes (each strictly cheaper than `price_ceiling`, when
         one is set)?
 
+        The ceiling is checked on the RESULT first: the cost-minimizing solve
+        usually opens the cheapest fitting node, so most simulations keep the
+        provider's instance-type list identity-stable and the encoder's
+        identity-validated caches (launch options, requirement tables) hit
+        instead of rebuilding per candidate. Only when that fast path rejects
+        on price does the simulation re-run against a ceiling-FILTERED catalog
+        — that is the one case where the answers can genuinely differ (e.g. a
+        preferred affinity satisfiable only on an over-ceiling node: the
+        filtered catalog makes the pod initially unschedulable, the relaxation
+        pass sheds the preference, and an under-ceiling replacement appears).
+
         Returns (feasible, replacement_specs). Conservative: any unschedulable pod
         or more than `max_new` new nodes means infeasible (never strand a pod).
         `max_new=None` lifts the cap (drift/expiration replacements).
         """
-        existing = [
-            e
-            for e in self.cluster.existing_capacity()
-            if e.node.name not in set(exclude)
+        capacity = self._sweep_capacity
+        if capacity is None:
+            capacity = self.cluster.existing_capacity()
+        excluded = set(exclude)
+        existing = [e for e in capacity if e.node.name not in excluded]
+        provisioners = [
+            (prov, self.provider.get_instance_types(prov))
+            for prov in self.cluster.provisioners.values()
         ]
-        provisioners = []
-        for prov in self.cluster.provisioners.values():
-            types = []
-            for it in self.provider.get_instance_types(prov):
-                offerings = [
-                    o
-                    for o in it.offerings
-                    if o.available
-                    and (price_ceiling is None or o.price < price_ceiling - 1e-9)
-                ]
-                if offerings:
-                    types.append(it.with_offerings(offerings))
-            provisioners.append((prov, types))
         result = self.solver.solve_pods(
             list(pods), provisioners, existing=existing, daemonsets=self.cluster.daemonsets()
         )
+        over_ceiling = price_ceiling is not None and any(
+            n.option.price >= price_ceiling - 1e-9 for n in result.new_nodes
+        )
+        if over_ceiling and not result.unschedulable:
+            # slow path: pre-filter the catalog and let relaxation work
+            # against only under-ceiling options (old semantics, rare case)
+            filtered = []
+            for prov in self.cluster.provisioners.values():
+                types = []
+                for it in self.provider.get_instance_types(prov):
+                    kept = [
+                        o for o in it.offerings
+                        if o.available and o.price < price_ceiling - 1e-9
+                    ]
+                    if kept:
+                        types.append(it.with_offerings(kept))
+                filtered.append((prov, types))
+            result = self.solver.solve_pods(
+                list(pods), filtered, existing=existing,
+                daemonsets=self.cluster.daemonsets(),
+            )
+            over_ceiling = False
         if result.unschedulable:
             return False, []
         if max_new is not None and len(result.new_nodes) > max_new:
+            return False, []
+        if over_ceiling:
             return False, []
         return True, list(result.new_nodes)
 

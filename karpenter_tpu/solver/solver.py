@@ -431,6 +431,12 @@ class TPUSolver(Solver):
         # device answer that is known to be no better. Any change to the
         # cluster produces a new encode (new object) and races afresh.
         kernel_hopeless = problem.__dict__.get("_race_kernel_lost", False)
+        # Tiny problems never race the device: the host paths answer in
+        # single-digit ms, while a dispatch costs a round trip AND (for a
+        # fresh shape) spawns a background XLA compile that steals CPU from
+        # whatever comes next. Consolidation candidate simulations — dozens
+        # of fresh few-pod problems per sweep — are the canonical case.
+        tiny = int(problem.count.sum()) < 450
         # A kernel result that WON a race on this problem is deterministic for
         # the unchanged problem: repeat solves compare the cached answer
         # against the (still-improving) host plan instead of re-paying the
@@ -438,6 +444,7 @@ class TPUSolver(Solver):
         kernel_cached = problem.__dict__.get("_race_kernel_result")
         if (
             not quality
+            and not tiny
             and not kernel_hopeless
             and kernel_cached is None
             and self.device_rtt() < self.latency_budget_s
@@ -491,7 +498,7 @@ class TPUSolver(Solver):
                 # quality mode (generous budget): synchronous race, compile and
                 # all — consolidation sweeps and tests that want the best answer
                 kernel_result = self._solve_kernel(problem)
-            elif kernel_hopeless:
+            elif kernel_hopeless or tiny:
                 kernel_result = None
             elif kernel_cached is not None:
                 # serve a fresh shell each time: the cached object's stats

@@ -308,3 +308,70 @@ class TestSweepDeadline:
         deprov.settings.consolidation_timeout = 30.0
         _sparse_two_nodes(cluster, provider)
         assert deprov._try_multi_node(deprov._consolidatable()) is not None
+
+
+class TestSimulationCeilingSemantics:
+    """The price ceiling is enforced on the RESULT (cheapest fitting node),
+    not by pre-filtering the catalog: equivalent for max_new=1 — if the
+    cheapest fitting node is at/over the ceiling, no under-ceiling node fits
+    — and it keeps the provider's instance-type list identity-stable so
+    encoder caches hit across a sweep's dozens of simulations."""
+
+    def test_replacement_over_ceiling_is_infeasible(self):
+        cluster, provider, ctl, deprov, clock = make_env(
+            make_provisioner(consolidation_enabled=True)
+        )
+        cluster.add_pod(make_pod(name="big", cpu="2", memory="4Gi"))
+        ctl.reconcile()
+        (node,) = cluster.nodes.values()
+        pods = [p for p in cluster.pods.values() if not p.is_daemonset]
+        # a ceiling below any node that can host the pod -> infeasible
+        fits, reps = deprov._simulate(pods, exclude=[node.name], price_ceiling=1e-9)
+        assert not fits
+        # a generous ceiling -> feasible with a strictly cheaper replacement
+        fits, reps = deprov._simulate(pods, exclude=[node.name], price_ceiling=1e9)
+        assert fits
+        assert all(r.option.price < 1e9 for r in reps)
+
+    def test_simulations_reuse_provider_type_lists(self, monkeypatch):
+        """Two simulations in one sweep must hand the encoder the SAME
+        instance-type list object (the identity the caches key on)."""
+        cluster, provider, ctl, deprov, clock = make_env(
+            make_provisioner(consolidation_enabled=True)
+        )
+        cluster.add_pod(make_pod(name="w", cpu="250m"))
+        ctl.reconcile()
+        (node,) = cluster.nodes.values()
+        pods = [p for p in cluster.pods.values() if not p.is_daemonset]
+        seen = []
+        orig = deprov.solver.solve_pods
+
+        def spy(pods_a, provisioners, **kw):
+            seen.append(tuple(id(t) for _, t in provisioners))
+            return orig(pods_a, provisioners, **kw)
+
+        monkeypatch.setattr(deprov.solver, "solve_pods", spy)
+        deprov._simulate(pods, exclude=[node.name], price_ceiling=1e9)
+        deprov._simulate(pods, exclude=[node.name], price_ceiling=1e9)
+        assert len(seen) == 2 and seen[0] == seen[1], (
+            "simulations must pass identity-stable type lists to the encoder"
+        )
+
+
+class TestTinyProblemRacePolicy:
+    def test_small_solves_never_dispatch_kernel(self, monkeypatch):
+        """Problems under the race floor (consolidation simulations) must not
+        touch the device: no dispatch, no background compile threads."""
+        from karpenter_tpu.solver import TPUSolver, encode
+
+        pods = make_pods(40, cpu="250m")
+        from helpers import setup
+
+        problem = encode(pods, setup(10))
+        s = TPUSolver(portfolio=4)
+        calls = []
+        monkeypatch.setattr(s, "_dispatch_async", lambda pr: calls.append(pr) or None)
+        r = s.solve(problem)
+        r2 = s.solve(problem)  # repeat solves skip too
+        assert calls == []
+        assert not r.unschedulable and not r2.unschedulable
