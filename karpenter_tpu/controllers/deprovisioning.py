@@ -416,9 +416,13 @@ class DeprovisioningController:
         over_ceiling = price_ceiling is not None and any(
             n.option.price >= price_ceiling - 1e-9 for n in result.new_nodes
         )
-        if over_ceiling and not result.unschedulable:
+        if price_ceiling is not None and (over_ceiling or result.unschedulable):
             # slow path: pre-filter the catalog and let relaxation work
-            # against only under-ceiling options (old semantics, rare case)
+            # against only under-ceiling options (old semantics). Runs on ANY
+            # fast-path divergence — over-ceiling replacement OR stranded
+            # pods — because heuristic packers are not monotone in the option
+            # set: an over-ceiling node can attract pods and strand one that
+            # the filtered catalog places fine
             filtered = []
             for prov in self.cluster.provisioners.values():
                 types = []

@@ -673,6 +673,14 @@ class TPUSolver(Solver):
                 time.sleep(0.0005)
             if not buf.is_ready():
                 self._race_fails += 1
+                # per-problem miss memory: two deadline misses on the SAME
+                # problem and repeat solves stop waiting on the device for it
+                # (the process-level breaker still half-open-probes, so a
+                # recovered device resumes racing on NEW problems)
+                misses = problem.__dict__.get("_race_miss_count", 0) + 1
+                problem.__dict__["_race_miss_count"] = misses
+                if misses >= 2:
+                    problem.__dict__["_race_kernel_lost"] = True
                 return None
             self._race_fails = 0
             k = orders.shape[0]

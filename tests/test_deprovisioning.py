@@ -339,6 +339,11 @@ class TestSimulationCeilingSemantics:
         cluster, provider, ctl, deprov, clock = make_env(
             make_provisioner(consolidation_enabled=True)
         )
+        # the provider's type cache keys on a 60s staleness bucket; pin the
+        # clock so a minute-boundary rollover can't flake the identity check
+        import time as _time
+
+        monkeypatch.setattr(_time, "time", lambda: 1_000_000.0)
         cluster.add_pod(make_pod(name="w", cpu="250m"))
         ctl.reconcile()
         (node,) = cluster.nodes.values()

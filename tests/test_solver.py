@@ -376,3 +376,26 @@ class TestMeshSharding:
             len(s.pod_names) for s in single.new_nodes
         )
         assert_feasible_and_complete(problem, multi, 40)
+
+
+class TestRaceMissMemory:
+    def test_two_misses_bench_the_problem(self, monkeypatch):
+        """Two deadline misses on the SAME problem mark it kernel-lost; one
+        miss does not (a transient stall must not bench the device)."""
+        from helpers import make_pods, setup as _setup
+
+        problem = encode(make_pods(4, cpu="250m"), _setup(5))
+        s = TPUSolver(portfolio=4)
+
+        class NeverReady:
+            def is_ready(self):
+                return False
+
+        dispatched = (NeverReady(), np.zeros((1, 1)), None, 4, 1, None)
+        import time as _t
+
+        s._poll_dispatch(problem, dispatched, deadline=_t.perf_counter(), host_cost=1.0)
+        assert problem.__dict__.get("_race_kernel_lost", False) is False
+        assert problem.__dict__["_race_miss_count"] == 1
+        s._poll_dispatch(problem, dispatched, deadline=_t.perf_counter(), host_cost=1.0)
+        assert problem.__dict__["_race_kernel_lost"] is True
