@@ -422,8 +422,10 @@ class DeprovisioningController:
             # fast-path divergence — over-ceiling replacement OR stranded
             # pods — because heuristic packers are not monotone in the option
             # set: an over-ceiling node can attract pods and strand one that
-            # the filtered catalog places fine
+            # the filtered catalog places fine. Skipped when the filter drops
+            # nothing: the re-solve would see the identical catalog.
             filtered = []
+            dropped = False
             for prov in self.cluster.provisioners.values():
                 types = []
                 for it in self.provider.get_instance_types(prov):
@@ -431,14 +433,22 @@ class DeprovisioningController:
                         o for o in it.offerings
                         if o.available and o.price < price_ceiling - 1e-9
                     ]
+                    # only a PRICE drop changes what the encoder would see —
+                    # unavailable offerings are skipped by the encoder anyway
+                    if any(
+                        o.available and o.price >= price_ceiling - 1e-9
+                        for o in it.offerings
+                    ):
+                        dropped = True
                     if kept:
                         types.append(it.with_offerings(kept))
                 filtered.append((prov, types))
-            result = self.solver.solve_pods(
-                list(pods), filtered, existing=existing,
-                daemonsets=self.cluster.daemonsets(),
-            )
-            over_ceiling = False
+            if dropped:
+                result = self.solver.solve_pods(
+                    list(pods), filtered, existing=existing,
+                    daemonsets=self.cluster.daemonsets(),
+                )
+                over_ceiling = False
         if result.unschedulable:
             return False, []
         if max_new is not None and len(result.new_nodes) > max_new:

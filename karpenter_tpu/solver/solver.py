@@ -683,6 +683,9 @@ class TPUSolver(Solver):
                     problem.__dict__["_race_kernel_lost"] = True
                 return None
             self._race_fails = 0
+            # the device answered: clear the per-problem miss streak too — two
+            # ISOLATED stalls with successes between them must not bench it
+            problem.__dict__.pop("_race_miss_count", None)
             k = orders.shape[0]
             Gp = inputs.count.shape[0]
             Ep = inputs.ex_valid.shape[0]

@@ -68,15 +68,56 @@ def _supported(problem: EncodedProblem) -> bool:
         return False
     if np.any(problem.colocate):
         return False
-    rel_active = any(
+    # Hostname-level cross-group COLOCATION (consumer requires provider on its
+    # node) is pattern-expressible: a pattern hosting a consumer must also
+    # contain a covering provider. Everything else relational — host forbids,
+    # zone-level needs/forbids, seeded bits from bound pods — stays with the
+    # FFD/kernel paths.
+    rel_unsupported = any(
         a is not None and np.any(a)
         for a in (
-            problem.rel_set, problem.rel_host_forbid, problem.rel_host_need,
-            problem.rel_zone_forbid, problem.rel_zone_need,
-            problem.rel_slot_bits, problem.rel_zone_bits,
+            problem.rel_host_forbid, problem.rel_zone_forbid,
+            problem.rel_zone_need, problem.rel_slot_bits, problem.rel_zone_bits,
         )
     )
-    return not rel_active
+    if rel_unsupported:
+        return False
+    hn = problem.rel_host_need
+    rs = problem.rel_set
+    if hn is not None and np.any(hn):
+        if rs is None:
+            return False
+        # every needed bit must be coverable by some provider group
+        all_set = int(np.bitwise_or.reduce(rs.astype(np.int64)))
+        if int(np.bitwise_or.reduce(hn.astype(np.int64))) & ~all_set:
+            return False
+    return True
+
+
+def _coverage_maps(problem: EncodedProblem):
+    """(hn[G], set_[G]) as int64 arrays (all zeros when no relations)."""
+    G = problem.G
+    hn = (
+        problem.rel_host_need.astype(np.int64)
+        if problem.rel_host_need is not None
+        else np.zeros(G, np.int64)
+    )
+    rs = (
+        problem.rel_set.astype(np.int64)
+        if problem.rel_set is not None
+        else np.zeros(G, np.int64)
+    )
+    return hn, rs
+
+
+def _apportion(share: np.ndarray, total: int) -> np.ndarray:
+    """Largest-remainder apportionment of ``total`` along ``share`` (sums to
+    exactly ``total``)."""
+    out = np.floor(share * total).astype(np.int64)
+    residue = int(total - out.sum())
+    for z in np.argsort(-(share * total - out), kind="stable")[:residue]:
+        out[z] += 1
+    return out
 
 
 def _zone_split(problem: EncodedProblem, quota: np.ndarray) -> Optional[np.ndarray]:
@@ -108,11 +149,7 @@ def _zone_split(problem: EncodedProblem, quota: np.ndarray) -> Optional[np.ndarr
             if flows.sum() <= 0:
                 flows = np.ones(Z)
             share = flows / flows.sum()
-            az = np.floor(share * count[g]).astype(np.int64)
-            residue = int(count[g] - az.sum())
-            for z in np.argsort(-(share * count[g] - az), kind="stable")[:residue]:
-                az[z] += 1
-            az = np.minimum(az, quota[g])
+            az = np.minimum(_apportion(share, int(count[g])), quota[g])
             over = int(count[g] - az.sum())
             zi = 0
             while over > 0 and zi < 4 * Z:
@@ -125,25 +162,81 @@ def _zone_split(problem: EncodedProblem, quota: np.ndarray) -> Optional[np.ndarr
             if over > 0:
                 return None  # quota-infeasible split; incumbent stands
             rem_gz[g] = az
+    # Colocation coupling: a consumer pod needs a covering provider ON ITS
+    # NODE, so zones with no provider pods cannot host the consumer. Move
+    # stranded consumer demand into provider-present zones (proportionally).
+    hn, rs = _coverage_maps(problem)
+    for g in np.flatnonzero(hn):
+        provs = np.flatnonzero((rs & int(hn[g])) != 0)
+        prov_z = rem_gz[provs].sum(axis=0)
+        bad = (prov_z == 0) & (rem_gz[g] > 0)
+        if not bad.any():
+            continue
+        move = int(rem_gz[g][bad].sum())
+        rem_gz[g][bad] = 0
+        good = np.flatnonzero(prov_z > 0)
+        if good.size == 0:
+            return None
+        share = prov_z[good] / prov_z[good].sum()
+        add = _apportion(share, move)
+        capped = np.minimum(add, np.maximum(quota[g][good] - rem_gz[g][good], 0))
+        if capped.sum() < add.sum():
+            return None  # quota blocks the coupled split
+        rem_gz[g][good] += add
     return rem_gz
 
 
-def _greedy_pattern(problem, o: int, weights: np.ndarray, caps: np.ndarray) -> np.ndarray:
+def _greedy_pattern(
+    problem, o: int, weights: np.ndarray, caps: np.ndarray,
+    cap_extra: Optional[np.ndarray] = None,
+) -> np.ndarray:
     d = problem.demand.astype(np.float64)
     a = problem.alloc.astype(np.float64)[o].copy()
     G = problem.G
     k = np.zeros(G, np.int64)
     compat = problem.compat[:, o]
+    caps = caps if cap_extra is None else np.minimum(caps, cap_extra)
+    hn, rs = _coverage_maps(problem)
+    covered = 0
     for _ in range(64):
-        fm = np.all(d <= a[None, :] + 1e-12, axis=1) & compat & (weights > 0) & (k < caps)
+        ok_rel = (hn & ~covered) == 0  # consumer addable only when covered
+        fm = (
+            np.all(d <= a[None, :] + 1e-12, axis=1)
+            & compat & (weights > 0) & (k < caps) & ok_rel
+        )
         if not fm.any():
-            break
+            # try opening coverage: add ONE provider pod for the
+            # best-weighted blocked consumer, then retry
+            blocked = (
+                np.all(d <= a[None, :] + 1e-12, axis=1)
+                & compat & (weights > 0) & (k < caps) & ~ok_rel
+            )
+            if not blocked.any():
+                break
+            g_c = int(np.argmax(np.where(blocked, weights, -1)))
+            need = int(hn[g_c]) & ~covered
+            provs = np.flatnonzero((rs & need) != 0)
+            added = False
+            for g_p in provs[np.argsort(d[provs].sum(axis=1))]:
+                if (
+                    compat[g_p] and k[g_p] < caps[g_p]
+                    and np.all(d[g_p] <= a + 1e-12)
+                ):
+                    k[g_p] += 1
+                    a -= d[g_p]
+                    covered |= int(rs[g_p])
+                    added = True
+                    break
+            if not added:
+                break
+            continue
         g = int(np.argmax(np.where(fm, weights, -1)))
         with np.errstate(divide="ignore", invalid="ignore"):
             m = np.min(np.where(d[g] > 0, a / np.maximum(d[g], 1e-30), np.inf))
         m = max(1, int(min(np.floor(m + 1e-9), caps[g] - k[g])) // 2)
         k[g] += m
         a -= d[g] * m
+        covered |= int(rs[g])
     return k
 
 
@@ -162,9 +255,37 @@ def _price_patterns_capped(
     k = np.zeros((O, G), np.int64)
     pos = duals > 0
     live = np.ones(O, bool)
+    hn, rs = _coverage_maps(problem)
+    has_rel = bool(np.any(hn))
+    covered = np.zeros(O, np.int64)  # per-pattern union of set bits
     for _ in range(48):
         fits = np.all(d[None, :, :] <= a[:, None, :] + 1e-12, axis=2)
         fits &= compat & pos[None, :] & (k < lim[None, :])
+        if has_rel:
+            # a consumer may only join a pattern whose providers cover it; a
+            # blocked consumer's value is instead ATTRIBUTED to adding its
+            # cheapest covering provider (amortized over the consumer dual)
+            uncovered = (hn[None, :] & ~covered[:, None]) != 0
+            blocked = fits & uncovered
+            fits &= ~uncovered
+            if blocked.any():
+                for oi, g_c in zip(*np.nonzero(blocked)):
+                    need = int(hn[g_c]) & ~int(covered[oi])
+                    provs = np.flatnonzero((rs & need) != 0)
+                    for g_p in provs:
+                        if (
+                            compat[oi, g_p]
+                            and k[oi, g_p] < lim[g_p]
+                            and np.all(d[g_p] <= a[oi] + 1e-12)
+                        ):
+                            k[oi, g_p] += 1
+                            a[oi] -= d[g_p]
+                            covered[oi] |= int(rs[g_p])
+                            break
+                # recompute fits with the new coverage
+                fits = np.all(d[None, :, :] <= a[:, None, :] + 1e-12, axis=2)
+                fits &= compat & pos[None, :] & (k < lim[None, :])
+                fits &= (hn[None, :] & ~covered[:, None]) == 0
         live &= fits.any(axis=1)
         if not live.any():
             break
@@ -183,6 +304,7 @@ def _price_patterns_capped(
         m = (np.minimum(np.maximum(1, m // 4), room) * ok).astype(np.int64)
         np.add.at(k, (np.arange(O), gs), m)
         a -= dsel * m[:, None]
+        covered |= np.where(m > 0, rs[gs], 0)
         live &= m > 0
     return k
 
@@ -223,11 +345,15 @@ def _zone_bulk(
 
     for o in cols:
         for w in (d[:, 0], d[:, 1], rem_z.astype(float)):
-            add(o, _greedy_pattern(problem, o, np.where(rem_z > 0, w, 0), caps))
+            add(o, _greedy_pattern(problem, o, np.where(rem_z > 0, w, 0), caps,
+                                   cap_extra=rem_z))
+    hn_seed, _rs_seed = _coverage_maps(problem)
     for g in np.flatnonzero(rem_z > 0):
+        if hn_seed[g]:
+            continue  # a consumer-only pattern violates colocation by design
         for o in cols:
             if problem.compat[g, o]:
-                u = int(min(units[g, o], caps[g]))
+                u = int(min(units[g, o], caps[g], rem_z[g]))
                 if u >= 1:
                     k = np.zeros(G, np.int64)
                     k[g] = u
@@ -250,7 +376,11 @@ def _zone_bulk(
             break
         duals = np.zeros(G)
         duals[act] = -np.asarray(res.ineqlin.marginals)
-        K = _price_patterns_capped(problem, cols, duals, caps)
+        # patterns never hold more than the remaining demand: a giant node
+        # carrying a fraction of a small remainder prices at a terrible
+        # rate, so the master picks right-sized columns whose counts floor
+        # cleanly instead of x<1 giants that floor to nothing
+        K = _price_patterns_capped(problem, cols, duals, caps, cap_extra=rem_z)
         vals = K @ duals
         fresh = 0
         for oi in np.flatnonzero(vals > price[cols] * (1 + 1e-6)):
@@ -275,22 +405,94 @@ def _zone_bulk(
             per_opt.setdefault(o, []).append(np.repeat(k[:, None], n, axis=1))
     opens: List[Opened] = []
     served_exact = np.zeros(G, np.int64)
+    hn, rs = _coverage_maps(problem)
+    # trim consumers before providers, and never strip the LAST covering
+    # provider pod from a node that still hosts dependent consumers
+    trim_order = sorted(range(G), key=lambda g: (rs[g] != 0, g))
     for o, blocks in per_opt.items():
         ys = np.concatenate(blocks, axis=1)
-        for g in np.flatnonzero(over):
+        for g in trim_order:
             if over[g] == 0 or not ys[g].any():
                 continue
-            row = ys[g]
-            cum = np.cumsum(row)
-            drop = np.minimum(row, np.maximum(0, over[g] - (cum - row)))
+            row = ys[g].copy()
+            if rs[g]:
+                # per-node floor: a dependent consumer present -> keep >= 1
+                dependents = np.flatnonzero((hn & int(rs[g])) != 0)
+                needed = (ys[dependents].sum(axis=0) > 0).astype(np.int64)
+                avail = np.maximum(row - needed, 0)
+            else:
+                avail = row
+            cum = np.cumsum(avail)
+            drop = np.minimum(avail, np.maximum(0, over[g] - (cum - avail)))
             ys[g] = row - drop
             over[g] -= int(drop.sum())
+        if over.any():
+            # pod-level trim blocked (e.g. the last covering provider under
+            # dependent consumers): peel WHOLE nodes hosting overserved
+            # groups — conservative, the freed pods rejoin the remainder
+            for g in np.flatnonzero(over):
+                while over[g] > 0 and ys[g].any():
+                    j = int(np.argmax(ys[g] > 0))
+                    over_g = ys[:, j].copy()
+                    ys[:, j] = 0
+                    over = np.maximum(over - over_g, 0)
         keep = ys.sum(axis=0) > 0
         ys = ys[:, keep]
         if ys.shape[1]:
             opens.append(Opened(option=o, nodes=ys.shape[1], ys=ys))
             served_exact += ys.sum(axis=1)
     return opens, served_exact
+
+
+def _residual_greedy(
+    problem, res_count: np.ndarray, res_quota: np.ndarray, caps: np.ndarray
+):
+    """Coverage-aware single-node best-fill for residuals the FFD strands —
+    typically consumer-heavy dregs whose providers the FFD packed too densely
+    to leave rider room. Quota-bounded groups are placed zone by zone; free
+    groups (colocation pairs included) pick the best option across all zones.
+    Returns [(option, contents[G])] or None when anything remains."""
+    G = problem.G
+    price = problem.price.astype(np.float64)
+    d = problem.demand.astype(np.float64)
+    value = d[:, 0] + d[:, 1] / 2**30
+    n_zones = int(problem.opt_zone.max()) + 1 if problem.O else 1
+    remaining = res_count.astype(np.int64).copy()
+    quota_fin = res_quota < _IBIG
+    nodes: List[Tuple[int, np.ndarray]] = []
+
+    def fill(cols: np.ndarray, lim: np.ndarray) -> np.ndarray:
+        placed = np.zeros(G, np.int64)
+        guard = 0
+        while lim.sum() > 0 and guard < 512:
+            guard += 1
+            wl = np.where(lim > 0, value, 0.0)
+            K = _price_patterns_capped(problem, cols, wl, caps, cap_extra=lim)
+            K_lim = np.minimum(K, lim[None, :])
+            util = (K_lim @ value) / np.maximum(price[cols], 1e-9)
+            oi = int(np.argmax(util))
+            if util[oi] <= 0:
+                break
+            kk = K_lim[oi]
+            nodes.append((int(cols[oi]), kk.copy()))
+            placed += kk
+            lim -= kk
+        return placed
+
+    for z in range(n_zones):
+        zone_lim = np.where(
+            quota_fin[:, z], np.minimum(res_quota[:, z], remaining), 0
+        ).astype(np.int64)
+        if zone_lim.sum() == 0:
+            continue
+        cols_z = np.flatnonzero(problem.opt_zone == z)
+        remaining -= fill(cols_z, zone_lim)
+    free_lim = np.where(quota_fin.any(axis=1), 0, remaining).astype(np.int64)
+    if free_lim.sum():
+        remaining -= fill(np.arange(problem.O), free_lim)
+    if remaining.sum() > 0:
+        return None
+    return nodes
 
 
 def _residual_ffd(solver, problem, res_count: np.ndarray, res_quota: np.ndarray):
@@ -361,6 +563,7 @@ def _capped_rr(
     lam = np.where(np.isfinite(lam), lam, 0.0)
     G = problem.G
     Z = int(problem.opt_zone.max()) + 1 if problem.O else 1
+    hn, rs = _coverage_maps(problem)  # loop-invariant
 
     for _ in range(rounds):
         if deadline is not None and time.perf_counter() > deadline:
@@ -382,6 +585,11 @@ def _capped_rr(
         placed_all = True
         slack = alloc[trial_opt] - (trial_ys.T.astype(np.float64) @ d)
         fill_order = np.argsort(-(d[:, 0] + d[:, 1] / 2**30), kind="stable")
+        # per-kept-node coverage (union of set bits of hosted groups)
+        node_cov = np.zeros(trial_opt.shape[0], np.int64)
+        if np.any(rs):
+            for g in np.flatnonzero(rs):
+                node_cov |= np.where(trial_ys[g] > 0, int(rs[g]), 0)
         for z in range(Z):
             rem_v = freed_z[:, z].copy()
             if rem_v.sum() == 0:
@@ -394,6 +602,8 @@ def _capped_rr(
                 for g in fill_order:
                     if rem_v[g] <= 0 or not problem.compat[g, trial_opt[j]]:
                         continue
+                    if hn[g] and (int(hn[g]) & ~int(node_cov[j])):
+                        continue  # consumer: node lacks a covering provider
                     while (
                         rem_v[g] > 0
                         and trial_ys[g, j] < caps[g]
@@ -402,6 +612,8 @@ def _capped_rr(
                         trial_ys[g, j] += 1
                         a -= d[g]
                         rem_v[g] -= 1
+                        if rs[g]:
+                            node_cov[j] |= int(rs[g])
             cols_z = np.flatnonzero(problem.opt_zone == z)
             guard = 0
             while rem_v.sum() > 0 and guard < 512:
@@ -506,19 +718,55 @@ def topo_improve(
     bulk_opens: List[Opened] = []
     bulk_gz = np.zeros((G, n_zones), np.int64)
     for z in range(n_zones):
-        rem_z = rem_gz[:, z]
-        if rem_z.sum() == 0:
-            continue
-        opens_z, served_z = _zone_bulk(problem, z, rem_z.copy(), caps, deadline)
-        # bulk must never exceed the zone demand (trim guarantees this)
-        if np.any(served_z > rem_z):
-            return finish(None)
-        bulk_opens.extend(opens_z)
-        bulk_gz[:, z] = served_z
+        rem_z = rem_gz[:, z].copy()
+        # iterate the floor: each CG pass floors its master's integral bulk
+        # and the next pass re-prices the shrunken remainder — colocation
+        # pairs stay inside pattern nodes at every level, so the FFD only
+        # ever sees dregs it can actually place
+        for _level in range(3):
+            if rem_z.sum() == 0:
+                break
+            opens_z, served_z = _zone_bulk(problem, z, rem_z.copy(), caps, deadline)
+            if np.any(served_z > rem_z):
+                return finish(None)
+            if served_z.sum() == 0:
+                break
+            bulk_opens.extend(opens_z)
+            bulk_gz[:, z] += served_z
+            rem_z -= served_z
 
     res_count = count - bulk_gz.sum(axis=1)
     if (res_count < 0).any():
         return finish(None)
+    # pair-consistency: residual consumers need residual providers (the FFD
+    # packs the residual in isolation and cannot see bulk nodes). Return
+    # whole provider-hosting bulk nodes to the residual until covered.
+    hn, rs = _coverage_maps(problem)
+    for g in np.flatnonzero(hn):
+        provs = np.flatnonzero((rs & int(hn[g])) != 0)
+        guard = 0
+        while res_count[g] > 0 and res_count[provs].sum() == 0 and guard < 64:
+            guard += 1
+            moved = False
+            for oi, op in enumerate(bulk_opens):
+                ys = op.placements(G)
+                cols_with = np.flatnonzero(ys[provs].sum(axis=0) > 0)
+                if cols_with.size == 0:
+                    continue
+                j = int(cols_with[0])
+                contents = ys[:, j].copy()
+                z = int(problem.opt_zone[op.option])
+                bulk_gz[:, z] -= contents
+                res_count += contents
+                ys2 = np.delete(ys, j, axis=1)
+                if ys2.shape[1]:
+                    bulk_opens[oi] = Opened(option=op.option, nodes=ys2.shape[1], ys=ys2)
+                else:
+                    bulk_opens.pop(oi)
+                moved = True
+                break
+            if not moved:
+                return finish(None)
     res_quota = np.where(
         quota[:, :n_zones] < _IBIG,
         np.maximum(quota[:, :n_zones] - bulk_gz, 0),
@@ -527,6 +775,9 @@ def topo_improve(
     nodes: List[Tuple[int, np.ndarray]] = []
     if res_count.sum() > 0:
         packed = _residual_ffd(solver, problem, res_count, res_quota)
+        if packed is None:
+            # consumer-heavy dregs the FFD strands: coverage-aware best-fill
+            packed = _residual_greedy(problem, res_count, res_quota, caps)
         if packed is None:
             return finish(None)
         nodes = packed

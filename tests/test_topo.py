@@ -74,8 +74,40 @@ class TestTopoImprove:
         assert out2 is not None and out2.cost == out1.cost
         assert time.perf_counter() - t0 < 0.05
 
-    def test_unsupported_shapes_bail(self):
-        # cross-group relation bits -> unsupported
+    def test_cross_group_colocation_supported_and_valid(self):
+        """Hostname colocation (consumer requires provider on its node) is
+        pattern-expressible: patterns carrying consumers always contain a
+        covering provider, and the validator must agree."""
+        pods = []
+        for j in range(120):
+            pods.append(Pod(meta=ObjectMeta(name=f"db-{j}", labels={"app": "db"}),
+                            requests=Resources(cpu="1", memory="2Gi")))
+        for j in range(480):
+            pods.append(Pod(
+                meta=ObjectMeta(name=f"web-{j}", labels={"app": "web"}),
+                requests=Resources(cpu="250m", memory="512Mi"),
+                affinity_terms=[PodAffinityTerm(label_selector={"app": "db"},
+                                                topology_key=wk.HOSTNAME)],
+            ))
+        # the filler mix tiles badly on the cheap nodes (2.0-cpu pods on
+        # 3.92-cpu allocatable): FFD leaves a real integrality gap for the
+        # pattern build to close
+        pods += [Pod(meta=ObjectMeta(name=f"f-{j}"),
+                     requests=Resources(cpu=["2", "250m"][j % 2], memory="512Mi"))
+                 for j in range(2400)]
+        prov = Provisioner(meta=ObjectMeta(name="default"))
+        p = encode(pods, [(prov, generate_catalog(n_types=40))])
+        assert _supported(p)
+        s = TPUSolver(portfolio=4)
+        base = s._solve_host_pack(p)
+        topo_improve(p, s, base.cost, deadline=time.perf_counter() + 3.0, min_pods=100)
+        out = topo_improve(p, s, base.cost, deadline=time.perf_counter() + 3.0, min_pods=100)
+        assert out is not None, "colocation pattern path must build on this shape"
+        assert out.cost < base.cost - 1e-9
+        assert validate(p, out) == []
+
+    def test_cross_group_anti_affinity_bails(self):
+        # cross-group hostname ANTI-affinity (host forbids) stays with FFD
         pods = []
         for j in range(40):
             pods.append(Pod(meta=ObjectMeta(name=f"db-{j}", labels={"app": "db"}),
@@ -85,7 +117,7 @@ class TestTopoImprove:
                 meta=ObjectMeta(name=f"web-{j}", labels={"app": "web"}),
                 requests=Resources(cpu="250m", memory="512Mi"),
                 affinity_terms=[PodAffinityTerm(label_selector={"app": "db"},
-                                                topology_key=wk.HOSTNAME)],
+                                                topology_key=wk.HOSTNAME, anti=True)],
             ))
         prov = Provisioner(meta=ObjectMeta(name="default"))
         p = encode(pods, [(prov, generate_catalog(n_types=20))])
