@@ -12,7 +12,15 @@ import time
 import numpy as np
 import pytest
 
-from karpenter_tpu.api import ObjectMeta, Pod, Provisioner, Resources
+from karpenter_tpu.api import (
+    ObjectMeta,
+    Pod,
+    PodAffinityTerm,
+    Provisioner,
+    Resources,
+    TopologySpreadConstraint,
+)
+from karpenter_tpu.api import labels as wk
 from karpenter_tpu.cloudprovider import generate_catalog
 from karpenter_tpu.solver import TPUSolver, encode, validate
 from karpenter_tpu.solver import host as H
@@ -142,3 +150,47 @@ class TestSolveAdaptiveTail:
         assert r_warm.cost <= r_cold.cost + 1e-9  # warm only improves
         # fresh object without adaptation must match the cold answer
         assert r_fresh.cost == pytest.approx(r_cold.cost, rel=1e-6)
+
+
+class TestPatternFuzz:
+    def test_random_instances_validate_and_never_regress(self):
+        """Seeded fuzz over random LP-safe and topology mixes: every repeat
+        solve must validate, and adaptation may only improve cost."""
+        rng = np.random.default_rng(1234)
+        cpus = ["100m", "250m", "500m", "1", "2"]
+        mems = ["256Mi", "512Mi", "1Gi", "2Gi", "4Gi"]
+        for trial in range(8):
+            pods = []
+            n_groups = int(rng.integers(2, 6))
+            for gi in range(n_groups):
+                n = int(rng.integers(200, 900))
+                cpu = cpus[int(rng.integers(0, len(cpus)))]
+                mem = mems[int(rng.integers(0, len(mems)))]
+                kw = {}
+                flavor = int(rng.integers(0, 4))
+                labels = {"app": f"t{trial}g{gi}"}
+                if flavor == 1:
+                    kw["topology_spread"] = [TopologySpreadConstraint(
+                        max_skew=1, topology_key=wk.ZONE,
+                        label_selector=dict(labels))]
+                elif flavor == 2:
+                    kw["affinity_terms"] = [PodAffinityTerm(
+                        label_selector=dict(labels), topology_key=wk.HOSTNAME,
+                        anti=True)]
+                    n = min(n, 60)
+                for j in range(n):
+                    pods.append(Pod(
+                        meta=ObjectMeta(name=f"t{trial}g{gi}-{j}", labels=dict(labels)),
+                        requests=Resources(cpu=cpu, memory=mem), **kw))
+            prov = Provisioner(meta=ObjectMeta(name="default"))
+            problem = encode(pods, [(prov, generate_catalog(n_types=30))])
+            s = TPUSolver(portfolio=4)
+            costs = []
+            for _ in range(3):
+                r = s.solve(problem)
+                assert validate(problem, r) == [], f"trial {trial} invalid"
+                assert not r.unschedulable, f"trial {trial} stranded pods"
+                costs.append(r.cost)
+            assert costs[2] <= costs[0] + 1e-9, (
+                f"trial {trial}: adaptation regressed {costs}"
+            )
