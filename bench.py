@@ -428,7 +428,7 @@ def bench_config(name, make, repeats=REPEATS):
     encode_s = time.perf_counter() - t0
     solver = TPUSolver(portfolio=8)
     result = solver.solve(problem)  # warmup (compile)
-    violations = validate(problem, result)
+    cold_violations = validate(problem, result)
     # settle background warm compiles before timing: the p50 measures
     # steady-state solving, not CPU contention with a one-off trace
     from karpenter_tpu.solver.solver import _join_warm_threads
@@ -438,11 +438,20 @@ def bench_config(name, make, repeats=REPEATS):
     # what the operator does at startup: freeze the long-lived heap so gen-2
     # GC scans of 10^5 pod objects don't land as ~200ms mid-solve pauses
     freeze_long_lived()
+    # let the race adaptation settle before timing: the per-problem memory
+    # marks a chronically-late device after two misses (or a delivered loss),
+    # which belongs to warmup, not the steady-state percentiles
+    solver.solve(problem)
+    solver.solve(problem)
     times = []
     for _ in range(repeats):
         t0 = time.perf_counter()
         result = solver.solve(problem)
         times.append(time.perf_counter() - t0)
+    # validate the ADAPTED result actually being reported (pattern CG, warm
+    # caches, race memory all engaged by now) — the cold warmup validation
+    # alone would let a warm-path regression ship invisible
+    violations = cold_violations + validate(problem, result)
     # cold number: fresh objects end-to-end (encode + solve), nothing reused.
     # encode_fresh_ms isolates the encode portion of that cold solve — the
     # "fresh 50k batch" encode cost with a warm process (encode_ms above is

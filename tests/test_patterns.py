@@ -156,6 +156,8 @@ class TestPatternFuzz:
     def test_random_instances_validate_and_never_regress(self):
         """Seeded fuzz over random LP-safe and topology mixes: every repeat
         solve must validate, and adaptation may only improve cost."""
+        from helpers import make_pods, setup as _setup  # noqa: F811
+
         rng = np.random.default_rng(1234)
         cpus = ["100m", "250m", "500m", "1", "2"]
         mems = ["256Mi", "512Mi", "1Gi", "2Gi", "4Gi"]
@@ -170,18 +172,16 @@ class TestPatternFuzz:
                 flavor = int(rng.integers(0, 4))
                 labels = {"app": f"t{trial}g{gi}"}
                 if flavor == 1:
-                    kw["topology_spread"] = [TopologySpreadConstraint(
+                    kw["spread"] = [TopologySpreadConstraint(
                         max_skew=1, topology_key=wk.ZONE,
                         label_selector=dict(labels))]
                 elif flavor == 2:
-                    kw["affinity_terms"] = [PodAffinityTerm(
+                    kw["affinity"] = [PodAffinityTerm(
                         label_selector=dict(labels), topology_key=wk.HOSTNAME,
                         anti=True)]
                     n = min(n, 60)
-                for j in range(n):
-                    pods.append(Pod(
-                        meta=ObjectMeta(name=f"t{trial}g{gi}-{j}", labels=dict(labels)),
-                        requests=Resources(cpu=cpu, memory=mem), **kw))
+                pods += make_pods(n, prefix=f"t{trial}g{gi}", cpu=cpu, memory=mem,
+                                  labels=labels, **kw)
             prov = Provisioner(meta=ObjectMeta(name="default"))
             problem = encode(pods, [(prov, generate_catalog(n_types=30))])
             s = TPUSolver(portfolio=4)
