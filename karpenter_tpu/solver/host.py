@@ -753,11 +753,15 @@ def solve_host(
                     try_round(plan2, "floor")
                     try_round(plan2, "nearest")
             if best is not None and best[1].sum() == 0 and best[0]:
-                # density-guided local search recovers rounding loss
-                rr_opens = ruin_recreate(problem, best[0], plan_obj.cols)
-                rr_cost = plan_cost(problem, rr_opens)
-                if rr_cost < best[2] - 1e-9:
-                    best = (rr_opens, best[1], rr_cost)
+                # density-guided local search recovers rounding loss —
+                # skipped when a cold pipeline has already burned the budget
+                # (the adaptive tail's banked pattern pool recovers more on
+                # the next solve anyway)
+                if deadline is None or time.perf_counter() < deadline:
+                    rr_opens = ruin_recreate(problem, best[0], plan_obj.cols)
+                    rr_cost = plan_cost(problem, rr_opens)
+                    if rr_cost < best[2] - 1e-9:
+                        best = (rr_opens, best[1], rr_cost)
         if best is None or best[1].sum() > 0:
             # LP unavailable or failed to place everything: greedy baseline
             g_opens, g_left, g_cost = config_greedy(problem, rem)

@@ -135,3 +135,48 @@ class TestChaos:
             t.join(timeout=10)
         assert not t.is_alive()
         assert not op.cluster.pending_pods()
+
+
+class TestTopologyE2E:
+    def test_spread_and_colocation_through_operator(self):
+        """Topology-heavy workload end-to-end: zone spread + cross-group
+        hostname colocation provisioned through the full controller stack —
+        the bound cluster must satisfy every constraint on REAL node objects,
+        and repeated reconciles (the steady state where the pattern paths
+        engage) must keep it that way."""
+        from karpenter_tpu.api import PodAffinityTerm, TopologySpreadConstraint
+
+        op, clock = make_operator()
+        spread = [TopologySpreadConstraint(
+            max_skew=1, topology_key=wk.ZONE, label_selector={"app": "svc"})]
+        for p in make_pods(90, prefix="svc", cpu="500m", labels={"app": "svc"},
+                           spread=spread):
+            op.cluster.add_pod(p)
+        for p in make_pods(6, prefix="db", cpu="1", memory="2Gi",
+                           labels={"app": "db"}):
+            op.cluster.add_pod(p)
+        for p in make_pods(24, prefix="web", cpu="250m", labels={"app": "web"},
+                           affinity=[PodAffinityTerm(
+                               label_selector={"app": "db"},
+                               topology_key=wk.HOSTNAME)]):
+            op.cluster.add_pod(p)
+        for _ in range(3):
+            op.step()
+        assert not op.cluster.pending_pods()
+        # zone spread holds on the real cluster state
+        zone_counts = {}
+        for p in op.cluster.pods.values():
+            if p.meta.labels.get("app") == "svc":
+                node = op.cluster.nodes[p.node_name]
+                z = node.meta.labels.get(wk.ZONE)
+                zone_counts[z] = zone_counts.get(z, 0) + 1
+        assert len(zone_counts) >= 2, f"spread collapsed to one zone: {zone_counts}"
+        assert max(zone_counts.values()) - min(zone_counts.values()) <= 1, zone_counts
+        # every web pod shares its node with a db pod
+        db_nodes = {
+            p.node_name for p in op.cluster.pods.values()
+            if p.meta.labels.get("app") == "db"
+        }
+        for p in op.cluster.pods.values():
+            if p.meta.labels.get("app") == "web":
+                assert p.node_name in db_nodes, f"{p.name} on {p.node_name} without db"

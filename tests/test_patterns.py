@@ -156,8 +156,6 @@ class TestPatternFuzz:
     def test_random_instances_validate_and_never_regress(self):
         """Seeded fuzz over random LP-safe and topology mixes: every repeat
         solve must validate, and adaptation may only improve cost."""
-        from helpers import make_pods, setup as _setup  # noqa: F811
-
         rng = np.random.default_rng(1234)
         cpus = ["100m", "250m", "500m", "1", "2"]
         mems = ["256Mi", "512Mi", "1Gi", "2Gi", "4Gi"]
