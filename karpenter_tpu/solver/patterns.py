@@ -52,9 +52,22 @@ _POOL_CACHE_MAX = 4
 _pool_cache: Dict[int, tuple] = {}
 
 
+def _count_improvement(savings: float) -> None:
+    """Metric semantic: savings DELIVERED per returned improvement — every
+    solve that hands back a pattern-improved plan counts, cached or computed,
+    so the counter tracks what the closer is worth in steady state."""
+    from ..utils import metrics
+
+    metrics.PATTERN_IMPROVEMENTS.inc()
+    metrics.PATTERN_SAVINGS.inc(value=savings)
+
+
 def _cache_put(cache: Dict[int, tuple], key: int, value: tuple, cap: int) -> None:
     if key not in cache and len(cache) >= cap:
-        cache.pop(next(iter(cache)))
+        try:
+            cache.pop(next(iter(cache)))
+        except (StopIteration, KeyError, RuntimeError):
+            pass  # concurrent evictor/mutator got there first
     cache[key] = value
 
 # Problems seen once: CG only engages from the SECOND solve of the same
@@ -263,7 +276,10 @@ def pattern_improve(
         pool = cached[1]
         if pool.converged and pool.rounded is not None:
             opens, cost = pool.rounded
-            return (opens, cost) if cost < incumbent_cost - 1e-9 else None
+            if cost < incumbent_cost - 1e-9:
+                _count_improvement(incumbent_cost - cost)
+                return opens, cost
+            return None
     else:
         if _seen_problems.get(key) is not problem:
             _seen_problems[key] = problem  # first sight: free, no CG yet
@@ -328,5 +344,6 @@ def pattern_improve(
         pool.rounded = rounded
     opens, cost = rounded
     if cost < incumbent_cost - 1e-9:
+        _count_improvement(incumbent_cost - cost)
         return opens, cost
     return None

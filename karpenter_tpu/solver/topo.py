@@ -707,6 +707,9 @@ def topo_improve(
         result, cost = entry
         if cost >= incumbent_cost - 1e-9:
             return None
+        from .patterns import _count_improvement
+
+        _count_improvement(incumbent_cost - cost)
         import dataclasses
 
         return dataclasses.replace(result, stats=dict(result.stats))

@@ -175,3 +175,11 @@ CLOUDPROVIDER_DURATION = Histogram(
     "karpenter_tpu_cloudprovider_duration_seconds", registry=REGISTRY
 )
 CLOUDPROVIDER_ERRORS = Counter("karpenter_tpu_cloudprovider_errors_total", registry=REGISTRY)
+# pattern column generation (solver/patterns.py, solver/topo.py): improved
+# plans RETURNED (cached or freshly built) and the savings they delivered
+PATTERN_IMPROVEMENTS = Counter(
+    "karpenter_tpu_pattern_improvements_total", registry=REGISTRY
+)
+PATTERN_SAVINGS = Counter(
+    "karpenter_tpu_pattern_savings_dollars_total", registry=REGISTRY
+)
