@@ -484,6 +484,7 @@ class TPUSolver(Solver):
                     improved = topo_improve(
                         problem, self, host_result.cost,
                         deadline=t0 + self.latency_budget_s * 0.85,
+                        incumbent=host_result,
                     )
                     if improved is not None:
                         host_result = improved

@@ -64,9 +64,13 @@ _seen: "weakref.WeakValueDictionary[int, EncodedProblem]" = weakref.WeakValueDic
 
 
 def _supported(problem: EncodedProblem) -> bool:
-    if problem.E or problem.O == 0:
+    if problem.O == 0:
         return False
     if np.any(problem.colocate):
+        return False
+    if problem.E and np.any(problem.zone_cap.astype(np.int64) < _IBIG):
+        # zone anti-affinity occupancy against a fixed existing assignment
+        # would need a recompute this path doesn't do
         return False
     # Hostname-level cross-group COLOCATION (consumer requires provider on its
     # node) is pattern-expressible: a pattern hosting a consumer must also
@@ -507,7 +511,10 @@ def _residual_ffd(solver, problem, res_count: np.ndarray, res_quota: np.ndarray)
     cnt2[:G] = res_count.astype(cnt2.dtype)
     q2 = np.asarray(inputs.quota).copy()
     q2[:G, :] = np.clip(res_quota[:, :n_zones], 0, np.iinfo(q2.dtype).max).astype(q2.dtype)
-    inputs2 = inputs._replace(count=cnt2, quota=q2)
+    # existing slots are OFF: with E > 0 the incumbent's existing placements
+    # are pinned by the caller — the residual may only open new nodes
+    ex_off = np.zeros_like(np.asarray(inputs.ex_valid))
+    inputs2 = inputs._replace(count=cnt2, quota=q2, ex_valid=ex_off)
     shared = host_shared(inputs2)
     price = problem.price.astype(np.float64)
     orders_np = np.asarray(orders)
@@ -655,10 +662,16 @@ def topo_improve(
     incumbent_cost: float,
     deadline: Optional[float] = None,
     min_pods: int = 2000,
+    incumbent=None,
 ):
     """Build a zone-decomposed pattern plan for a topology-constrained problem
     and return a validated SolveResult when it strictly beats
     ``incumbent_cost``; None otherwise.
+
+    With existing capacity (E > 0) the ``incumbent`` result's existing-node
+    assignments are kept FIXED — they already passed validation — and only
+    the new-node remainder is pattern-rebuilt, with zone quotas re-watered
+    over seeds augmented by those assignments.
 
     Engages from the SECOND solve of the same problem (one-shot solves pay
     ~nothing); the finished plan — or the fact that the build could not beat
@@ -667,6 +680,8 @@ def topo_improve(
     if not _HAVE_SCIPY or not _supported(problem):
         return None
     if problem.count.sum() < min_pods:
+        return None
+    if problem.E and incumbent is None:
         return None
     key = id(problem)
     cached = _state_cache.get(key)
@@ -677,6 +692,9 @@ def topo_improve(
         result, cost = finished
         if cost >= incumbent_cost - 1e-9:
             return None
+        from .patterns import _count_improvement
+
+        _count_improvement(incumbent_cost - cost)
         # fresh shell per return: callers stamp stats (total_solve_s) on what
         # we hand them, and that must never rewrite the cached object
         import dataclasses
@@ -696,7 +714,6 @@ def topo_improve(
     count = problem.count.astype(np.int64)
     caps = np.minimum(problem.node_cap.astype(np.int64), _IBIG)
     n_zones = len(problem.zones)
-    quota = _zone_quotas(problem, n_zones).astype(np.int64)
 
     def finish(entry):
         from .patterns import _cache_put
@@ -714,7 +731,52 @@ def topo_improve(
 
         return dataclasses.replace(result, stats=dict(result.stats))
 
-    rem_gz = _zone_split(problem, quota)
+    assigned = np.zeros((G, problem.E), np.int64)
+    split_problem = problem
+    if problem.E:
+        # pin the incumbent's existing-node placements; rebuild only the rest
+        name_to_g = {
+            p.name: gi for gi, grp in enumerate(problem.groups) for p in grp.pods
+        }
+        e_index = {e.name: ei for ei, e in enumerate(problem.existing)}
+        assigned_gz = np.zeros((G, n_zones), np.int64)
+        for node_name, pod_names in (incumbent.existing_assignments or {}).items():
+            ei = e_index.get(node_name)
+            if ei is None:
+                return finish(None)
+            z = int(problem.ex_zone[ei])  # the encoder's own zone mapping
+            for pn in pod_names:
+                gi = name_to_g.get(pn)
+                if gi is None:
+                    return finish(None)
+                assigned[gi, ei] += 1
+                assigned_gz[gi, z] += 1
+        count = count - assigned.sum(axis=1)
+        if (count < 0).any():
+            return finish(None)
+        # re-water the spread quotas over seeds AUGMENTED by the pinned
+        # assignments (family members count toward each other's selectors)
+        seed_add = np.zeros((G, n_zones), np.int64)
+        fams = problem.zone_spread_members or [[] for _ in range(G)]
+        for g in range(G):
+            if problem.zone_skew[g] > 0:
+                members = sorted(set([g] + list(fams[g])))
+                seed_add[g] = assigned_gz[members].sum(axis=0)
+        base_seed = (
+            problem.zone_seed[:, :n_zones].astype(np.int64)
+            if problem.zone_seed is not None
+            else np.zeros((G, n_zones), np.int64)
+        )
+        import dataclasses as _dc
+
+        split_problem = _dc.replace(
+            problem,
+            count=count.astype(problem.count.dtype),
+            zone_seed=(base_seed + seed_add).astype(np.int32),
+        )
+    quota = _zone_quotas(split_problem, n_zones).astype(np.int64)
+
+    rem_gz = _zone_split(split_problem, quota)
     if rem_gz is None:
         return finish(None)
 
@@ -817,11 +879,10 @@ def topo_improve(
     from .host import _check_counts, _decode
     from .validate import validate
 
-    placements = np.zeros((G, problem.E), np.int64)
     leftover = np.zeros(G, np.int64)
-    if _check_counts(problem, placements, opens, leftover):
+    if _check_counts(problem, assigned, opens, leftover):
         return finish(None)
-    result = _decode(problem, placements, opens, leftover)
+    result = _decode(problem, assigned, opens, leftover)
     if validate(problem, result) != []:
         return finish(None)
     cost = plan_cost(problem, opens)

@@ -136,3 +136,53 @@ class TestTopoImprove:
             r = s.solve(p)
         assert validate(p, r) == []
         assert lb / r.cost >= 0.96, f"efficiency {lb / r.cost:.4f}"
+
+
+class TestTopoWithExisting:
+    def test_existing_assignments_pinned_and_plan_validates(self):
+        """E > 0: the incumbent's existing-node placements stay fixed; only
+        the new-node remainder is pattern-rebuilt, and the combined plan must
+        validate (spread re-watered over the pinned assignments)."""
+        from karpenter_tpu.api import Node, ObjectMeta, Resources
+        from karpenter_tpu.solver import ExistingNode
+
+        pods = []
+        for i in range(2):
+            app = f"svc{i}"
+            for j in range(900):
+                pods.append(Pod(
+                    meta=ObjectMeta(name=f"{app}-{j}", labels={"app": app}),
+                    requests=Resources(cpu=["250m", "2"][i], memory="512Mi"),
+                    topology_spread=[TopologySpreadConstraint(
+                        max_skew=1, topology_key=wk.ZONE,
+                        label_selector={"app": app})],
+                ))
+        pods += [Pod(meta=ObjectMeta(name=f"fill-{j}"),
+                     requests=Resources(cpu=["2", "500m"][j % 2], memory="512Mi"))
+                 for j in range(1400)]
+        existing = []
+        for i in range(30):
+            zone = ["zone-a", "zone-b", "zone-c"][i % 3]
+            node = Node(
+                meta=ObjectMeta(name=f"ex-{i}", labels={wk.ZONE: zone}),
+                allocatable=Resources(cpu=8, memory="16Gi", pods=58),
+            )
+            existing.append(ExistingNode(
+                node=node, remaining=Resources(cpu=4, memory="8Gi", pods=40)))
+        prov = Provisioner(meta=ObjectMeta(name="default"))
+        p = encode(pods, [(prov, generate_catalog(n_types=40))], existing=existing)
+        assert _supported(p)
+        s = TPUSolver(portfolio=4)
+        base = s._solve_host_pack(p)
+        assert base is not None and not base.unschedulable
+        topo_improve(p, s, base.cost, deadline=time.perf_counter() + 4.0,
+                     min_pods=100, incumbent=base)
+        out = topo_improve(p, s, base.cost, deadline=time.perf_counter() + 4.0,
+                           min_pods=100, incumbent=base)
+        if out is None:
+            pytest.skip("FFD already at the pattern frontier on this draw")
+        assert out.cost < base.cost - 1e-9
+        assert validate(p, out) == []
+        # existing assignments are EXACTLY the incumbent's
+        assert {k: sorted(v) for k, v in out.existing_assignments.items()} == \
+               {k: sorted(v) for k, v in base.existing_assignments.items()}
