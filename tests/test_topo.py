@@ -72,7 +72,7 @@ class TestTopoImprove:
         t0 = time.perf_counter()
         out2 = topo_improve(p, s, base.cost, deadline=time.perf_counter() + 3.0, min_pods=100)
         assert out2 is not None and out2.cost == out1.cost
-        assert time.perf_counter() - t0 < 0.05
+        assert time.perf_counter() - t0 < 0.25
 
     def test_cross_group_colocation_supported_and_valid(self):
         """Hostname colocation (consumer requires provider on its node) is
