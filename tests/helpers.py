@@ -76,3 +76,29 @@ def small_catalog(n_types: int = 20):
 def setup(n_types: int = 20, provisioner: Optional[Provisioner] = None):
     p = provisioner or make_provisioner()
     return [(p, small_catalog(n_types))]
+
+
+def zone_skew(op, app: str) -> int:
+    """Zone skew of an app's pods on the live cluster, floored over EVERY zone
+    any managed node occupies — a spread collapsed into one zone must read as
+    maximal skew, not zero (the validator's semantics)."""
+    from karpenter_tpu.api import labels as wk
+
+    zones = {
+        n.meta.labels.get(wk.ZONE)
+        for n in op.cluster.nodes.values()
+        if n.meta.labels.get(wk.ZONE)
+    }
+    counts = {z: 0 for z in zones}
+    for p in op.cluster.pods.values():
+        if p.meta.labels.get("app") != app or p.node_name is None:
+            continue
+        node = op.cluster.nodes.get(p.node_name)
+        if node is None:
+            continue
+        z = node.meta.labels.get(wk.ZONE)
+        if z is not None:
+            counts[z] = counts.get(z, 0) + 1
+    if not counts:
+        return 0
+    return max(counts.values()) - min(counts.values())
