@@ -457,6 +457,14 @@ def bench_config(name, make, repeats=REPEATS):
     # "fresh 50k batch" encode cost with a warm process (encode_ms above is
     # the very first encode ever, including one-time compile/intern costs).
     pods2, provs2, existing2 = make()
+    # one extra pod: the solver interns content-identical problems (reusing
+    # the learned plan is correct product behavior for an unchanged cluster),
+    # so the COLD metric must present a genuinely changed batch
+    from karpenter_tpu.api import ObjectMeta as _OM, Pod as _Pod, Resources as _Res
+
+    pods2 = list(pods2) + [
+        _Pod(meta=_OM(name="cold-extra"), requests=_Res(cpu="100m", memory="128Mi"))
+    ]
     t0 = time.perf_counter()
     cold_result = solver.solve_pods(pods2, provs2, existing=existing2)
     cold_s = time.perf_counter() - t0

@@ -206,9 +206,107 @@ def _join_warm_threads() -> None:
             t.join(timeout=120)
 
 
+def _problems_content_equal(a: EncodedProblem, b: EncodedProblem) -> bool:
+    """Full content equality between two encoded problems, including the pod
+    NAMES each group expands to (a reused problem's result decodes the OLD
+    pod objects' names — renamed pods must miss). Cheap relative to a solve:
+    array compares are bytes-level, names are a single tuple compare."""
+    if (a.G, a.O, a.E) != (b.G, b.O, b.E):
+        return False
+    if a.resource_axes != b.resource_axes or a.zones != b.zones:
+        return False
+    for fld in (
+        "demand", "count", "alloc", "price", "opt_zone", "compat",
+        "node_cap", "zone_cap", "zone_skew", "colocate",
+        "ex_rem", "ex_zone", "ex_compat",
+    ):
+        if not np.array_equal(getattr(a, fld), getattr(b, fld)):
+            return False
+    for fld in (
+        "zone_seed", "zone_occupied", "rel_set", "rel_host_forbid",
+        "rel_host_need", "rel_zone_forbid", "rel_zone_need",
+        "rel_slot_bits", "rel_zone_bits", "rel_layer",
+    ):
+        va, vb = getattr(a, fld), getattr(b, fld)
+        if (va is None) != (vb is None):
+            return False
+        if va is not None and not np.array_equal(va, vb):
+            return False
+    if a.rel_unsupported != b.rel_unsupported:
+        return False
+    if a.zone_spread_members != b.zone_spread_members:
+        return False
+    if a.weight_gated_groups != b.weight_gated_groups:
+        return False
+    for ga, gb in zip(a.groups, b.groups):
+        if len(ga.pods) != len(gb.pods):
+            return False
+        if any(pa.name != pb.name for pa, pb in zip(ga.pods, gb.pods)):
+            return False
+    if len(a.seed_pods) != len(b.seed_pods):
+        return False
+    for (ha, za, pa), (hb, zb, pb) in zip(a.seed_pods, b.seed_pods):
+        if ha != hb or za != zb or pa.name != pb.name:
+            return False
+    for ea, eb in zip(a.existing, b.existing):
+        if ea.name != eb.name:
+            return False
+    for oa, ob in zip(a.options, b.options):
+        if (
+            oa.instance_type.name != ob.instance_type.name
+            or oa.zone != ob.zone
+            or oa.capacity_type != ob.capacity_type
+            or oa.provisioner.name != ob.provisioner.name
+        ):
+            return False
+    # FULL provisioner signatures: a reused problem's options hand their
+    # embedded Provisioner objects to launch and limit enforcement, so any
+    # spec field those paths read (limits, labels, taints, kubelet,
+    # node_template_ref, ...) must match even when no encoded array changed
+    from .encode import _provisioner_sig
+
+    def uniq_provs(p):
+        seen, out = set(), []
+        for o in p.options:
+            if id(o.provisioner) not in seen:
+                seen.add(id(o.provisioner))
+                out.append(o.provisioner)
+        return out
+
+    pa, pb = uniq_provs(a), uniq_provs(b)
+    if len(pa) != len(pb):
+        return False
+    for x, y in zip(pa, pb):
+        if x is not y and _provisioner_sig(x) != _provisioner_sig(y):
+            return False
+    return True
+
+
 class Solver(abc.ABC):
     @abc.abstractmethod
     def solve(self, problem: EncodedProblem) -> SolveResult: ...
+
+    def _intern_problem(self, problem: EncodedProblem) -> EncodedProblem:
+        """Return the PREVIOUS encode's problem object when this one is
+        content-identical — every reconcile re-encodes, producing fresh
+        objects, but the per-problem learning (banked pattern pools, cached
+        rounded plans, race outcome memory) keys on problem identity. Without
+        interning, a steady-state operator whose cluster is momentarily
+        unchanged would pay the pattern warmup on every cycle and never reach
+        the learned plan. One slot: the steady state being optimized is
+        consecutive reconciles of the same batch."""
+        slots = getattr(self, "_interned_problems", None)
+        if slots is None:
+            slots = self._interned_problems = []
+        for cached in slots:
+            if _problems_content_equal(cached, problem):
+                return cached
+        slots.append(problem)
+        if len(slots) > 4:
+            # a few slots: deprovisioning's hypothetical solves share this
+            # solver and must not evict the provisioning batch's learning
+            slots.pop(0)
+        return problem
 
     def solve_pods(
         self,
@@ -223,7 +321,9 @@ class Solver(abc.ABC):
         encode_s = 0.0
         with span("solve", pods=len(pods)):
             with span("solve.encode"):
-                problem = encode(pods, provisioners, existing, daemonsets)
+                problem = self._intern_problem(
+                    encode(pods, provisioners, existing, daemonsets)
+                )
             encode_s += time.perf_counter() - t0
             with span("solve.backend"):
                 result = self.solve(problem)

@@ -704,9 +704,12 @@ def topo_improve(
         _seen[key] = problem
         return None
     # one-time build, bounded like the pattern-CG warmup spike: steady-state
-    # latency is the contract, a single bounded spike buys the optimal plan
+    # latency is the contract, a single bounded spike buys the optimal plan.
+    # The budget must cover a COMPLETE build (zone CG levels + residual FFD +
+    # capped ruin-recreate, measured <=1.3s at 10k): a starved build caches a
+    # worse-than-incumbent plan permanently
     if deadline is not None:
-        deadline = max(deadline, time.perf_counter() + 0.6)
+        deadline = max(deadline, time.perf_counter() + 1.5)
 
     from .solver import _zone_quotas  # local import: solver imports this module's caller
 

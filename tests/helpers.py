@@ -102,3 +102,17 @@ def zone_skew(op, app: str) -> int:
     if not counts:
         return 0
     return max(counts.values()) - min(counts.values())
+
+
+def pod_zones(op, app: str) -> set:
+    """Distinct zones currently hosting an app's pods."""
+    from karpenter_tpu.api import labels as wk
+
+    out = set()
+    for p in op.cluster.pods.values():
+        if p.meta.labels.get("app") != app or p.node_name is None:
+            continue
+        node = op.cluster.nodes.get(p.node_name)
+        if node is not None and node.meta.labels.get(wk.ZONE):
+            out.add(node.meta.labels[wk.ZONE])
+    return out

@@ -165,8 +165,9 @@ class TestTopologyE2E:
         assert not op.cluster.pending_pods()
         # zone spread holds on the real cluster state (floored over every
         # zone the fleet occupies — a collapse reads as maximal skew)
-        from helpers import zone_skew
+        from helpers import pod_zones, zone_skew
 
+        assert len(pod_zones(op, "svc")) >= 2, "spread collapsed to one zone"
         assert zone_skew(op, "svc") <= 1
         # every web pod shares its node with a db pod
         db_nodes = {

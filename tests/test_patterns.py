@@ -192,3 +192,46 @@ class TestPatternFuzz:
             assert costs[2] <= costs[0] + 1e-9, (
                 f"trial {trial}: adaptation regressed {costs}"
             )
+
+
+class TestProblemInterning:
+    def test_fresh_object_reconciles_reach_learned_plan(self):
+        """Production shape: every reconcile re-encodes fresh objects, so the
+        solver interns content-identical problems — per-problem learning
+        (pattern pools, cached plans, race memory) must engage across them."""
+        def make():
+            return _mixed_problem_pods(3000)
+
+        s = TPUSolver(portfolio=4)
+        costs = []
+        for _ in range(4):
+            pods, provs = make()
+            r = s.solve_pods(pods, provs)
+            assert not r.unschedulable
+            costs.append(r.cost)
+        assert costs[-1] <= costs[0] + 1e-9
+        # the interned problem is reused across value-equal encodes
+        p_obj = s._interned_problems[-1]
+        pods, provs = make()
+        s.solve_pods(pods, provs)
+        assert p_obj in s._interned_problems
+
+    def test_changed_batch_misses_the_intern(self):
+        s = TPUSolver(portfolio=4)
+        pods, provs = _mixed_problem_pods(500)
+        s.solve_pods(pods, provs)
+        first = s._interned_problems[-1]
+        pods2, provs2 = _mixed_problem_pods(501)
+        s.solve_pods(pods2, provs2)
+        assert s._interned_problems[-1] is not first
+
+
+def _mixed_problem_pods(n):
+    shapes = [("big", "2", "512Mi"), ("mem", "500m", "4Gi"), ("tiny", "250m", "256Mi")]
+    pods = []
+    for i in range(n):
+        name, cpu, mem = shapes[i % 3]
+        pods.append(Pod(meta=ObjectMeta(name=f"{name}-{i}"),
+                        requests=Resources(cpu=cpu, memory=mem)))
+    prov = Provisioner(meta=ObjectMeta(name="default"))
+    return pods, [(prov, generate_catalog(n_types=60))]
