@@ -450,25 +450,78 @@ def bench_config(name, make, repeats=REPEATS):
         times.append(time.perf_counter() - t0)
     # validate the ADAPTED result actually being reported (pattern CG, warm
     # caches, race memory all engaged by now) — the cold warmup validation
-    # alone would let a warm-path regression ship invisible
-    violations = cold_violations + validate(problem, result)
-    # cold number: fresh objects end-to-end (encode + solve), nothing reused.
-    # encode_fresh_ms isolates the encode portion of that cold solve — the
-    # "fresh 50k batch" encode cost with a warm process (encode_ms above is
-    # the very first encode ever, including one-time compile/intern costs).
-    pods2, provs2, existing2 = make()
-    # one extra pod: the solver interns content-identical problems (reusing
-    # the learned plan is correct product behavior for an unchanged cluster),
-    # so the COLD metric must present a genuinely changed batch
+    # alone would let a warm-path regression ship invisible. The cold/novel
+    # trials below append their validations too; `violations` in the report
+    # is the total across every checked result.
+    cold_violations = cold_violations + validate(problem, result)
+    # cold numbers: fresh objects end-to-end (encode + solve), nothing
+    # identity-reused. encode_fresh_ms isolates the encode portion of a cold
+    # solve with a warm process (encode_ms above is the very first encode
+    # ever, including one-time compile/intern costs). Median of 3 trials with
+    # idle-window GC maintenance between them — exactly what the operator's
+    # reconcile loop does between batches (operator.py gcmaintain) — so the
+    # metric measures the solve, not a deferred gen-2 collection landing on
+    # whichever trial trips the threshold.
     from karpenter_tpu.api import ObjectMeta as _OM, Pod as _Pod, Resources as _Res
+    from karpenter_tpu.utils.gctuning import maintain as _gc_maintain
 
-    pods2 = list(pods2) + [
-        _Pod(meta=_OM(name="cold-extra"), requests=_Res(cpu="100m", memory="128Mi"))
-    ]
-    t0 = time.perf_counter()
-    cold_result = solver.solve_pods(pods2, provs2, existing=existing2)
-    cold_s = time.perf_counter() - t0
+    def make_cold(tag):
+        # one extra pod: the solver interns content-identical problems
+        # (reusing the learned plan is correct product behavior for an
+        # unchanged cluster), so the COLD metric must present a genuinely
+        # changed batch. Similar-problem warm starts may still engage — a
+        # steady-state cluster's fresh batches are near-copies, and that
+        # reuse is the product path; novel_* below measures without it.
+        p3, pr3, ex3 = make()
+        p3 = list(p3) + [
+            _Pod(meta=_OM(name=f"cold-{tag}"), requests=_Res(cpu="100m", memory="128Mi"))
+        ]
+        return p3, pr3, ex3
+
+    cold_times = []
+    cold_result = None
+    cold_batch = None
+    for ci in range(3):
+        batch = make_cold(ci)
+        _gc_maintain()
+        t0 = time.perf_counter()
+        cold_result = solver.solve_pods(batch[0], batch[1], existing=batch[2])
+        cold_times.append(time.perf_counter() - t0)
+        cold_batch = batch
+    cold_s = statistics.median(cold_times)
     encode_fresh_s = cold_result.stats.get("encode_s", 0.0)
+    # validate + bound the cold result (round-4 verdict item 2: one-shot
+    # efficiency was unmeasured) — encoded fresh so nothing leaks from the
+    # solver's interned state into the check
+    cold_problem = encode(cold_batch[0], cold_batch[1], existing=cold_batch[2])
+    cold_violations = cold_violations + validate(cold_problem, cold_result)
+    cold_lb = float(best_lower_bound(cold_problem))
+    cold_eff = (cold_lb / cold_result.cost) if cold_result.cost > 0 else 1.0
+
+    # novel numbers: a problem this PROCESS has learning for, but this solver
+    # and the pattern caches have never seen — similarity warm-start disabled
+    # by clearing the pools. The truly-never-seen-anything-like-it case.
+    from karpenter_tpu.solver import patterns as _patterns
+
+    saved_pool = dict(_patterns._pool_cache)
+    _patterns._pool_cache.clear()
+    try:
+        novel_solver = TPUSolver(portfolio=8)
+        batch = make_cold("novel")
+        _gc_maintain()
+        t0 = time.perf_counter()
+        novel_result = novel_solver.solve_pods(batch[0], batch[1], existing=batch[2])
+        novel_s = time.perf_counter() - t0
+    finally:
+        # full replace (clear + update): the novel problem's banked pool must
+        # not linger and shadow the real learned pools for later configs
+        _patterns._pool_cache.clear()
+        _patterns._pool_cache.update(saved_pool)
+    novel_problem = encode(batch[0], batch[1], existing=batch[2])
+    cold_violations = cold_violations + validate(novel_problem, novel_result)
+    novel_lb = float(best_lower_bound(novel_problem))
+    novel_eff = (novel_lb / novel_result.cost) if novel_result.cost > 0 else 1.0
+
     # tight LP-relaxation bound (bench-side instrumentation, not the hot path)
     lb = float(best_lower_bound(problem))
     eff = (lb / result.cost) if result.cost > 0 else 1.0
@@ -485,11 +538,14 @@ def bench_config(name, make, repeats=REPEATS):
         "encode_ms": round(encode_s * 1e3, 1),
         "encode_fresh_ms": round(encode_fresh_s * 1e3, 1),
         "cold_solve_ms": round(cold_s * 1e3, 1),
+        "cold_efficiency": round(float(cold_eff), 4),
+        "novel_cold_ms": round(novel_s * 1e3, 1),
+        "novel_efficiency": round(float(novel_eff), 4),
         "cost_per_hour": round(float(result.cost), 3),
         "lower_bound": round(lb, 3),
         "efficiency_vs_lb": round(float(eff), 4),
         "unschedulable": len(result.unschedulable),
-        "violations": len(violations),
+        "violations": len(cold_violations),
         "backend": backend,
         "oracle_fallbacks": int(result.stats.get("fallback", 0)),
     }
@@ -529,6 +585,11 @@ def main():
         "unit": "ms",
         "vs_baseline": round(TARGET_MS / p50, 3) if p50 == p50 and p50 > 0 else 0.0,
         "efficiency_vs_lb": head.get("efficiency_vs_lb"),
+        # the honest fresh-batch numbers (round-4 verdict): end-to-end solve
+        # of a changed 50k batch, and its one-shot packing efficiency
+        "cold_solve_ms": head.get("cold_solve_ms"),
+        "cold_efficiency": head.get("cold_efficiency"),
+        "novel_cold_ms": head.get("novel_cold_ms"),
         "details": details,
     }
     print(json.dumps(line))

@@ -230,6 +230,14 @@ class Operator:
                 "garbagecollect", self.garbagecollect.reconcile, interval=300.0
             )
         )
+        # idle-window GC maintenance: run the full collection while idle (NOT
+        # freeze — see gctuning.maintain) so the high-threshold auto gen-2
+        # collection never fires mid-solve
+        from .utils.gctuning import maintain as gc_maintain
+
+        controllers.append(
+            SingletonController("gcmaintain", gc_maintain, interval=60.0)
+        )
         self.controllers = controllers
         while not stop.is_set():
             for c in controllers:

@@ -26,11 +26,22 @@ import numpy as np
 from .encode import EncodedProblem
 
 
+def _servable_counts(problem: EncodedProblem) -> np.ndarray:
+    """Group counts with structurally-unschedulable groups zeroed: a group
+    with no compatible option (and no compatible existing node) can never be
+    packed, so its demand must not inflate a bound on the cost of the pods a
+    solve actually places (those pods are reported unschedulable)."""
+    ok = problem.compat.any(axis=1)
+    if problem.E:
+        ok = ok | problem.ex_compat.any(axis=1)
+    return np.where(ok, problem.count, 0)
+
+
 def fractional_lower_bound(problem: EncodedProblem) -> float:
     """Per-axis fractional covering bound (constraint-free, always valid)."""
     if problem.O == 0 or problem.G == 0:
         return 0.0
-    total = (problem.demand * problem.count[:, None]).sum(axis=0)
+    total = (problem.demand * _servable_counts(problem)[:, None]).sum(axis=0)
     free = problem.ex_rem.sum(axis=0) if problem.E else 0.0
     leftover = np.maximum(total - free, 0.0)
     best = 0.0
@@ -83,11 +94,14 @@ def lp_lower_bound(problem: EncodedProblem, time_limit: float = 30.0) -> Optiona
     # columns: [x (nx)] + [n (OT)]
     c = np.concatenate([np.zeros(nx), price])
 
-    # equality: per-group demand
+    # equality: per-group demand. Structurally-unschedulable groups (no
+    # compatible option or existing node) demand zero — requiring their
+    # placement would make the whole LP infeasible and silently drop the
+    # bound to the loose fractional fallback for every OTHER pod too.
     a_eq = sparse.csr_matrix(
         (np.ones(nx), (gi, np.arange(nx))), shape=(G, nx + OT)
     )
-    b_eq = problem.count.astype(np.float64)
+    b_eq = _servable_counts(problem).astype(np.float64)
 
     # inequality: sum_g x[g,o] * d[g,r] - n_o * alloc[o,r] <= 0
     rows, cols, vals = [], [], []

@@ -306,6 +306,20 @@ def lp_polish(
     return opens, leftover, cost, plan.cols
 
 
+def topk_rate_options(rate: np.ndarray, active: np.ndarray, topk: int) -> set:
+    """Candidate column pruning shared by the LP pipeline and the similarity
+    fast path: each active group contributes its ``topk`` best per-pod-rate
+    options (finite rates only)."""
+    cand: set = set()
+    for g in active:
+        finite = np.isfinite(rate[g])
+        k = min(topk, int(finite.sum()))
+        if k:
+            idx = np.argpartition(rate[g], k - 1)[:k]
+            cand.update(int(j) for j in idx if np.isfinite(rate[g, j]))
+    return cand
+
+
 def lp_solve(
     problem: EncodedProblem,
     rem: np.ndarray,
@@ -334,14 +348,17 @@ def lp_solve(
     alloc = problem.alloc.astype(np.float64)
     price = problem.price.astype(np.float64)
     units, rate = _units_rate(problem)
+    # groups with NO compatible option can never be placed: excluding them
+    # keeps the LP feasible and leaves their demand as leftover
+    # (unschedulable) instead of poisoning the whole batch into the greedy
+    # fallback — one untolerating pod must not cost every other pod the LP
+    possible = np.isfinite(rate[active]).any(axis=1)
+    active = active[possible]
+    if active.size == 0:
+        return None
 
     cand = {op.option for op in greedy_opens}
-    for g in active:
-        finite = np.isfinite(rate[g])
-        k = min(topk, int(finite.sum()))
-        if k:
-            idx = np.argpartition(rate[g], k - 1)[:k]
-            cand.update(int(j) for j in idx if np.isfinite(rate[g, j]))
+    cand |= topk_rate_options(rate, active, topk)
     cols = sorted(cand)
     if not cols:
         return None
@@ -707,6 +724,32 @@ def solve_host(
         placements, rem, ex_rem = refill_existing(problem, rem, ex_rem)
 
         best: Optional[Tuple[List[Opened], np.ndarray, float]] = None
+        # Similar-problem fast path: a fresh batch that is a near-copy of a
+        # recently learned one (steady-state reconciles: same catalog, a few
+        # pods changed) reuses the learned pattern pool instead of re-running
+        # the assignment-LP pipeline — cheaper AND at the converged pool's
+        # efficiency (round-4 verdict item 1). Validated like any other plan.
+        from .patterns import similar_warm_start
+
+        sim = similar_warm_start(problem, rem, deadline=deadline)
+        if sim is not None:
+            s_opens, s_cost, s_cols, s_fun, s_left = sim
+            best = (s_opens, s_left, s_cost)
+            plan_obj = _LPPlan(
+                cols=s_cols, active=np.flatnonzero(rem > 0),
+                gi=np.zeros(0, np.int64), oi=np.zeros(0, np.int64),
+                x=np.zeros(0), n=np.zeros(0), fun=s_fun,
+            )
+            # copies in: a failed fast path must not leave evacuation's
+            # in-place placement moves behind for the pipeline retry
+            result = _finalize_host(
+                problem, placements.copy(), rem.copy(), ex_rem.copy(),
+                plan_obj, best, deadline, t0,
+            )
+            if result is not None:
+                result.stats["similar_warm"] = 1.0
+                return result
+            best = None  # fast path failed the count gate; run the pipeline
         plan = lp_solve(problem, rem, [], topk=8)
         if isinstance(plan, tuple):  # no remaining demand
             plan_obj = None
@@ -770,7 +813,38 @@ def solve_host(
             ):
                 best = (g_opens, g_left, g_cost)
 
-    if plan_obj is not None and best is not None and best[1].sum() == 0 and best[0]:
+    return _finalize_host(problem, placements, rem, ex_rem, plan_obj, best, deadline, t0)
+
+
+def _finalize_host(
+    problem: EncodedProblem,
+    placements: np.ndarray,
+    rem: np.ndarray,
+    ex_rem: np.ndarray,
+    plan_obj,
+    best: Optional[Tuple[List[Opened], np.ndarray, float]],
+    deadline: Optional[float],
+    t0: float,
+) -> Optional[SolveResult]:
+    """Shared tail of every host path: adaptive polish (pattern CG +
+    ruin-recreate sweep), warm-state snapshot, existing-fragment evacuation,
+    the count-level feasibility gate, and decode."""
+    if best is None:
+        return None
+    # A plan is "complete" for polish/warm purposes when every leftover pod
+    # is STRUCTURALLY unschedulable (no compatible option anywhere): those
+    # pods stay unschedulable no matter what, and their presence must not
+    # disable the adaptive tail or force a full re-pipeline every reconcile.
+    left = best[1]
+    complete = left.sum() == 0
+    rem_eff = rem
+    if not complete:
+        _, rate = _units_rate(problem)
+        hopeless = ~np.isfinite(rate).any(axis=1)
+        if not np.any(left[~hopeless]):
+            complete = True
+            rem_eff = (rem - left).astype(rem.dtype)
+    if plan_obj is not None and complete and best[0]:
         # -- adaptive tail (round-4 verdict item 6) --------------------------
         # pattern column generation: per-node integer patterns close the
         # rounding gap the assignment LP cannot see (patterns.py; 50k:
@@ -779,7 +853,7 @@ def solve_host(
         from .patterns import pattern_improve
 
         improved = pattern_improve(
-            problem, rem, best[0], best[2], plan_obj.cols, plan_obj.fun,
+            problem, rem_eff, best[0], best[2], plan_obj.cols, plan_obj.fun,
             deadline=deadline,
         )
         if improved is not None:
@@ -818,7 +892,7 @@ def solve_host(
                 # sweep that never started) must retry on the next solve
                 problem.__dict__["_rr_exhausted_at"] = best[2]
 
-    if best is not None and best[1].sum() == 0:
+    if complete:
         # snapshot BEFORE evacuate mutates placements/ex_rem in place
         problem.__dict__["_host_warm"] = (
             placements.copy(), rem.copy(), ex_rem.copy(), plan_obj, best,
@@ -839,7 +913,9 @@ def solve_host(
     errors = _check_counts(problem, placements, best[0], best[1])
     if errors:
         # should be unreachable (every stage is capacity-checked); bail to the
-        # kernel path rather than emit an infeasible plan
+        # kernel path rather than emit an infeasible plan — and drop the warm
+        # snapshot so the next solve re-derives instead of replaying the bug
+        problem.__dict__.pop("_host_warm", None)
         return None
     result = _decode(problem, placements, best[0], best[1])
     result.stats["solve_s"] = time.perf_counter() - t0
@@ -946,7 +1022,9 @@ def _decode(
     cursor = np.zeros(G, np.int64)
     group_names = problem.__dict__.get("_group_names")
     if group_names is None:
-        group_names = [[p.name for p in g.pods] for g in problem.groups]
+        from .result import LazyNames
+
+        group_names = [LazyNames(g.pods) for g in problem.groups]
         problem.__dict__["_group_names"] = group_names
     existing_assignments = {}
     for e in range(problem.E):

@@ -9,6 +9,36 @@ from ..api.objects import Pod
 from .encode import EncodedProblem, LaunchOption
 
 
+class LazyNames(TSequence):
+    """List-of-names view over a group's pod list, materialized on first
+    access. Decoders build one per group instead of copying 50k name strings
+    on the solve's critical path — the strings only exist if a consumer
+    (binding, validation, tests) actually reads them."""
+
+    __slots__ = ("_pods", "_names")
+
+    def __init__(self, pods):
+        self._pods = pods
+        self._names: Optional[List[str]] = None
+
+    def _materialize(self) -> List[str]:
+        if self._names is None:
+            self._names = [p.meta.name for p in self._pods]
+        return self._names
+
+    def __len__(self) -> int:
+        return len(self._pods)
+
+    def __iter__(self):
+        return iter(self._materialize())
+
+    def __getitem__(self, i):
+        return self._materialize()[i]
+
+    def __contains__(self, item) -> bool:
+        return item in self._materialize()
+
+
 class NameSlice(TSequence):
     """Lazy view over slices of per-group pod-name lists.
 

@@ -206,11 +206,81 @@ def _join_warm_threads() -> None:
             t.join(timeout=120)
 
 
+def problem_digest(problem: EncodedProblem) -> bytes:
+    """Strong content digest of an encoded problem, cached on the problem.
+
+    Covers everything ``_problems_content_equal`` compares — shapes, every
+    array, pod NAMES per group, seed pods, existing-node names, option
+    identities, and the full provisioner signatures — so digest equality is
+    content equality (sha256; collision risk is negligible next to cosmic
+    rays). Interning compares digests instead of walking 50k pod names per
+    cached slot: the walk cost ~30ms/slot and made a steady stream of fresh
+    batches progressively slower as slots filled (round-5 cold-path fix)."""
+    cached = problem.__dict__.get("_digest")
+    if cached is not None:
+        return cached
+    import hashlib
+
+    from .encode import _provisioner_sig
+
+    h = hashlib.sha256()
+    h.update(
+        repr((
+            problem.G, problem.O, problem.E,
+            problem.resource_axes, problem.zones,
+            problem.rel_unsupported, problem.zone_spread_members,
+            problem.weight_gated_groups,
+        )).encode()
+    )
+    for fld in (
+        "demand", "count", "alloc", "price", "opt_zone", "compat",
+        "node_cap", "zone_cap", "zone_skew", "colocate",
+        "ex_rem", "ex_zone", "ex_compat",
+    ):
+        h.update(np.ascontiguousarray(getattr(problem, fld)).tobytes())
+    for fld in (
+        "zone_seed", "zone_occupied", "rel_set", "rel_host_forbid",
+        "rel_host_need", "rel_zone_forbid", "rel_zone_need",
+        "rel_slot_bits", "rel_zone_bits", "rel_layer",
+    ):
+        v = getattr(problem, fld)
+        h.update(b"\x00" if v is None else np.ascontiguousarray(v).tobytes())
+    # names in bulk: one big join+encode per group (a per-pod generator of
+    # small .encode() calls costs ~35ms at 50k pods; this is ~8ms)
+    for g in problem.groups:
+        h.update("\x1f".join([p.meta.name for p in g.pods]).encode())
+        h.update(b"\x1e")
+    if problem.seed_pods:
+        h.update(
+            "\x1e".join(
+                [f"{host}\x1f{zone}\x1f{p.meta.name}" for host, zone, p in problem.seed_pods]
+            ).encode()
+        )
+    if problem.existing:
+        h.update("\x1e".join([e.node.meta.name for e in problem.existing]).encode())
+    seen_prov: dict = {}
+    for o in problem.options:
+        h.update(
+            f"{o.instance_type.name}\x1f{o.zone}\x1f{o.capacity_type}\x1f{o.provisioner.name}\x1e".encode()
+        )
+        seen_prov.setdefault(id(o.provisioner), o.provisioner)
+    for p in seen_prov.values():
+        h.update(repr(_provisioner_sig(p)).encode())
+    digest = h.digest()
+    problem.__dict__["_digest"] = digest
+    return digest
+
+
 def _problems_content_equal(a: EncodedProblem, b: EncodedProblem) -> bool:
-    """Full content equality between two encoded problems, including the pod
-    NAMES each group expands to (a reused problem's result decodes the OLD
-    pod objects' names — renamed pods must miss). Cheap relative to a solve:
-    array compares are bytes-level, names are a single tuple compare."""
+    """TEST ORACLE for ``problem_digest`` — not called on the hot path.
+
+    Field-by-field content equality between two encoded problems, including
+    the pod NAMES each group expands to (a reused problem's result decodes
+    the OLD pod objects' names — renamed pods must miss). Interning compares
+    digests instead (O(1) per slot); ``tests/test_solver.py`` cross-checks
+    that digest equality and this definition agree, so any future
+    EncodedProblem field must be added to BOTH or the test that perturbs it
+    will catch the drift."""
     if (a.G, a.O, a.E) != (b.G, b.O, b.E):
         return False
     if a.resource_axes != b.resource_axes or a.zones != b.zones:
@@ -293,13 +363,34 @@ class Solver(abc.ABC):
         rounded plans, race outcome memory) keys on problem identity. Without
         interning, a steady-state operator whose cluster is momentarily
         unchanged would pay the pattern warmup on every cycle and never reach
-        the learned plan. One slot: the steady state being optimized is
-        consecutive reconciles of the same batch."""
+        the learned plan. A few slots: the steady state being optimized is
+        consecutive reconciles of the same batch.
+
+        Thread-safety/staleness contract: ``solve_pods`` is single-threaded
+        per Solver instance (the operator's provisioning loop owns it; the
+        deprovisioning sweep shares the instance but runs on the same
+        reconcile thread). On an intern hit the cached problem's embedded
+        objects (groups, options, existing, seed_pods) are REPLACED by the
+        fresh encode's, so any consumer reading non-encoded fields — launch
+        paths reading option.provisioner, limit enforcement, decode — always
+        sees this reconcile's live objects, never a stale generation
+        (round-4 advisor finding)."""
         slots = getattr(self, "_interned_problems", None)
         if slots is None:
             slots = self._interned_problems = []
+        digest = problem_digest(problem)
         for cached in slots:
-            if _problems_content_equal(cached, problem):
+            if problem_digest(cached) == digest:
+                # refresh embedded objects: content-equal by digest (names,
+                # option identities, provisioner sigs all covered), so the
+                # learned state stays valid while object references go live
+                cached.groups = problem.groups
+                cached.options = problem.options
+                cached.existing = problem.existing
+                cached.seed_pods = problem.seed_pods
+                # drop the name cache too: it pins the PRIOR generation's pod
+                # objects (names are equal, but the memory must free)
+                cached.__dict__.pop("_group_names", None)
                 return cached
         slots.append(problem)
         if len(slots) > 4:
@@ -325,6 +416,11 @@ class Solver(abc.ABC):
                     encode(pods, provisioners, existing, daemonsets)
                 )
             encode_s += time.perf_counter() - t0
+            # anchor the latency budget at ENTRY (before encode): the budget
+            # is an end-to-end contract, so a fresh batch's encode time comes
+            # out of the polish budget, not on top of it (round-4 verdict
+            # item 1: cold_solve was structurally encode + full budget)
+            problem.__dict__["_entry_t"] = t0
             with span("solve.backend"):
                 result = self.solve(problem)
             # Preference relaxation (the reference scheduler's relaxation
@@ -356,6 +452,7 @@ class Solver(abc.ABC):
                     t_enc = time.perf_counter()
                     problem = encode(work, provisioners, existing, daemonsets)
                     encode_s += time.perf_counter() - t_enc
+                    problem.__dict__["_entry_t"] = t0
                     result = self.solve(problem)
             # Final fallback: the weight gate pins each group to its highest-
             # weight compatible pool; a group can be per-pod compatible yet
@@ -379,6 +476,7 @@ class Solver(abc.ABC):
                         weight_degate=degate,
                     )
                     encode_s += time.perf_counter() - t_enc
+                    problem2.__dict__["_entry_t"] = t0
                     result2 = self.solve(problem2)
                 if len(result2.unschedulable) < len(result.unschedulable):
                     result, problem = result2, problem2
@@ -509,6 +607,11 @@ class TPUSolver(Solver):
 
     def solve(self, problem: EncodedProblem) -> SolveResult:
         t0 = time.perf_counter()
+        # end-to-end anchor: when solve_pods stamped its entry time (this
+        # solve follows a fresh encode), deadlines count from THERE — encode
+        # spent part of the budget already. Popped so a later direct
+        # solve(problem) can't see a stale timestamp and zero its budget.
+        t_anchor = problem.__dict__.pop("_entry_t", t0)
         if problem.G == 0:
             return SolveResult(stats={"backend": 1.0})
         if problem.O == 0 and problem.E == 0:
@@ -561,7 +664,7 @@ class TPUSolver(Solver):
             # the host path may spend budget left after a feasible plan exists
             # on adaptive polish (pattern CG + ruin-recreate); quality mode
             # gets a fixed generous cap instead of its multi-second budget
-            host_deadline = t0 + min(self.latency_budget_s * 0.85, 0.5)
+            host_deadline = t_anchor + min(self.latency_budget_s * 0.85, 0.5)
             host_result = solve_host(problem, deadline=host_deadline)
         except Exception:
             host_result = None  # any host-path failure falls through to kernel
@@ -583,7 +686,7 @@ class TPUSolver(Solver):
 
                     improved = topo_improve(
                         problem, self, host_result.cost,
-                        deadline=t0 + self.latency_budget_s * 0.85,
+                        deadline=t_anchor + self.latency_budget_s * 0.85,
                         incumbent=host_result,
                     )
                     if improved is not None:
@@ -611,7 +714,7 @@ class TPUSolver(Solver):
                 kernel_result = self._poll_dispatch(
                     problem,
                     dispatched,
-                    deadline=t0 + self.latency_budget_s,
+                    deadline=t_anchor + self.latency_budget_s,
                     host_cost=host_cmp,
                 )
             if kernel_result is not None and (
@@ -1077,7 +1180,9 @@ class TPUSolver(Solver):
         s_new = new_opt.shape[0]
         group_names = problem.__dict__.get("_group_names")
         if group_names is None:
-            group_names = [[p.name for p in g.pods] for g in problem.groups]
+            from .result import LazyNames
+
+            group_names = [LazyNames(g.pods) for g in problem.groups]
             problem.__dict__["_group_names"] = group_names
         # slot -> name segments (lazy NameSlice views; no per-pod string copies)
         new_segs: List[List[tuple]] = [[] for _ in range(s_new)]

@@ -235,3 +235,65 @@ def _mixed_problem_pods(n):
                         requests=Resources(cpu=cpu, memory=mem)))
     prov = Provisioner(meta=ObjectMeta(name="default"))
     return pods, [(prov, generate_catalog(n_types=60))]
+
+
+class TestSimilarWarmStart:
+    """Cold-solve fast path: learned pattern pools transfer between
+    content-similar problems (same option table, groups matched by
+    signature), with duplicate-signature groups mapped one-to-one."""
+
+    def _learn(self, solver, pods, provs):
+        from karpenter_tpu.solver import encode
+
+        problem = encode(pods, provs)
+        for _ in range(4):  # repeat solves bank + converge the pattern pool
+            solver.solve(problem)
+        return problem
+
+    def test_transfers_to_similar_batch(self):
+        import numpy as np
+        from helpers import make_pod, make_pods, setup as _setup
+        from karpenter_tpu.solver import TPUSolver, encode, validate
+        from karpenter_tpu.solver import patterns as P
+
+        provs = _setup(12)
+        pods = make_pods(5000, cpu="250m", memory="512Mi")
+        solver = TPUSolver(portfolio=4)
+        self._learn(solver, pods, provs)
+        # fresh batch, one extra pod: new problem object, similar content
+        pods2 = make_pods(5000, cpu="250m", memory="512Mi") + [
+            make_pod(name="extra", cpu="100m", memory="128Mi")
+        ]
+        res = solver.solve_pods(pods2, provs)
+        p2 = encode(pods2, provs)
+        assert validate(p2, res) == []
+        assert not res.unschedulable
+        assert res.stats.get("similar_warm") == 1.0
+
+    def test_duplicate_signature_groups_map_one_to_one(self):
+        """Two groups with identical (demand, compat) signatures must not
+        both claim the same donor pattern content — that would pack 2x the
+        pods per node. Donor AND target carry duplicate-signature groups so
+        the remap actually runs; the plan must validate."""
+        from helpers import make_pod, make_pods, setup as _setup
+        from karpenter_tpu.solver import TPUSolver, encode, validate
+        from karpenter_tpu.solver import patterns as P
+
+        provs = _setup(12)
+
+        def split_batch(extra=0):
+            a = make_pods(2500, prefix="a", cpu="250m", memory="512Mi", labels={"team": "a"})
+            b = make_pods(2500, prefix="b", cpu="250m", memory="512Mi", labels={"team": "b"})
+            out = a + b
+            if extra:
+                out.append(make_pod(name="extra", cpu="100m", memory="128Mi"))
+            return out
+
+        solver = TPUSolver(portfolio=4)
+        learned = self._learn(solver, split_batch(), provs)
+        assert learned.G >= 2  # labels split the same shape into two groups
+        res = solver.solve_pods(split_batch(extra=1), provs)
+        p2 = encode(split_batch(extra=1), provs)
+        assert validate(p2, res) == []
+        assert not res.unschedulable
+        assert res.stats.get("similar_warm") == 1.0

@@ -399,3 +399,58 @@ class TestRaceMissMemory:
         assert problem.__dict__["_race_miss_count"] == 1
         s._poll_dispatch(problem, dispatched, deadline=_t.perf_counter(), host_cost=1.0)
         assert problem.__dict__["_race_kernel_lost"] is True
+
+
+class TestProblemDigest:
+    """problem_digest is the interning equality; _problems_content_equal is
+    the readable field-by-field oracle. They must agree, or a future
+    EncodedProblem field added to one and not the other silently changes
+    what interning considers 'the same problem'."""
+
+    def _encode(self, n=6, rename=None, cpu="250m"):
+        from helpers import make_pods, setup as _setup
+
+        pods = make_pods(n, cpu=cpu)
+        if rename is not None:
+            pods[rename].meta.name = "renamed-pod"
+        return encode(pods, _setup(5))
+
+    def test_identical_content_same_digest(self):
+        from karpenter_tpu.solver.solver import (
+            _problems_content_equal,
+            problem_digest,
+        )
+
+        a, b = self._encode(), self._encode()
+        assert _problems_content_equal(a, b)
+        assert problem_digest(a) == problem_digest(b)
+
+    def test_renamed_pod_changes_digest(self):
+        from karpenter_tpu.solver.solver import (
+            _problems_content_equal,
+            problem_digest,
+        )
+
+        a, b = self._encode(), self._encode(rename=2)
+        assert not _problems_content_equal(a, b)
+        assert problem_digest(a) != problem_digest(b)
+
+    def test_changed_demand_changes_digest(self):
+        from karpenter_tpu.solver.solver import (
+            _problems_content_equal,
+            problem_digest,
+        )
+
+        a, b = self._encode(cpu="250m"), self._encode(cpu="300m")
+        assert not _problems_content_equal(a, b)
+        assert problem_digest(a) != problem_digest(b)
+
+    def test_intern_refreshes_embedded_objects(self):
+        """On an intern hit the cached problem must hand back THIS encode's
+        live objects (groups/options), not the prior generation's."""
+        s = TPUSolver(portfolio=4)
+        a, b = self._encode(), self._encode()
+        assert s._intern_problem(a) is a
+        assert s._intern_problem(b) is a  # content-equal -> interned
+        assert a.groups is b.groups  # refreshed to the fresh encode's objects
+        assert a.options is b.options
