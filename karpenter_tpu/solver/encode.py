@@ -80,6 +80,28 @@ def _items_t(d) -> tuple:
     return tuple(d.items()) if d else _EMPTY
 
 
+def _spread_sig(c) -> tuple:
+    """Per-constraint signature cached ON the constraint object: pods stamped
+    from one controller template share constraint objects (and our own
+    apiserver store hands out shared specs), so the sort+tuple work runs once
+    per template instead of once per pod. Constraints are treated immutable
+    after first encode, like the pod fields under ``_signature``."""
+    s = c.__dict__.get("_sig")
+    if s is None:
+        s = (c.max_skew, c.topology_key, c.when_unsatisfiable,
+             _sorted_items(c.label_selector))
+        c.__dict__["_sig"] = s
+    return s
+
+
+def _aff_sig(t) -> tuple:
+    s = t.__dict__.get("_sig")
+    if s is None:
+        s = (t.topology_key, t.anti, _sorted_items(t.label_selector))
+        t.__dict__["_sig"] = s
+    return s
+
+
 def _signature(pod: Pod) -> tuple:
     """Scheduling-identity key, built from raw fields (no Requirements objects —
     that construction cost dominates 50k-pod encodes) and cached on the pod, so
@@ -114,12 +136,10 @@ def _signature(pod: Pod) -> tuple:
         tol = tuple(sorted((t.key, t.operator, t.value, t.effect) for t in pod.tolerations))
     spread = _EMPTY
     if pod.topology_spread:
-        spread = tuple(sorted((c.max_skew, c.topology_key, c.when_unsatisfiable,
-                               _sorted_items(c.label_selector)) for c in pod.effective_spread()))
+        spread = tuple(sorted(_spread_sig(c) for c in pod.effective_spread()))
     aff = _EMPTY
     if pod.affinity_terms:
-        aff = tuple(sorted((t.topology_key, t.anti, _sorted_items(t.label_selector))
-                           for t in pod.affinity_terms))
+        aff = tuple(sorted(_aff_sig(t) for t in pod.affinity_terms))
     sig = (
         _items_t(pod.requests.items_mapping()),
         _items_t(pod.node_selector),

@@ -694,7 +694,9 @@ def evacuate_into_existing(
 
 
 def solve_host(
-    problem: EncodedProblem, deadline: Optional[float] = None
+    problem: EncodedProblem,
+    deadline: Optional[float] = None,
+    spike_s: float = 1.5,
 ) -> Optional[SolveResult]:
     """Full host pipeline for LP-safe problems. Returns None when the problem
     has constraint shapes only the kernel handles (spread/affinity/colocate).
@@ -744,7 +746,7 @@ def solve_host(
             # in-place placement moves behind for the pipeline retry
             result = _finalize_host(
                 problem, placements.copy(), rem.copy(), ex_rem.copy(),
-                plan_obj, best, deadline, t0,
+                plan_obj, best, deadline, t0, spike_s,
             )
             if result is not None:
                 result.stats["similar_warm"] = 1.0
@@ -813,7 +815,9 @@ def solve_host(
             ):
                 best = (g_opens, g_left, g_cost)
 
-    return _finalize_host(problem, placements, rem, ex_rem, plan_obj, best, deadline, t0)
+    return _finalize_host(
+        problem, placements, rem, ex_rem, plan_obj, best, deadline, t0, spike_s
+    )
 
 
 def _finalize_host(
@@ -825,6 +829,7 @@ def _finalize_host(
     best: Optional[Tuple[List[Opened], np.ndarray, float]],
     deadline: Optional[float],
     t0: float,
+    spike_s: float = 1.5,
 ) -> Optional[SolveResult]:
     """Shared tail of every host path: adaptive polish (pattern CG +
     ruin-recreate sweep), warm-state snapshot, existing-fragment evacuation,
@@ -854,7 +859,7 @@ def _finalize_host(
 
         improved = pattern_improve(
             problem, rem_eff, best[0], best[2], plan_obj.cols, plan_obj.fun,
-            deadline=deadline,
+            deadline=deadline, spike_s=spike_s,
         )
         if improved is not None:
             best = (improved[0], best[1], improved[1])
@@ -866,7 +871,7 @@ def _finalize_host(
             # the pattern warmup already blew this solve's budget once —
             # finish the whole adaptation (frac sweep included) in the same
             # spike instead of leaking a second slow solve
-            deadline = max(deadline, time.perf_counter() + 0.1)
+            deadline = max(deadline, time.perf_counter() + min(0.1, spike_s))
         if (
             deadline is not None
             and problem.__dict__.get("_rr_exhausted_at") != best[2]

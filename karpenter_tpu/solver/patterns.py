@@ -52,14 +52,20 @@ _POOL_CACHE_MAX = 4
 _pool_cache: Dict[int, tuple] = {}
 
 
-def _count_improvement(savings: float) -> None:
-    """Metric semantic: savings DELIVERED per returned improvement — every
-    solve that hands back a pattern-improved plan counts, cached or computed,
-    so the counter tracks what the closer is worth in steady state."""
+def _count_improvement(savings: float, pool: "Optional[_Pool]" = None) -> None:
+    """Metric semantics: PATTERN_IMPROVEMENTS counts every solve that hands
+    back a pattern-improved plan (cached or computed — the delivery rate);
+    PATTERN_SAVINGS counts each problem's dollar delta ONCE, on first
+    delivery, so a steady-state reconcile loop replaying the cached plan
+    doesn't scale the cumulative-dollars metric with reconcile frequency
+    (round-4 advisor finding)."""
     from ..utils import metrics
 
     metrics.PATTERN_IMPROVEMENTS.inc()
-    metrics.PATTERN_SAVINGS.inc(value=savings)
+    if pool is None or not pool.savings_counted:
+        metrics.PATTERN_SAVINGS.inc(value=savings)
+        if pool is not None:
+            pool.savings_counted = True
 
 
 def _cache_put(cache: Dict[int, tuple], key: int, value: tuple, cap: int) -> None:
@@ -247,6 +253,8 @@ class _Pool:
         # similarity-remapped pools must run at least one full CG pricing
         # cycle before the gap gate may trust their master objective
         self.needs_reprice = False
+        # savings metric counted at most once per problem (see _count_improvement)
+        self.savings_counted = False
         # rounded integer plan cached once CG converges: warm re-solves of the
         # same problem return it for the cost of one dict hit
         self.rounded: Optional[Tuple[List[Opened], float]] = None
@@ -408,6 +416,7 @@ def pattern_improve(
     deadline: Optional[float] = None,
     min_pods: int = 4000,
     gap_threshold: float = 1.012,
+    spike_s: float = 1.5,
 ) -> Optional[Tuple[List[Opened], float]]:
     """Improve the incumbent open-node plan by pattern CG, within ``deadline``.
 
@@ -443,7 +452,7 @@ def pattern_improve(
         if pool.converged and pool.rounded is not None:
             opens, cost = pool.rounded
             if cost < incumbent_cost - 1e-9:
-                _count_improvement(incumbent_cost - cost)
+                _count_improvement(incumbent_cost - cost, pool)
                 return opens, cost
             return None
     else:
@@ -458,8 +467,9 @@ def pattern_improve(
         # the converged, rounded plan in ~ms. Steady-state latency is the
         # contract; a single bounded warmup spike is not. The flag lets the
         # caller extend its own polish deadline the same one time.
-        if deadline is not None:
-            deadline = max(deadline, time.perf_counter() + 0.25)
+        spike = min(0.25, float(spike_s))
+        if deadline is not None and spike > 0:
+            deadline = max(deadline, time.perf_counter() + spike)
             problem.__dict__["_patterns_warmup_solve"] = True
 
     res = _solve_master(pool, price, rem, active)
@@ -511,6 +521,6 @@ def pattern_improve(
         pool.rounded = rounded
     opens, cost = rounded
     if cost < incumbent_cost - 1e-9:
-        _count_improvement(incumbent_cost - cost)
+        _count_improvement(incumbent_cost - cost, pool)
         return opens, cost
     return None
