@@ -144,7 +144,18 @@ def lp_lower_bound(problem: EncodedProblem, time_limit: float = 30.0) -> Optiona
 
 
 def best_lower_bound(problem: EncodedProblem) -> float:
-    """Tightest available bound: LP when it solves, else the fractional bound."""
+    """Tightest available bound: LP when it solves, else the fractional bound.
+
+    Known looseness (measured, 20k-repack config): with existing capacity the
+    LP tiles the in-flight bins FRACTIONALLY, while any real packing commits
+    one integer pattern per bin. Running the joint existing+new pattern CG
+    (``repack.py``) to convergence puts the integral optimum near 84.5 vs
+    this bound's 81.8 on that config — i.e. ~0.967 is the efficiency CEILING
+    there, not a solver gap. A tighter valid bound needs exact per-bin
+    integer pricing (~30s/CG-iteration at 1,500 bins) — attempted and
+    rejected as bench-side cost; capacity-relaxed cluster pricing is cheap
+    but comes out WEAKER than the LP (member-max capacity inflates the
+    fleet)."""
     frac = fractional_lower_bound(problem)
     lp = lp_lower_bound(problem)
     if lp is None:

@@ -525,10 +525,31 @@ def _maybe_compact_vocab() -> None:
         _table_cache.clear()
         _surface_cols.clear()
         _ex_table_cache.clear()
+        _value_props.clear()  # entries embed vocab codes
 
 
 _surface_cols: Dict[int, tuple] = {}  # id(surface) -> (pin, vocab gen, cols)
 _SURFACE_COLS_MAX = 200_000  # bound: one entry per live interned surface
+
+_value_props: Dict[str, tuple] = {}
+
+
+def _make_value_props(v: str) -> tuple:
+    """(cplx, code, num) for a singleton value, memoized per VALUE string:
+    label values repeat across thousands of surfaces, and the numeric parse
+    costs a raised ValueError for every non-numeric value — ~45% of a
+    first-contact 1,500-node surface-table build before this memo."""
+    props = _value_props.get(v)
+    if props is None:
+        try:
+            num = float(int(v))
+        except ValueError:
+            num = np.nan
+        props = (False, _code(v), num)
+        if len(_value_props) >= _VOCAB_MAX:
+            _value_props.clear()
+        _value_props[v] = props
+    return props
 
 
 def _surface_columns(reqs: Requirements) -> list:
@@ -542,17 +563,16 @@ def _surface_columns(reqs: Requirements) -> list:
     if e is not None and e[0] is reqs and e[1] == _VOCAB_GEN:
         return e[2]
     cols = []
-    for r in reqs:
-        v = r.single_value()
-        if v is None:
-            props = (True, -1, np.nan)
+    # friend access to the keyed dict: the public iterator + single_value()
+    # per requirement costs ~2x this whole loop at 3,810-surface first
+    # contact (complement/multi-value checks inlined)
+    for key, r in reqs._by_key.items():
+        vals = r.values
+        if not r.complement and len(vals) == 1:
+            props = _make_value_props(next(iter(vals)))
         else:
-            try:
-                num = float(int(v))
-            except ValueError:
-                num = np.nan
-            props = (False, _code(v), num)
-        cols.append((r.key, props))
+            props = (True, -1, np.nan)
+        cols.append((key, props))
     if len(_surface_cols) >= _SURFACE_COLS_MAX:
         _surface_cols.clear()
     _surface_cols[id(reqs)] = (reqs, _VOCAB_GEN, cols)
@@ -1221,6 +1241,7 @@ def sizing_demand(problem: "EncodedProblem") -> np.ndarray:
 
 
 _node_surface_intern: Dict[str, tuple] = {}  # node name -> (labels copy, surface)
+_labels_surface_intern: Dict[tuple, Requirements] = {}  # label items -> surface
 _NODE_SURFACE_MAX = 100_000  # bound for a long-lived operator's name churn
 
 
@@ -1244,7 +1265,17 @@ def _node_surface(node: Node) -> Requirements:
     if entry is not None and entry[0] == labels:
         surface = entry[1]
     else:
-        surface = Requirements.from_labels(labels)
+        # content-level intern: fleet nodes share label SETS (type, zone,
+        # provisioner, capacity-type...), so first contact with 1,500 nodes
+        # builds one surface per distinct label set, not per node — and the
+        # shared object keeps every identity-keyed downstream memo hitting
+        content_key = tuple(sorted(labels.items()))
+        surface = _labels_surface_intern.get(content_key)
+        if surface is None:
+            surface = Requirements.from_labels(labels)
+            if len(_labels_surface_intern) >= _NODE_SURFACE_MAX:
+                _labels_surface_intern.clear()
+            _labels_surface_intern[content_key] = surface
         if len(_node_surface_intern) >= _NODE_SURFACE_MAX:
             _node_surface_intern.clear()
         # store a copy: in-place mutation of the caller's dict must not be

@@ -857,12 +857,44 @@ def _finalize_host(
         # engaged from the second solve of a problem
         from .patterns import pattern_improve
 
-        improved = pattern_improve(
-            problem, rem_eff, best[0], best[2], plan_obj.cols, plan_obj.fun,
-            deadline=deadline, spike_s=spike_s,
-        )
-        if improved is not None:
-            best = (improved[0], best[1], improved[1])
+        if not problem.__dict__.get("_repack_owned", False):
+            improved = pattern_improve(
+                problem, rem_eff, best[0], best[2], plan_obj.cols, plan_obj.fun,
+                deadline=deadline, spike_s=spike_s,
+            )
+            if improved is not None:
+                best = (improved[0], best[1], improved[1])
+        if problem.E:
+            # joint existing+new pattern CG (repack.py): re-decides how much
+            # each existing bin absorbs TOGETHER with the new-node patterns —
+            # the sequential refill-then-LP decomposition is the repack
+            # efficiency floor (round-4 verdict item 5). Gated like the other
+            # closers; adopted only when cheaper and count-exact.
+            from .repack import repack_improve
+
+            rp = repack_improve(
+                problem, best[2], placements, best[0], plan_obj.cols,
+                deadline=deadline, spike_s=spike_s, incumbent_left=best[1],
+            )
+            if rp is not None:
+                new_plc, new_opens, new_cost = rp
+                if not _check_counts(problem, new_plc, new_opens, best[1]):
+                    placements = new_plc
+                    best = (new_opens, best[1], new_cost)
+                    # the joint plan OWNS this problem now: the refill-
+                    # decomposition state (rem, pattern pool's cached plan)
+                    # no longer matches the placements, so rem is rebased and
+                    # pattern_improve stays out — its cached rounding covers
+                    # the old remainder and would poison the count gate
+                    problem.__dict__["_repack_owned"] = True
+                    rem = (
+                        problem.count.astype(np.int64) - placements.sum(axis=1)
+                    ).astype(rem.dtype)
+                    # existing headroom moved with the new placements
+                    ex_rem = problem.ex_rem.astype(np.float64) - (
+                        placements.T.astype(np.float64)
+                        @ problem.demand.astype(np.float64)
+                    )
         # leftover-budget polish: varied ruin fractions explore different
         # kill thresholds; each round kept only if strictly cheaper; stops at
         # the deadline or when improvement dries up — no fixed round cap.

@@ -296,9 +296,25 @@ def _price_patterns(
     each step every option adds a bulk of the group with the best dual value
     per unit of its (dynamically) scarcest remaining resource. Returns
     [len(cols), G] integer contents."""
-    d = problem.demand.astype(np.float64)
-    a = problem.alloc.astype(np.float64)[cols].copy()  # [O, R] remaining
-    compat = problem.compat[:, cols].T  # [O, G]
+    return price_patterns_core(
+        problem.demand.astype(np.float64),
+        problem.alloc.astype(np.float64)[cols].copy(),
+        problem.compat[:, cols].T,
+        duals,
+        max_steps,
+    )
+
+
+def price_patterns_core(
+    d: np.ndarray,
+    a: np.ndarray,
+    compat: np.ndarray,
+    duals: np.ndarray,
+    max_steps: int = 48,
+) -> np.ndarray:
+    """The knapsack body, shared with repack.py's bin-cluster pricing:
+    capacity rows ``a`` [N, R] and ``compat`` [N, G] can be launch options or
+    existing-bin clusters — the pricing mathematics is identical."""
     O, G = compat.shape
     k = np.zeros((O, G), np.int64)
     live = np.ones(O, bool)
