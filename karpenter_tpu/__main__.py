@@ -33,6 +33,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--batch-idle-duration", type=float, default=None)
     p.add_argument("--batch-max-duration", type=float, default=None)
     p.add_argument("--interruption-queue-name", default=None)
+    p.add_argument("--cluster-endpoint", default=None,
+                   help="apiserver endpoint (http://host:port) to reconcile "
+                        "against; default is the embedded in-process store. "
+                        "The reference operator's only mode is remote "
+                        "(cmd/controller/main.go:33-71).")
+    p.add_argument("--serve-cluster-api", type=int, default=None, metavar="PORT",
+                   help="also serve this operator's cluster store as an "
+                        "apiserver surface on PORT (watch/list/patch + "
+                        "admission over HTTP) for external clients")
     p.add_argument("--tick", type=float, default=0.25, help="loop poll interval")
     return p
 
@@ -63,7 +72,25 @@ def main(argv=None) -> int:
         settings.apply(overrides)
 
     ctx = OperatorContext.discover(settings=settings)
-    op = Operator.new(provider=ctx.provider, settings=ctx.settings)
+    cluster = None
+    if args.cluster_endpoint:
+        from .state import HTTPCluster
+
+        cluster = HTTPCluster(args.cluster_endpoint)
+    op = Operator.new(provider=ctx.provider, settings=ctx.settings, cluster=cluster)
+    cluster_api = None
+    if args.serve_cluster_api is not None:
+        if args.cluster_endpoint:
+            log.warning(
+                "--serve-cluster-api ignored: this operator is a CLIENT of "
+                "--cluster-endpoint; serve the API from the store owner"
+            )
+        else:
+            from .state import ClusterAPIServer
+
+            cluster_api = ClusterAPIServer(
+                backing=op.cluster, port=args.serve_cluster_api
+            ).start()
     import logging
 
     kv(log, logging.INFO, "operator starting",
@@ -107,6 +134,10 @@ def main(argv=None) -> int:
     finally:
         if elector is not None:
             elector.release()
+        if cluster_api is not None:
+            cluster_api.stop()
+        if cluster is not None:
+            cluster.close()
     kv(log, logging.INFO, "operator stopped")
     return 0
 

@@ -366,5 +366,11 @@ def register_node(cluster: Cluster, machine: Machine, provisioner: Provisioner) 
     )
     machine.status.registered = True
     machine.status.initialized = True
+    # announce the status transition: against the apiserver-backed cluster
+    # (HTTPCluster) this PUTs the machine so the authoritative store and
+    # other watchers see registered/initialized flip — in-process it is a
+    # version bump on the shared object (reference: the machine lifecycle
+    # controller patches Machine status through the apiserver)
+    cluster.update(machine)
     cluster.add_node(node)
     return node

@@ -57,11 +57,17 @@ class Operator:
         solver: Optional[Solver] = None,
         queue: Optional[FakeQueue] = None,
         clock: Optional[Clock] = None,
+        cluster: Optional[Cluster] = None,
     ) -> "Operator":
+        """``cluster`` defaults to the in-process store; pass an
+        ``HTTPCluster`` to run every controller against the apiserver wire
+        surface (reads from the informer cache, writes + admission over
+        HTTP) — the reference operator's only mode
+        (``cmd/controller/main.go:33-71``)."""
         settings = settings or Settings()
         settings.validate()
         clock = clock or Clock()
-        cluster = Cluster()
+        cluster = cluster if cluster is not None else Cluster()
         provider = provider or FakeCloudProvider()
         if getattr(provider, "node_template_lookup", "absent") is None:
             # let the cloud provider resolve NodeTemplate refs at launch time

@@ -1,3 +1,5 @@
 from .cluster import Cluster
+from .apiserver import ClusterAPIServer
+from .httpcluster import HTTPCluster
 
-__all__ = ["Cluster"]
+__all__ = ["Cluster", "ClusterAPIServer", "HTTPCluster"]

@@ -65,6 +65,12 @@ class Cluster:
     def _delete(self, coll: Dict[str, object], name: str):
         with self._lock:
             obj = coll.pop(name, None)
+            if obj is not None:
+                # deletes advance the store version too: a watch client must
+                # be able to order a DELETED event against later writes (the
+                # apiserver surface replays events by resourceVersion)
+                self._version += 1
+                obj.meta.resource_version = self._version
         if obj is not None:
             self._emit("DELETED", obj)
         return obj
@@ -150,6 +156,9 @@ class Cluster:
             pod = self.pods[pod_name]
             pod.node_name = node_name
             pod.phase = "Running"
+            # bindings are writes: version them so watch clients order them
+            self._version += 1
+            pod.meta.resource_version = self._version
         self._emit("MODIFIED", pod)
 
     def pods_on_node(self, node_name: str) -> List[Pod]:

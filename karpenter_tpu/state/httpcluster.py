@@ -1,0 +1,302 @@
+"""HTTPCluster: the controllers' cluster client over the apiserver wire.
+
+The reference's controllers read through controller-runtime's CACHED client
+(informers list+watch the apiserver; reads hit the local cache, writes go to
+the server — ``/root/reference/pkg/context/context.go:76-166`` builds exactly
+that stack). ``HTTPCluster`` is the same shape against
+``state/apiserver.py``:
+
+* it IS a ``Cluster`` (subclass) — every query controllers use
+  (``pending_pods``, ``existing_capacity``, ``pdbs_for_pod``...) reads the
+  local informer cache with zero wire traffic;
+* every WRITE (add/update/delete/bind) goes over HTTP first — the server
+  runs admission at that boundary and its rejection surfaces here as
+  ``AdmissionError`` (the webhook deny path) — then applies to the local
+  cache immediately (read-your-writes, like an optimistic informer update);
+* a watch loop long-polls ``/watch`` and applies remote events idempotently
+  by resource version, firing the same watch callbacks controllers register
+  against an in-process ``Cluster`` (the informer event handlers). A "gone"
+  response triggers a full relist, k8s-style.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.error
+import urllib.request
+from typing import Dict, Optional
+
+from ..api.admission import AdmissionError
+from ..api.codec import KINDS, kind_of, to_wire
+from ..api.objects import (
+    Machine,
+    Node,
+    NodeTemplate,
+    Pod,
+    PodDisruptionBudget,
+    Provisioner,
+)
+from .cluster import Cluster
+
+_COLLECTION_ATTR = {
+    "pods": "pods",
+    "nodes": "nodes",
+    "machines": "machines",
+    "provisioners": "provisioners",
+    "nodetemplates": "node_templates",
+    "poddisruptionbudgets": "pdbs",
+}
+
+
+class HTTPCluster(Cluster):
+    def __init__(self, endpoint: str, timeout_s: float = 10.0, watch: bool = True):
+        super().__init__()
+        self.endpoint = endpoint.rstrip("/")
+        self.timeout_s = timeout_s
+        self._bookmark = 0  # server watch seq consumed so far
+        # (kind, name) -> deferred events: the watch echo for a self-initiated
+        # write can land BEFORE the write path's own cache apply (the
+        # long-poll is already parked server-side). Applying it would
+        # pop/replace the caller's instance under it, but DROPPING it would
+        # also drop a concurrent third-party write to the same object — so
+        # events arriving during the in-flight window are deferred and
+        # replayed when the write completes (per-object version guard makes
+        # the replay idempotent).
+        self._inflight: Dict[tuple, list] = {}
+        self._stop = threading.Event()
+        self._watch_thread: Optional[threading.Thread] = None
+        self.relist()
+        if watch:
+            self._watch_thread = threading.Thread(
+                target=self._watch_loop, daemon=True
+            )
+            self._watch_thread.start()
+
+    # -- wire ----------------------------------------------------------------
+    def _call(self, method: str, path: str, body: Optional[Dict] = None) -> Dict:
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(
+            f"{self.endpoint}{path}", data=data, method=method
+        )
+        if data is not None:
+            req.add_header("Content-Type", "application/json")
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
+                return json.loads(resp.read() or b"{}")
+        except urllib.error.HTTPError as e:
+            payload = {}
+            try:
+                payload = json.loads(e.read() or b"{}")
+            except Exception:
+                pass
+            if e.code == 422 and payload.get("admission"):
+                raise AdmissionError(
+                    payload.get("kind", "object"),
+                    payload.get("name", "?"),
+                    payload.get("fieldErrors", [payload.get("error", "rejected")]),
+                )
+            raise RuntimeError(
+                f"{method} {path}: HTTP {e.code}: {payload.get('error', '')}"
+            ) from e
+
+    # -- informer cache ------------------------------------------------------
+    def relist(self) -> None:
+        """Full list of every kind, replacing the cache (initial sync and
+        watch-gone recovery). The watch bookmark is the server version read
+        BEFORE the lists: writes landing between the per-kind lists replay as
+        watch events and the per-object version guard in ``_apply_wire``
+        makes the replay idempotent — a max-across-lists bookmark would skip
+        events for kinds listed early (review finding)."""
+        version_info = self._call("GET", "/version")
+        bookmark = version_info.get("watchSeq", 0)
+        for kind, attr in _COLLECTION_ATTR.items():
+            out = self._call("GET", f"/api/{kind}")
+            decode = KINDS[kind][2]
+            with self._lock:
+                coll = getattr(self, attr)
+                coll.clear()
+                for item in out["items"]:
+                    obj = decode(item)
+                    coll[obj.meta.name] = obj
+        with self._lock:
+            self._bookmark = bookmark
+            self._version = max(self._version, version_info.get("resourceVersion", 0))
+
+    def _apply_wire(self, version: int, event: str, kind: str, wire: Dict) -> None:
+        """Apply one remote event to the cache, idempotently, and fire the
+        local watch callbacks (the informer handlers). Staleness is judged
+        PER OBJECT (event version vs the cached object's version): the relist
+        bookmark can replay events the lists already reflect, and a
+        read-your-writes echo arrives with the version the write stamped —
+        both must no-op without suppressing unrelated events."""
+        decode = KINDS[kind][2]
+        attr = _COLLECTION_ATTR[kind]
+        name = wire["meta"]["name"]
+        with self._lock:
+            if version > self._version:
+                self._version = version
+            deferred = self._inflight.get((kind, name))
+            if deferred is not None:
+                # a local write to this object is in flight: defer (replayed
+                # by the write path once its own cache apply lands)
+                deferred.append((version, event, kind, wire))
+                return
+            coll = getattr(self, attr)
+            existing = coll.get(name)
+            if existing is not None and existing.meta.resource_version >= version:
+                return  # cache already at or past this event
+            if event == "DELETED":
+                if existing is None:
+                    return  # already gone (self-applied delete, or relisted)
+                coll.pop(name)
+                obj = existing
+            else:
+                obj = decode(wire)
+                coll[name] = obj
+        self._emit(event, obj)
+
+    def _watch_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                out = self._call(
+                    "GET", f"/watch?since={self._bookmark}&timeout=5"
+                )
+            except Exception:
+                if self._stop.wait(0.2):
+                    return
+                continue
+            if out.get("gone"):
+                self.relist()
+                continue
+            for ev in out.get("events", ()):
+                self._apply_wire(
+                    ev["resourceVersion"], ev["event"], ev["kind"], ev["object"]
+                )
+                with self._lock:
+                    self._bookmark = max(self._bookmark, ev["seq"])
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._watch_thread is not None:
+            self._watch_thread.join(timeout=6)
+
+    # -- writes (server first, then read-your-writes cache apply) ------------
+    class _InFlight:
+        def __init__(self, cluster: "HTTPCluster", kind: str, name: str):
+            self.cluster, self.key = cluster, (kind, name)
+
+        def __enter__(self):
+            with self.cluster._lock:
+                self.cluster._inflight.setdefault(self.key, [])
+
+        def __exit__(self, *exc):
+            with self.cluster._lock:
+                deferred = self.cluster._inflight.pop(self.key, [])
+            # replay events that arrived mid-write: the self-echo no-ops on
+            # the per-object version guard; a concurrent third-party write
+            # (higher version) applies — nothing is lost
+            for version, event, kind, wire in deferred:
+                self.cluster._apply_wire(version, event, kind, wire)
+
+    def _create(self, obj):
+        """POST to the server, then cache the CALLER'S instance (not the
+        server's decoded copy): controllers mutate objects they hold after
+        adding them — machine status flags during registration, node flips —
+        exactly as the in-process store allows, and the cache must alias
+        those instances or HTTP-mode state silently diverges. Defaulted
+        fields the server's admission added are folded back in."""
+        kind = kind_of(obj)
+        with self._InFlight(self, kind, obj.meta.name):
+            stored = self._call("POST", f"/api/{kind}", to_wire(obj))
+            decoded = KINDS[kind][2](stored)
+            if kind in ("provisioners", "nodetemplates"):
+                # admission defaulting ran server-side; adopt the stored spec
+                obj.__dict__.update(decoded.__dict__)
+            version = stored["meta"]["resourceVersion"]
+            obj.meta.resource_version = version
+            with self._lock:
+                getattr(self, _COLLECTION_ATTR[kind])[obj.meta.name] = obj
+                self._version = max(self._version, version)
+        self._emit("ADDED", obj)
+        return obj
+
+    def add_pod(self, pod: Pod) -> Pod:
+        return self._create(pod)
+
+    def add_node(self, node: Node) -> Node:
+        return self._create(node)
+
+    def add_machine(self, machine: Machine) -> Machine:
+        return self._create(machine)
+
+    def add_provisioner(self, provisioner: Provisioner) -> Provisioner:
+        return self._create(provisioner)
+
+    def add_node_template(self, t: NodeTemplate) -> NodeTemplate:
+        return self._create(t)
+
+    def add_pdb(self, pdb: PodDisruptionBudget) -> PodDisruptionBudget:
+        return self._create(pdb)
+
+    def update(self, obj) -> None:
+        kind = kind_of(obj)
+        with self._InFlight(self, kind, obj.meta.name):
+            stored = self._call(
+                "PUT", f"/api/{kind}/{obj.meta.name}", to_wire(obj)
+            )
+            # keep the CALLER'S object authoritative in the cache: controllers
+            # mutate objects they hold and expect those instances to stay live
+            # (the same contract as the in-process store). Only the version
+            # advances from the server's stored copy.
+            with self._lock:
+                version = stored["meta"]["resourceVersion"]
+                obj.meta.resource_version = version
+                if isinstance(obj, (Pod, Node)):
+                    obj.invalidate_scheduling_cache()
+                getattr(self, _COLLECTION_ATTR[kind])[obj.meta.name] = obj
+                self._version = max(self._version, version)
+        self._emit("MODIFIED", obj)
+
+    def _remote_delete(self, kind: str, name: str):
+        with self._InFlight(self, kind, name):
+            try:
+                out = self._call("DELETE", f"/api/{kind}/{name}")
+            except RuntimeError as e:
+                if "HTTP 404" in str(e):
+                    return None
+                raise
+            with self._lock:
+                obj = getattr(self, _COLLECTION_ATTR[kind]).pop(name, None)
+                self._version = max(self._version, out["meta"]["resourceVersion"])
+        if obj is not None:
+            self._emit("DELETED", obj)
+        return obj
+
+    def delete_pod(self, name: str) -> Optional[Pod]:
+        return self._remote_delete("pods", name)
+
+    def delete_node(self, name: str) -> Optional[Node]:
+        return self._remote_delete("nodes", name)
+
+    def delete_machine(self, name: str) -> Optional[Machine]:
+        return self._remote_delete("machines", name)
+
+    def delete_provisioner(self, name: str) -> Optional[Provisioner]:
+        return self._remote_delete("provisioners", name)
+
+    def bind_pod(self, pod_name: str, node_name: str) -> None:
+        with self._InFlight(self, "pods", pod_name):
+            out = self._call(
+                "POST", f"/api/pods/{pod_name}/bind", {"nodeName": node_name}
+            )
+            with self._lock:
+                pod = self.pods.get(pod_name)
+                if pod is not None:
+                    pod.node_name = node_name
+                    pod.phase = "Running"
+                    version = out["meta"]["resourceVersion"]
+                    pod.meta.resource_version = version
+                    self._version = max(self._version, version)
+        if pod is not None:
+            self._emit("MODIFIED", pod)
