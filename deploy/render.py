@@ -161,6 +161,108 @@ def pdb(values: Dict) -> Dict:
     }
 
 
+def lease_pvc(values: Dict) -> Dict:
+    """Shared RWX volume carrying the leader lease: the file-lease elector
+    only provides mutual exclusion across pods that see the SAME file
+    (utils/leaderelection.py), so the HA variant mounts this into every
+    replica."""
+    return {
+        "apiVersion": "v1",
+        "kind": "PersistentVolumeClaim",
+        "metadata": {
+            "name": f"{APP}-lease",
+            "namespace": values["namespace"],
+            "labels": labels(),
+        },
+        "spec": {
+            "accessModes": ["ReadWriteMany"],
+            "resources": {"requests": {"storage": "16Mi"}},
+        },
+    }
+
+
+def state_deployment(values: Dict) -> Dict:
+    """The state tier: one replica serving the cluster apiserver surface
+    (``python -m karpenter_tpu.state.apiserver``). Operator replicas are
+    CLIENTS of this store — two leaders-in-waiting each owning a private
+    embedded store would fail over onto empty state."""
+    return {
+        "apiVersion": "apps/v1",
+        "kind": "Deployment",
+        "metadata": {
+            "name": f"{APP}-state",
+            "namespace": values["namespace"],
+            "labels": labels(),
+        },
+        "spec": {
+            "replicas": 1,
+            "selector": {"matchLabels": {"app.kubernetes.io/name": f"{APP}-state"}},
+            "template": {
+                "metadata": {"labels": {**labels(), "app.kubernetes.io/name": f"{APP}-state"}},
+                "spec": {
+                    "containers": [
+                        {
+                            "name": "state",
+                            "image": values["image"],
+                            "command": ["python", "-m", "karpenter_tpu.state.apiserver"],
+                            "args": ["--port", "8090"],
+                            "ports": [{"name": "http", "containerPort": 8090}],
+                        }
+                    ]
+                },
+            },
+        },
+    }
+
+
+def state_service(values: Dict) -> Dict:
+    return {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": {
+            "name": f"{APP}-state",
+            "namespace": values["namespace"],
+            "labels": labels(),
+        },
+        "spec": {
+            "selector": {"app.kubernetes.io/name": f"{APP}-state"},
+            "ports": [{"name": "http", "port": 8090, "targetPort": 8090}],
+        },
+    }
+
+
+def render_ha(values: Dict) -> List[Dict]:
+    """The HA overlay (reference: 2 leader-elected replicas + PDB,
+    ``charts/karpenter/templates/deployment.yaml:96-104``): the operator
+    deployment at replicas=2 with (a) the lease on a shared ReadWriteMany
+    volume and (b) --cluster-endpoint pointing every replica at the shared
+    state tier (Deployment + Service here) — replicas with private embedded
+    stores would fail over onto empty state. Applied INSTEAD of the base
+    deployment; every other base object is shared. The two-replica election
+    semantics (leader exclusivity, takeover on kill, both replicas Ready
+    throughout) are exercised end-to-end by tests/test_leader_ha.py."""
+    values = dict(values, replicas=2)
+    dep = deployment(values)
+    spec = dep["spec"]["template"]["spec"]
+    spec["volumes"] = [
+        {
+            "name": "leader-lease",
+            "persistentVolumeClaim": {"claimName": f"{APP}-lease"},
+        }
+    ]
+    container = spec["containers"][0]
+    container["volumeMounts"] = [
+        {"name": "leader-lease", "mountPath": "/var/lease"}
+    ]
+    container["args"] = container["args"] + [
+        "--leader-elect-lease", "/var/lease/karpenter-tpu-leader",
+        "--cluster-endpoint", f"http://{APP}-state.{values['namespace']}:8090",
+    ]
+    if values.get("cloud_endpoint"):
+        container["args"] += ["--cloud-endpoint", values["cloud_endpoint"]]
+    return [lease_pvc(values), state_deployment(values), state_service(values), dep]
+
+
 def render_all(values: Dict) -> List[Dict]:
     return [
         namespace(values),
@@ -179,14 +281,22 @@ def main() -> int:
     # 1 until the lease lives on a shared volume (see module docstring)
     ap.add_argument("--replicas", type=int, default=1)
     ap.add_argument("--image", default="karpenter-tpu:latest")
+    ap.add_argument("--ha", action="store_true",
+                    help="render the HA overlay (replicas=2 + shared-RWX "
+                         "lease volume) instead of the base deployment")
     ap.add_argument("--out-dir", default=None)
     args = ap.parse_args()
     values = vars(args)
-    objs = render_all(values)
+    if args.ha:
+        objs = render_ha(values)
+        prefix = "ha-"
+    else:
+        objs = render_all(values)
+        prefix = ""
     if args.out_dir:
         os.makedirs(args.out_dir, exist_ok=True)
         for obj in objs:
-            name = f"{obj['kind'].lower()}-{obj['metadata']['name']}.yaml"
+            name = f"{prefix}{obj['kind'].lower()}-{obj['metadata']['name']}.yaml"
             with open(os.path.join(args.out_dir, name), "w") as f:
                 yaml.safe_dump(obj, f, sort_keys=False)
             print(f"wrote {args.out_dir}/{name}")

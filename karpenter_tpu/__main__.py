@@ -33,6 +33,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--batch-idle-duration", type=float, default=None)
     p.add_argument("--batch-max-duration", type=float, default=None)
     p.add_argument("--interruption-queue-name", default=None)
+    p.add_argument("--cloud-endpoint", default=None,
+                   help="HTTP cloud service endpoint; default is the "
+                        "embedded fake provider. Replicas sharing a cluster "
+                        "endpoint must also share the cloud.")
+    p.add_argument("--leader-lease-duration", type=float, default=15.0)
+    p.add_argument("--leader-renew-interval", type=float, default=5.0)
     p.add_argument("--cluster-endpoint", default=None,
                    help="apiserver endpoint (http://host:port) to reconcile "
                         "against; default is the embedded in-process store. "
@@ -71,7 +77,12 @@ def main(argv=None) -> int:
     if overrides:
         settings.apply(overrides)
 
-    ctx = OperatorContext.discover(settings=settings)
+    provider = None
+    if args.cloud_endpoint:
+        from .cloudprovider.httpcloud import HTTPCloudProvider
+
+        provider = HTTPCloudProvider(args.cloud_endpoint)
+    ctx = OperatorContext.discover(provider=provider, settings=settings)
     cluster = None
     if args.cluster_endpoint:
         from .state import HTTPCluster
@@ -102,9 +113,11 @@ def main(argv=None) -> int:
         signal.signal(sig, lambda *_: stop.set())
 
     # The HTTP surface comes up BEFORE leader election: a standby replica must
-    # answer /healthz (alive) and /readyz (not ready — not leader) or the
-    # kubelet liveness probe restart-loops it. The reference likewise serves
-    # manager endpoints regardless of leadership (cmd/controller/main.go:33-71).
+    # answer /healthz and /readyz (Ready = able to serve and take over; the
+    # reference serves readiness independent of leadership) or the kubelet
+    # probes wedge a multi-replica rollout. Leadership is observable on
+    # /leaderz (cmd/controller/main.go:33-71 serves manager endpoints
+    # regardless of leadership).
     http_server = None
     if args.metrics_port >= 0:
         from .utils.httpserver import OperatorHTTPServer
@@ -112,7 +125,7 @@ def main(argv=None) -> int:
         http_server = OperatorHTTPServer(
             port=args.metrics_port,
             host=args.metrics_bind,
-            ready_check=lambda: elector is None or elector.is_leader,
+            leader_check=lambda: elector is None or elector.is_leader,
         ).start()
 
     if args.leader_elect:
@@ -121,7 +134,12 @@ def main(argv=None) -> int:
         # on_lost=stop.set: a deposed leader must stop reconciling, not just
         # flip /readyz — two live reconcilers is split-brain (the reference's
         # controller-runtime exits the process on lost leadership)
-        elector = LeaderElector(args.leader_elect_lease, on_lost=stop.set)
+        elector = LeaderElector(
+            args.leader_elect_lease,
+            lease_duration=args.leader_lease_duration,
+            renew_interval=args.leader_renew_interval,
+            on_lost=stop.set,
+        )
         kv(log, logging.INFO, "waiting for leadership", lease=args.leader_elect_lease)
         if not elector.acquire(stop=stop):
             if http_server is not None:

@@ -57,39 +57,18 @@ class FakeCloudProvider(WindowedBatchers, CloudProvider):
         self.insufficient_capacity_pools: Set[OfferingKey] = set()
         self.next_errors: List[Exception] = []
         self.instances: Dict[str, Instance] = {}
-        self.current_images: Dict[str, str] = {"default": "image-001"}
         # Network/image inventory resolved by the nodetemplate controller
         # (reference subnet/securitygroup/ami providers, pkg/providers/{subnet,
-        # securitygroup,amifamily}).
+        # securitygroup,amifamily}); shared with the HTTP cloud so selector
+        # resolution cannot diverge between backends (inventory.py).
+        from .inventory import default_inventory
+
         zones = sorted({o.zone for it in self.catalog for o in it.offerings})
-        self.subnets: List[Subnet] = [
-            Subnet(id=f"subnet-{z}", zone=z, tags={"karpenter.tpu/discovery": "cluster", "zone": z})
-            for z in zones
-        ]
+        (self.subnets, self.security_groups, self.images,
+         self.current_images) = default_inventory(zones)
         from .subnet import SubnetProvider
 
         self.subnet_provider = SubnetProvider(self.subnets)
-        self.security_groups: List[SecurityGroup] = [
-            SecurityGroup(id="sg-default", name="default",
-                          tags={"karpenter.tpu/discovery": "cluster"}),
-            SecurityGroup(id="sg-nodes", name="nodes",
-                          tags={"karpenter.tpu/discovery": "cluster", "role": "node"}),
-        ]
-        self.images: List[Image] = [
-            Image(id="image-001", family="default", created=1.0,
-                  tags={"family": "default"})
-        ]
-        # Per-(family, variant) image inventory + current pointers, the
-        # analogue of SSM default-AMI parameters per family
-        # (reference amifamily/{al2,bottlerocket,ubuntu}.go DefaultAMIs).
-        for fam in ("al2", "ubuntu", "bottlerocket"):
-            for variant in ("standard", "accelerator"):
-                img = f"img-{fam}-{variant}-001"
-                self.images.append(
-                    Image(id=img, family=fam, created=1.0,
-                          tags={"family": fam, "variant": variant})
-                )
-                self.current_images[f"{fam}/{variant}"] = img
         # Provider-side launch templates (hash-named; see launchtemplate.py)
         self.launch_templates: Dict[str, object] = {}
         # Wired by the operator: NodeTemplate name -> NodeTemplate, so create()
@@ -568,13 +547,4 @@ def _bootstrap_labels(labels: Dict[str, str]) -> Dict[str, str]:
     return out
 
 
-def _tags_match(tags: Dict[str, str], selector: Dict[str, str]) -> bool:
-    """Tag selector semantics: every selector entry must match; '*' matches any
-    value; the special key 'id' matches the resource id... handled by callers."""
-    for k, v in selector.items():
-        if v == "*":
-            if k not in tags:
-                return False
-        elif tags.get(k) != v:
-            return False
-    return True
+from .inventory import tags_match as _tags_match  # shared selector semantics

@@ -10,7 +10,7 @@ from __future__ import annotations
 
 from typing import List, Optional
 
-from ..cloudprovider.fake import FakeCloudProvider
+from ..cloudprovider.interface import CloudProvider
 from ..state.cluster import Cluster
 from ..utils.events import Recorder
 
@@ -19,7 +19,7 @@ class NodeTemplateController:
     def __init__(
         self,
         cluster: Cluster,
-        provider: FakeCloudProvider,
+        provider: CloudProvider,  # any provider with describe_* discovery
         recorder: Optional[Recorder] = None,
     ):
         self.cluster = cluster

@@ -94,7 +94,7 @@ class Operator:
             )
         nodetemplate = (
             NodeTemplateController(cluster, provider, recorder=recorder)
-            if isinstance(provider, FakeCloudProvider)
+            if hasattr(provider, "describe_security_groups")
             else None
         )
         pricing = None

@@ -285,3 +285,33 @@ class ClusterAPIServer:
             self._server.server_close()
         if self._thread is not None:
             self._thread.join(timeout=5)
+
+
+def main(argv=None) -> int:  # pragma: no cover - exercised by the HA e2e
+    """Standalone state tier: ``python -m karpenter_tpu.state.apiserver``.
+
+    The HA deployment points operator replicas at this server with
+    ``--cluster-endpoint`` (deploy/render.py render_ha)."""
+    import argparse
+    import signal
+    import threading
+
+    ap = argparse.ArgumentParser(prog="karpenter-tpu-state")
+    ap.add_argument("--port", type=int, default=8090)
+    ap.add_argument("--latency", type=float, default=0.0,
+                    help="injected per-request latency seconds (testing)")
+    args = ap.parse_args(argv)
+    srv = ClusterAPIServer(latency_s=args.latency, port=args.port).start()
+    stop = threading.Event()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        signal.signal(sig, lambda *_: stop.set())
+    print(f"cluster api serving on {srv.endpoint}", flush=True)
+    stop.wait()
+    srv.stop()
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    import sys
+
+    sys.exit(main())

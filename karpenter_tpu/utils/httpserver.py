@@ -23,11 +23,17 @@ class OperatorHTTPServer:
         registry: Optional[Registry] = None,
         ready_check: Optional[Callable[[], bool]] = None,
         healthy_check: Optional[Callable[[], bool]] = None,
+        leader_check: Optional[Callable[[], bool]] = None,
         host: str = "127.0.0.1",
     ):
         self.registry = registry or REGISTRY
         self.ready_check = ready_check or (lambda: True)
         self.healthy_check = healthy_check or (lambda: True)
+        # /leaderz is leadership observability, DISTINCT from readiness: a
+        # standby replica is Ready (it can serve probes and take over) but
+        # not leader — gating /readyz on leadership would wedge a
+        # two-replica Deployment's rolling update at 1/2 Ready forever
+        self.leader_check = leader_check or (lambda: True)
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -45,6 +51,11 @@ class OperatorHTTPServer:
                 elif path == "/readyz":
                     ok = outer.ready_check()
                     body = (b"ok" if ok else b"not ready") + b"\n"
+                    self.send_response(200 if ok else 503)
+                    self.send_header("Content-Type", "text/plain")
+                elif path == "/leaderz":
+                    ok = outer.leader_check()
+                    body = (b"leader" if ok else b"standby") + b"\n"
                     self.send_response(200 if ok else 503)
                     self.send_header("Content-Type", "text/plain")
                 else:

@@ -56,3 +56,38 @@ def test_checked_in_manifests_current():
         assert os.path.exists(path), path
         with open(path) as f:
             assert yaml.safe_load(f) == obj, f"{path} is stale — rerun deploy/render.py"
+
+
+def test_ha_overlay_renders_and_is_current():
+    """The HA variant (round-4 verdict item 8): replicas=2, shared RWX lease
+    volume mounted, lease path passed to the elector."""
+    sys.path.insert(0, os.path.join(ROOT, "deploy"))
+    import render
+
+    values = {"cluster_name": "karpenter-tpu", "namespace": "karpenter-tpu",
+              "replicas": 1, "image": "karpenter-tpu:latest"}
+    objs = render.render_ha(values)
+    kinds = [o["kind"] for o in objs]
+    assert kinds == ["PersistentVolumeClaim", "Deployment", "Service", "Deployment"]
+    pvc, state_dep, state_svc, dep = objs
+    # every replica points at the SHARED state tier — private embedded
+    # stores would fail over onto empty state
+    assert state_dep["metadata"]["name"] == "karpenter-tpu-state"
+    assert state_svc["spec"]["ports"][0]["port"] == 8090
+    assert pvc["spec"]["accessModes"] == ["ReadWriteMany"]
+    assert dep["spec"]["replicas"] == 2
+    spec = dep["spec"]["template"]["spec"]
+    assert spec["volumes"][0]["persistentVolumeClaim"]["claimName"] == "karpenter-tpu-lease"
+    args = spec["containers"][0]["args"]
+    assert "--leader-elect-lease" in args
+    assert "/var/lease/karpenter-tpu-leader" in args
+    assert "--cluster-endpoint" in args
+    assert "http://karpenter-tpu-state.karpenter-tpu:8090" in args
+    mdir = os.path.join(ROOT, "deploy", "manifests")
+    for obj in objs:
+        path = os.path.join(
+            mdir, f"ha-{obj['kind'].lower()}-{obj['metadata']['name']}.yaml"
+        )
+        assert os.path.exists(path), path
+        with open(path) as f:
+            assert yaml.safe_load(f) == obj, f"{path} is stale — rerun deploy/render.py --ha"

@@ -80,9 +80,11 @@ class TestHTTPServer:
             t.join(timeout=10)
 
     def test_standby_serves_probes_before_leadership(self, tmp_path):
-        """ADVICE r3: a replica waiting for leadership must answer /healthz 200
-        and /readyz 503, then flip ready once it becomes leader. Runs the real
-        entrypoint in a subprocess (main() installs signal handlers)."""
+        """ADVICE r3 + round-5 review: a replica waiting for leadership must
+        answer /healthz 200 AND /readyz 200 (Ready = able to take over; a
+        leader-gated readiness would wedge a 2-replica rollout), with
+        leadership observable as /leaderz 503 -> 200 on takeover. Runs the
+        real entrypoint in a subprocess (main() installs signal handlers)."""
         import os
         import signal
         import socket
@@ -119,21 +121,22 @@ class TestHTTPServer:
                     time.sleep(0.2)
             else:
                 raise AssertionError("standby never served /healthz")
+            assert _get(port, "/readyz")[0] == 200  # Ready while standby
             try:
-                _get(port, "/readyz")
-                raise AssertionError("standby reported ready while not leader")
+                _get(port, "/leaderz")
+                raise AssertionError("standby claimed leadership")
             except urllib.error.HTTPError as e:
                 assert e.code == 503
             holder.release()  # hand over leadership
             deadline = time.time() + 30
             while time.time() < deadline:
                 try:
-                    if _get(port, "/readyz")[0] == 200:
+                    if _get(port, "/leaderz")[0] == 200:
                         break
                 except urllib.error.HTTPError:
                     time.sleep(0.2)
             else:
-                raise AssertionError("replica never became ready after takeover")
+                raise AssertionError("replica never became leader after takeover")
         finally:
             if proc.poll() is None:
                 proc.send_signal(signal.SIGTERM)

@@ -387,3 +387,64 @@ class TestE2EOverHTTP:
         finally:
             op.close()
             svc.stop()
+
+
+class TestDiscoveryConformance:
+    """Selector -> concrete-id resolution against BOTH backends (round-4
+    verdict item 9: SG discovery existed only in the fake). Reference:
+    subnet.go:213-235, securitygroup.go:53, ami.go:99-133,236-245."""
+
+    def test_security_group_selector(self, provider):
+        all_groups = provider.describe_security_groups(
+            {"karpenter.tpu/discovery": "cluster"}
+        )
+        assert sorted(g.id for g in all_groups) == ["sg-default", "sg-nodes"]
+        nodes_only = provider.describe_security_groups({"role": "node"})
+        assert [g.id for g in nodes_only] == ["sg-nodes"]
+        assert provider.describe_security_groups({"role": "nope"}) == []
+
+    def test_wildcard_selector_matches_key_presence(self, provider):
+        """'*' = key present with any value — identical across backends
+        (shared matcher, inventory.tags_match)."""
+        groups = provider.describe_security_groups({"role": "*"})
+        assert [g.id for g in groups] == ["sg-nodes"]
+        subnets = provider.describe_subnets({"zone": "*"})
+        assert len(subnets) >= 2
+        assert provider.describe_images({"nosuchtag": "*"}) == []
+
+    def test_subnet_selector(self, provider):
+        subnets = provider.describe_subnets({"karpenter.tpu/discovery": "cluster"})
+        assert subnets and all(s.id.startswith("subnet-") for s in subnets)
+        one = provider.describe_subnets({"zone": subnets[0].zone})
+        assert [s.zone for s in one] == [subnets[0].zone]
+
+    def test_image_selector_newest_first(self, provider):
+        imgs = provider.describe_images({"family": "al2"})
+        assert imgs and all(i.tags.get("family") == "al2" for i in imgs)
+        created = [i.created for i in imgs]
+        assert created == sorted(created, reverse=True)
+
+    def test_nodetemplate_controller_resolves_against_either_backend(self, provider):
+        from karpenter_tpu.api.objects import NodeTemplate
+        from karpenter_tpu.api import ObjectMeta
+        from karpenter_tpu.controllers.nodetemplate import NodeTemplateController
+        from karpenter_tpu.state import Cluster
+
+        cluster = Cluster()
+        cluster.add_node_template(
+            NodeTemplate(
+                meta=ObjectMeta(name="t"),
+                subnet_selector={"karpenter.tpu/discovery": "cluster"},
+                security_group_selector={"role": "node"},
+                image_selector={"family": "al2"},
+            )
+        )
+        ctl = NodeTemplateController(cluster, provider)
+        updated = ctl.reconcile()
+        assert updated == ["t"]
+        t = cluster.node_templates["t"]
+        assert t.resolved_security_groups == ["sg-nodes"]
+        assert t.resolved_subnets and all(s.startswith("subnet-") for s in t.resolved_subnets)
+        assert t.resolved_images and all(i.startswith("img-al2") for i in t.resolved_images)
+        # steady state: second reconcile is a no-op
+        assert ctl.reconcile() == []
