@@ -236,12 +236,16 @@ CONFIGS = [
 ]
 
 
-def bench_consolidation(n_nodes=200, pods_per_node=3, max_passes=40):
+def bench_consolidation(n_nodes=300, pods_per_node=3, max_passes=40):
     """Consolidation savings metric (BASELINE 'repack to minimize cost'):
     seed a deliberately fragmented, overpriced fleet — mid-size on-demand nodes
-    a few percent utilized — run the deprovisioning orchestrator to quiescence,
-    and report $/hr before -> after. Feasibility = every pod still bound."""
-    from karpenter_tpu.api import Machine, ObjectMeta, Pod, Provisioner, Requirement, Requirements, Resources
+    a few percent utilized, hosting zone-spread services (a realistic fleet's
+    topology constraints ride along into every repack simulation) — run the
+    deprovisioning orchestrator to quiescence, and report $/hr before ->
+    after. Feasibility = every pod still bound. The sweep's large repack
+    simulations run the QUALITY-budget solver (kernel races host FFD, best
+    validated plan wins); per-backend attribution is reported."""
+    from karpenter_tpu.api import Machine, ObjectMeta, Pod, Provisioner, Requirement, Requirements, Resources, TopologySpreadConstraint
     from karpenter_tpu.api import labels as wk
     from karpenter_tpu.api.settings import Settings
     from karpenter_tpu.cloudprovider import FakeCloudProvider, generate_catalog
@@ -284,9 +288,22 @@ def bench_consolidation(n_nodes=200, pods_per_node=3, max_passes=40):
         cluster.add_machine(machine)
         node = register_node(cluster, machine, prov)
         for j in range(pods_per_node):
+            # services spread over zones: every repack simulation carries the
+            # topology constraints a real fleet has (non-LP-safe -> the
+            # kernel-vs-host-FFD race decides, not the assignment LP)
+            app = f"svc{j}"
             pod = Pod(
-                meta=ObjectMeta(name=f"fp-{i}-{j}", owner_kind="ReplicaSet"),
+                meta=ObjectMeta(
+                    name=f"fp-{i}-{j}", owner_kind="ReplicaSet",
+                    labels={"app": app},
+                ),
                 requests=Resources(cpu="200m", memory="256Mi"),
+                topology_spread=[
+                    TopologySpreadConstraint(
+                        max_skew=2, topology_key=wk.ZONE,
+                        label_selector={"app": app},
+                    )
+                ],
             )
             cluster.add_pod(pod)
             cluster.bind_pod(pod.name, node.name)
@@ -327,6 +344,9 @@ def bench_consolidation(n_nodes=200, pods_per_node=3, max_passes=40):
         # VERDICT r3 item 7: mass termination must coalesce — this counts
         # TerminateInstances backend calls for the whole consolidation run
         "terminate_batches": provider.terminate_calls,
+        # which engine answered each sweep simulation (round-4 verdict
+        # item 3: the kernel as a winning backend in a realistic flow)
+        "sweep_backends": dict(deprov.sweep_backend_counts),
     }
 
 
@@ -356,6 +376,41 @@ def bench_kernel_race(n_pods=500, n_types=20):
         "lower_bound": round(lb, 4),
         "host_cost": round(float(host.cost), 4) if host else None,
         "kernel_cost": round(float(kernel.cost), 4) if kernel else None,
+    }
+    if host and kernel and not kernel.stats.get("fallback"):
+        out["winner"] = "kernel" if kernel.cost < host.cost - 1e-9 else (
+            "host" if host.cost < kernel.cost - 1e-9 else "tie"
+        )
+    return out
+
+
+def bench_kernel_race_topology(n_pods=10_000):
+    """Scaled-up quality-budget race on a TOPOLOGY shape (round-4 verdict
+    item 3b): zone spread + hostname anti-affinity at 10k pods, where the
+    assignment LP is unavailable and the host competitor is the numpy FFD
+    portfolio. Reports both costs and the winner."""
+    import time as _t
+
+    from karpenter_tpu.solver import TPUSolver, best_lower_bound, encode, validate
+
+    pods, provs, _ = config_10k_topology()
+    problem = encode(pods, provs)
+    lb = float(best_lower_bound(problem))
+    solver = TPUSolver(portfolio=8, latency_budget_s=30.0)
+    t0 = _t.perf_counter()
+    host = solver._solve_host_pack(problem)
+    host_ms = (_t.perf_counter() - t0) * 1e3
+    t0 = _t.perf_counter()
+    kernel = solver._solve_kernel(problem)
+    kernel_ms = (_t.perf_counter() - t0) * 1e3
+    out = {
+        "pods": n_pods,
+        "lower_bound": round(lb, 4),
+        "host_cost": round(float(host.cost), 4) if host else None,
+        "host_ms": round(host_ms, 1),
+        "kernel_cost": round(float(kernel.cost), 4) if kernel else None,
+        "kernel_ms": round(kernel_ms, 1),
+        "violations": len(validate(problem, kernel)) + len(validate(problem, host)),
     }
     if host and kernel and not kernel.stats.get("fallback"):
         out["winner"] = "kernel" if kernel.cost < host.cost - 1e-9 else (
@@ -570,6 +625,10 @@ def main():
         details["kernel_race"] = bench_kernel_race()
     except Exception as e:
         details["kernel_race"] = {"error": f"{type(e).__name__}: {e}"}
+    try:
+        details["kernel_race_topology"] = bench_kernel_race_topology()
+    except Exception as e:
+        details["kernel_race_topology"] = {"error": f"{type(e).__name__}: {e}"}
     try:
         from karpenter_tpu.solver.solver import TPUSolver as _S
 

@@ -35,7 +35,7 @@ from ..api.settings import Settings
 from ..cloudprovider.interface import CloudProvider
 from ..cloudprovider.types import InstanceType, Offering
 from ..solver.encode import ExistingNode
-from ..solver.solver import GreedySolver, Solver
+from ..solver.solver import GreedySolver, Solver, TPUSolver
 from ..state.cluster import Cluster
 from ..utils import metrics
 from ..utils.cache import Clock
@@ -67,6 +67,8 @@ class DeprovisioningController:
         settings: Optional[Settings] = None,
         recorder: Optional[Recorder] = None,
         clock: Optional[Clock] = None,
+        quality_budget_s: float = 2.0,
+        quality_min_pods: int = 500,
     ):
         self.cluster = cluster
         self.provider = provider
@@ -75,6 +77,30 @@ class DeprovisioningController:
         self.settings = settings or Settings()
         self.recorder = recorder or Recorder()
         self.clock = clock or Clock()
+        # Quality-budget sweep solver (round-4 verdict item 3): consolidation
+        # is not latency-critical (15s validation TTL, out-of-band cadence),
+        # so LARGE repack simulations get a quality-mode TPUSolver — the
+        # kernel races the host competitor under a generous budget and the
+        # cheaper validated plan wins, with the compile warmed off-path
+        # (quality_sync=False: a cold operator's first sweep is served by the
+        # host answer while XLA warms in the background). Small candidate
+        # sims keep the latency-tuned solver (its tiny gate skips the device).
+        self.quality_min_pods = quality_min_pods
+        self.quality_solver: Optional[Solver] = None
+        if quality_budget_s > 1.0 and isinstance(self.solver, TPUSolver):
+            self.quality_solver = TPUSolver(
+                portfolio=self.solver.portfolio,
+                seed=self.solver.seed,
+                mesh=self.solver.mesh,
+                auto_mesh=False,
+                latency_budget_s=quality_budget_s,
+                warmup_spike_s=self.solver.warmup_spike_s,
+                quality_race=True,
+                quality_sync=False,
+            )
+        # sweep solves attributed by winning backend (observability for the
+        # "which engine answered" question; surfaced by the benchmark)
+        self.sweep_backend_counts: Dict[str, int] = {}
         self.pending_action: Optional[PlannedAction] = None
         # sweep-scoped existing-capacity snapshot (see _consolidation)
         self._sweep_capacity = None
@@ -318,11 +344,15 @@ class DeprovisioningController:
         best = None
         t0 = time.monotonic()
         deadline = t0 + self.settings.consolidation_timeout
-        # heuristic subset cap (the reference consolidates over a bounded
-        # candidate subset, designs/consolidation.md): the search starts at the
-        # 25 cheapest-to-disrupt nodes; largest prefixes first, so a deadline
-        # hit keeps the highest-savings candidates already evaluated
-        for k in range(min(len(candidates), 25), 1, -1):
+        # Subset cap: the reference bounds the search to a small heuristic
+        # subset because every prefix is a full scheduler re-simulation and
+        # its packer is single-threaded greedy (designs/consolidation.md).
+        # With a quality-budget solver present, fleet-scale simulations are
+        # what the solver is FOR — the sweep evaluates every prefix down from
+        # the whole candidate list (largest first, deadline-bounded), finding
+        # one big repack action where the reference needs many small ones.
+        cap = 25 if self.quality_solver is None else len(candidates)
+        for k in range(min(len(candidates), cap), 1, -1):
             if time.monotonic() >= deadline:
                 metrics.CONSOLIDATION_SWEEP_TRUNCATED.inc()
                 break
@@ -410,9 +440,17 @@ class DeprovisioningController:
             (prov, self.provider.get_instance_types(prov))
             for prov in self.cluster.provisioners.values()
         ]
-        result = self.solver.solve_pods(
-            list(pods), provisioners, existing=existing, daemonsets=self.cluster.daemonsets()
+        pods = list(pods)
+        solver = self.solver
+        if self.quality_solver is not None and len(pods) >= self.quality_min_pods:
+            solver = self.quality_solver
+        result = solver.solve_pods(
+            pods, provisioners, existing=existing, daemonsets=self.cluster.daemonsets()
         )
+        backend = {0.0: "greedy", 1.0: "kernel", 2.0: "host-lp", 3.0: "host-ffd"}.get(
+            result.stats.get("backend"), "oracle"
+        )
+        self.sweep_backend_counts[backend] = self.sweep_backend_counts.get(backend, 0) + 1
         over_ceiling = price_ceiling is not None and any(
             n.option.price >= price_ceiling - 1e-9 for n in result.new_nodes
         )
@@ -444,8 +482,8 @@ class DeprovisioningController:
                         types.append(it.with_offerings(kept))
                 filtered.append((prov, types))
             if dropped:
-                result = self.solver.solve_pods(
-                    list(pods), filtered, existing=existing,
+                result = solver.solve_pods(
+                    pods, filtered, existing=existing,
                     daemonsets=self.cluster.daemonsets(),
                 )
                 over_ceiling = False

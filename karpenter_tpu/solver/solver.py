@@ -540,6 +540,8 @@ class TPUSolver(Solver):
         auto_mesh: bool = True,
         warmup_spike_s: float = 1.5,
         race_memory_ttl_s: float = 30.0,
+        quality_race: bool = False,
+        quality_sync: bool = True,
     ):
         self.portfolio = portfolio
         self.seed = seed
@@ -557,6 +559,17 @@ class TPUSolver(Solver):
         # passes, and a cached winning kernel result is revalidated instead
         # of being replayed forever (round-4 advisor finding).
         self.race_memory_ttl_s = race_memory_ttl_s
+        # Quality mode (budget > 1s) knobs for the consolidation sweep
+        # (round-4 verdict item 3):
+        # * quality_race: ALSO build the host competitor (FFD + topo CG) for
+        #   non-LP-safe shapes and return the cheaper validated answer with
+        #   winner attribution, instead of trusting the kernel outright.
+        # * quality_sync=False: never compile XLA inline — a fresh shape
+        #   warms in a background thread and the host answer serves THIS
+        #   solve (a cold operator's first sweep must not stall multi-seconds
+        #   mid-deadline; round-4 weak item 7).
+        self.quality_race = quality_race
+        self.quality_sync = quality_sync
         # Portfolio members shard across the device mesh (the solver's
         # data-parallel axis, SURVEY §2.3): pass a jax.sharding.Mesh, or let
         # the solver build one over all local devices on first kernel solve.
@@ -572,6 +585,11 @@ class TPUSolver(Solver):
         self._host_cache: dict = {}  # numpy inputs for the host FFD competitor
         self._cache_lock = threading.Lock()
         self._warmed_problems: dict = {}
+        # padded shapes whose XLA compile has completed (a background warm
+        # ran to the end): quality_sync=False solves consult this — sweep
+        # problems are FRESH objects every cycle, so per-problem warm state
+        # can never mark them ready, but the compile is per-SHAPE
+        self._warmed_shapes: set = set()
         self._race_fails = 0
         # breaker half-open probe: when the race breaker is open (>=3 missed
         # deadlines) we still re-probe the device once per interval — a
@@ -723,10 +741,11 @@ class TPUSolver(Solver):
                 )
             except Exception:
                 host_result = None  # any host-path failure falls to the kernel
-        if host_result is None and not quality:
+        if host_result is None and (not quality or self.quality_race):
             # topology shapes (non-LP-safe): the numpy grouped-FFD member is
             # the host competitor — the tunneled device's RTT must never be
-            # the latency floor (round-4 verdict item 2)
+            # the latency floor (round-4 verdict item 2). Quality mode skips
+            # this unless quality_race is on (sweeps want the comparison).
             try:
                 host_result = self._solve_host_pack(problem)
             except Exception:
@@ -754,9 +773,11 @@ class TPUSolver(Solver):
             # on raw node cost (round-4 review finding)
             host_cmp = host_result.cost + 1e6 * len(host_result.unschedulable)
             if quality:
-                # quality mode (generous budget): synchronous race, compile and
-                # all — consolidation sweeps and tests that want the best answer
-                kernel_result = self._solve_kernel(problem)
+                # quality mode (generous budget): the best answer wins. With
+                # quality_sync the compile happens inline (tests, dryrun);
+                # without, a cold shape warms off-path and the host answer
+                # serves this solve (consolidation sweeps on a cold operator)
+                kernel_result = self._solve_kernel_quality(problem)
             elif kernel_hopeless or tiny:
                 kernel_result = None
             elif kernel_cached is not None:
@@ -882,6 +903,7 @@ class TPUSolver(Solver):
             def _warm():
                 try:
                     self._solve_kernel(problem)
+                    self._warmed_shapes.add(self._shape_key(problem))
                 except Exception:
                     pass
                 finally:
@@ -970,6 +992,37 @@ class TPUSolver(Solver):
             return result
         except Exception:
             return None
+
+    def _shape_key(self, problem: EncodedProblem) -> tuple:
+        """The padded-dimension tuple XLA compiles against. Sweep problems
+        are fresh objects each cycle but share shapes, so compile-warm state
+        is tracked per shape, not per problem."""
+        from ..parallel import round_up_portfolio
+
+        return (
+            _next_pow2(problem.G),
+            _next_pow2(problem.O),
+            max(problem.E, 1),
+            max(len(problem.zones), 1),
+            self._estimate_slots(problem),
+            round_up_portfolio(self.portfolio, self.mesh),
+        )
+
+    def _solve_kernel_quality(self, problem: EncodedProblem) -> Optional[SolveResult]:
+        """Quality-mode kernel entry. With ``quality_sync`` the compile runs
+        inline (tests, the multichip dryrun). Without it — the consolidation
+        sweep's mode — a SHAPE that has not finished its background warm
+        contributes nothing to THIS solve (the host competitor answers) and
+        the warm thread brings the compile up off-path, so a cold operator's
+        first sweep never stalls on XLA (round-4 weak item 7). Later sweeps
+        of the same padded shape run the kernel synchronously: the compile
+        is cached, so the solve is one device round trip."""
+        if self.quality_sync:
+            return self._solve_kernel(problem)
+        if self._shape_key(problem) in self._warmed_shapes:
+            return self._solve_kernel(problem)  # compile cached for this shape
+        self._dispatch_async(problem)  # spawns the background warm if absent
+        return None
 
     def _solve_kernel(self, problem: EncodedProblem) -> Optional[SolveResult]:
         t0 = time.perf_counter()
@@ -1075,7 +1128,14 @@ class TPUSolver(Solver):
         G, O, E, R = problem.G, problem.O, problem.E, len(problem.resource_axes)
         Gp = _next_pow2(G)
         Op = _next_pow2(O)
-        Ep = max(E, 1)
+        # Ep padded to a power of two like the other axes: consolidation
+        # sweep simulations vary E by one node per prefix, and an exact Ep
+        # would give every prefix its own XLA shape (compile per simulation);
+        # bucketed with a coarse floor, a handful of compiles serve a whole
+        # fleet-scale sweep. ex_valid masks the padding rows. E=0 (pure
+        # provisioning) keeps the single padding column — the hot 50k path
+        # must not scan 64 dead existing slots.
+        Ep = _next_pow2(E, floor=64) if E else 1
         n_zones = max(len(problem.zones), 1)
 
         scale = problem.alloc.max(axis=0) if O else np.ones(R, np.float32)
@@ -1231,8 +1291,10 @@ class TPUSolver(Solver):
         ys: np.ndarray,
     ) -> SolveResult:
         E = problem.E
-        Ep = max(E, 1)
         s_new = new_opt.shape[0]
+        # slot columns are [existing (padded) | new]; derive the pad from the
+        # matrix rather than assuming max(E, 1)
+        Ep = ys.shape[1] - s_new
         group_names = problem.__dict__.get("_group_names")
         if group_names is None:
             from .result import LazyNames

@@ -296,7 +296,8 @@ def validate_counts(
     validator cross-checks it in tests)."""
     violations: List[str] = []
     G, E = problem.G, problem.E
-    Ep = max(E, 1)
+    # ys columns are [existing (padded to s_ex) | new]; infer the split
+    Ep = ys.shape[1] - new_opt.shape[0]
     T = ys.shape[0]
     d = problem.demand.astype(np.float64)
 
@@ -309,12 +310,12 @@ def validate_counts(
     placed = counts.sum(axis=1)
     if np.any(placed > problem.count):
         violations.append("group placed more pods than demanded")
-    if E == 0 and np.any(counts[:, :Ep]):
-        # E==0 pads one existing-slot column; pods assigned there would be
-        # dropped by decode (cursor advances, nothing emitted) — the
-        # completeness hole the name-level validator catches as "neither
-        # placed nor reported unschedulable"
-        violations.append("pods assigned to the existing-node padding slot")
+    if np.any(counts[:, E:Ep]):
+        # existing-slot PADDING columns (E..Ep pow2 pad, or the single E==0
+        # column): pods assigned there have no node — decode skips the
+        # column and reports them unschedulable, so a kernel placing there
+        # is emitting an invalid plan (ex_valid should have masked it)
+        violations.append("pods assigned to an existing-node padding slot")
 
     # existing nodes: remaining capacity + compat
     if E:
