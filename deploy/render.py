@@ -33,6 +33,18 @@ def labels() -> Dict[str, str]:
     return {"app.kubernetes.io/name": APP, "app.kubernetes.io/managed-by": "render.py"}
 
 
+def scrape_annotations() -> Dict[str, str]:
+    """Prometheus discovery annotations on the operator pod template: the
+    state gauges (controllers/metricsscraper) are only useful if something
+    actually scrapes :8080/metrics. Rides the pod template so both the base
+    deployment and the HA overlay (which reuses deployment()) carry it."""
+    return {
+        "prometheus.io/scrape": "true",
+        "prometheus.io/port": "8080",
+        "prometheus.io/path": "/metrics",
+    }
+
+
 def namespace(values: Dict) -> Dict:
     return {
         "apiVersion": "v1",
@@ -111,7 +123,7 @@ def deployment(values: Dict) -> Dict:
             "replicas": values["replicas"],
             "selector": {"matchLabels": {"app.kubernetes.io/name": APP}},
             "template": {
-                "metadata": {"labels": labels()},
+                "metadata": {"labels": labels(), "annotations": scrape_annotations()},
                 "spec": {
                     "serviceAccountName": APP,
                     "containers": [

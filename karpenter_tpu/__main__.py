@@ -126,6 +126,7 @@ def main(argv=None) -> int:
             port=args.metrics_port,
             host=args.metrics_bind,
             leader_check=lambda: elector is None or elector.is_leader,
+            recorder=op.recorder,
         ).start()
 
     if args.leader_elect:

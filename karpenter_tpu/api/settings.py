@@ -31,6 +31,9 @@ class Settings:
     # under load instead of running unbounded as the fleet grows. 0 disables
     # the multi-node sweep entirely (single-node consolidation still runs).
     consolidation_timeout: float = 2.0
+    # cadence of the state-observability scrapers (controllers/metricsscraper)
+    # on the operator loop; 0 scrapes every tick
+    metrics_scrape_interval: float = 10.0
 
     def validate(self) -> None:
         if not self.cluster_name:
@@ -41,6 +44,8 @@ class Settings:
             raise ValueError("vmMemoryOverheadPercent must be in [0,1)")
         if self.consolidation_timeout < 0:
             raise ValueError("consolidationTimeout must be >= 0 (0 disables the multi-node sweep)")
+        if self.metrics_scrape_interval < 0:
+            raise ValueError("metricsScrapeInterval must be >= 0 (0 scrapes every tick)")
 
     # -- config system (reference: karpenter-global-settings ConfigMap,
     # settings.go:40-93; env/flag ingestion in the operator bootstrap) -------

@@ -8,19 +8,29 @@ exponential error backoff). Every loop the operator drives is wrapped in a
 (1s doubling to 5m) and is logged/counted instead of killing the whole run
 loop, and per-loop cadences (drift/GC/nodetemplate at 5m, termination every
 tick) live in ONE place instead of ad-hoc timestamp math.
+
+Every reconcile also gets a CORRELATION ID: the kit opens a structured-log
+context (every log line inside the reconcile carries ``reconcile_id``) and a
+root trace span ``reconcile.<name>`` stamped with the same id, so a slow
+reconcile found in the logs joins to its span tree on ``/debug/traces`` and
+to its ``karpenter_tpu_controller_reconcile_duration_seconds`` sample.
 """
 
 from __future__ import annotations
 
+import itertools
 import logging
 import time
 from typing import Callable, Optional
 
 from ..utils import metrics
-from ..utils.logging import get_logger, kv
+from ..utils.logging import get_logger, kv, log_context
+from ..utils.tracing import TRACER
 
 BASE_BACKOFF = 1.0
 MAX_BACKOFF = 300.0
+
+_reconcile_seq = itertools.count(1)
 
 
 class SingletonController:
@@ -52,14 +62,18 @@ class SingletonController:
         now = self._clock() if now is None else now
         if now < self._next:
             return False
+        reconcile_id = f"{self.name}.{next(_reconcile_seq)}"
         try:
-            with metrics.RECONCILE_DURATION.time({"controller": self.name}):
+            with log_context(reconcile_id=reconcile_id), \
+                 TRACER.span(f"reconcile.{self.name}", reconcile_id=reconcile_id), \
+                 metrics.RECONCILE_DURATION.time({"controller": self.name}):
                 self._reconcile()
         except Exception as e:
             self.consecutive_errors += 1
             metrics.RECONCILE_ERRORS.inc({"controller": self.name})
             kv(self._log, logging.ERROR, "reconcile failed",
-               controller=self.name, consecutive=self.consecutive_errors,
+               controller=self.name, reconcile_id=reconcile_id,
+               consecutive=self.consecutive_errors,
                error=f"{type(e).__name__}: {e}")
             self._log.debug("reconcile traceback", exc_info=True)
             self._next = now + self._backoff

@@ -1,0 +1,43 @@
+"""State-observability scrapers: periodic cluster-state -> gauge controllers.
+
+The reference devotes an entire controller group to STATE observability —
+karpenter-core's ``pkg/controllers/metrics/{pod,node,provisioner}`` scrape
+the cluster into ``karpenter_pods_state``, ``karpenter_nodes_allocatable``
+and the provisioner usage/limit gauges (``designs/metrics.md``). The action
+counters in ``utils/metrics.py`` say what the controllers DID; these
+scrapers say what the cluster IS — the signal an operator watching
+``/metrics`` needs to answer "what is the cluster's shape and utilization
+right now".
+
+Three scrapers, each a plain reconcile callable the operator registers on
+its loop through the controller kit (so they inherit cadence, error backoff,
+reconcile metrics and correlation ids like every other controller):
+
+* :class:`NodeScraper` — per-node allocatable / pod-requested / utilization
+  gauges labeled by provisioner, zone, instance type, capacity type, phase;
+* :class:`PodScraper` — ``karpenter_tpu_pods_state`` by phase/owner/
+  provisioner plus the pod-created -> bound schedulable-latency histogram
+  (fed by cluster watch events, so a bind is observed exactly once);
+* :class:`ProvisionerScraper` — usage vs. limit gauges per provisioner,
+  mirroring ``karpenter_provisioner_usage``/``karpenter_provisioner_limit``.
+
+All three read through ``Cluster.state_snapshot()`` — one consistent view
+per pass — which works identically against the embedded store and the
+HTTP informer cache (``state/httpcluster.py`` subclasses ``Cluster``).
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from .node import NodeScraper
+from .pod import PodScraper
+from .provisioner import ProvisionerScraper
+
+
+def build_scrapers(cluster) -> List:
+    """The operator's default scraper set, in scrape order."""
+    return [NodeScraper(cluster), PodScraper(cluster), ProvisionerScraper(cluster)]
+
+
+__all__ = ["NodeScraper", "PodScraper", "ProvisionerScraper", "build_scrapers"]

@@ -16,7 +16,7 @@ from __future__ import annotations
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Optional
+from typing import List, Optional
 
 from .api.settings import Settings
 from .cloudprovider.fake import FakeCloudProvider
@@ -25,6 +25,7 @@ from .controllers.deprovisioning import DeprovisioningController
 from .controllers.drift import DriftController
 from .controllers.garbagecollect import GarbageCollectionController
 from .controllers.interruption import FakeQueue, InterruptionController
+from .controllers.metricsscraper import build_scrapers
 from .controllers.nodetemplate import NodeTemplateController
 from .controllers.provisioning import ProvisioningController
 from .controllers.termination import TerminationController
@@ -49,6 +50,9 @@ class Operator:
     garbagecollect: GarbageCollectionController
     pricing: Optional[object] = None
     clock: Clock = field(default_factory=Clock)
+    # state-observability scrapers (controllers/metricsscraper): periodic
+    # cluster-state -> gauge controllers on the operator loop
+    scrapers: List[object] = field(default_factory=list)
 
     @staticmethod
     def new(
@@ -120,6 +124,7 @@ class Operator:
             garbagecollect=garbagecollect,
             pricing=pricing,
             clock=clock,
+            scrapers=build_scrapers(cluster),
         )
 
     # -- single synchronous pass over every loop (tests/simulation) --------
@@ -137,6 +142,8 @@ class Operator:
         self.provisioning.reconcile()
         self.termination.reconcile()
         self.garbagecollect.reconcile()
+        for scraper in self.scrapers:
+            scraper.scrape()
 
     # -- continuous run -----------------------------------------------------
     def run(
@@ -158,7 +165,13 @@ class Operator:
         if self.http_server is None and http_port is not None:
             from .utils.httpserver import OperatorHTTPServer
 
-            self.http_server = OperatorHTTPServer(port=http_port).start()
+            self.http_server = OperatorHTTPServer(
+                port=http_port, recorder=self.recorder
+            ).start()
+        elif self.http_server is not None and getattr(self.http_server, "recorder", None) is None:
+            # adopted server (the entrypoint starts it before the operator
+            # exists): late-bind the events recorder so /debug/events works
+            self.http_server.recorder = self.recorder
         try:
             self._run_loop(stop, tick)
         finally:
@@ -244,6 +257,16 @@ class Operator:
         controllers.append(
             SingletonController("gcmaintain", gc_maintain, interval=60.0)
         )
+        # state scrapers ride the kit like every loop (cadence + backoff +
+        # reconcile metrics + correlation ids); the interval is the
+        # reference's metrics-controller resync, tunable via settings
+        for scraper in self.scrapers:
+            controllers.append(
+                SingletonController(
+                    scraper.name, scraper.scrape,
+                    interval=self.settings.metrics_scrape_interval,
+                )
+            )
         self.controllers = controllers
         while not stop.is_set():
             for c in controllers:

@@ -1,5 +1,5 @@
-from .cluster import Cluster
+from .cluster import Cluster, StateSnapshot
 from .apiserver import ClusterAPIServer
 from .httpcluster import HTTPCluster
 
-__all__ = ["Cluster", "ClusterAPIServer", "HTTPCluster"]
+__all__ = ["Cluster", "ClusterAPIServer", "HTTPCluster", "StateSnapshot"]

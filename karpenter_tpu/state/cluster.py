@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import threading
 from collections import defaultdict
+from dataclasses import dataclass
 from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple
 
 from ..api import labels as wk
@@ -31,6 +32,32 @@ from ..api.resources import Resources, merge
 from ..solver.encode import ExistingNode
 
 WatchFn = Callable[[str, object], None]  # (event_type: ADDED|MODIFIED|DELETED, obj)
+
+
+@dataclass(frozen=True)
+class StateSnapshot:
+    """One consistent read of the cluster's shape, taken under the store lock.
+
+    The read API the state-observability scrapers
+    (``controllers/metricsscraper``) consume: because ``HTTPCluster``
+    subclasses ``Cluster``, the same call reads the embedded store in-process
+    and the informer cache in apiserver mode — scrapers never special-case
+    the backend. Object references alias the live store (snapshot the SET,
+    not deep copies); the store version stamps the view for debugging.
+    """
+
+    nodes: Tuple[Node, ...]
+    pods: Tuple[Pod, ...]
+    machines: Tuple[Machine, ...]
+    provisioners: Tuple[Provisioner, ...]
+    resource_version: int = 0
+
+    def pods_by_node(self) -> Dict[str, List[Pod]]:
+        out: Dict[str, List[Pod]] = {}
+        for p in self.pods:
+            if p.node_name is not None:
+                out.setdefault(p.node_name, []).append(p)
+        return out
 
 
 class Cluster:
@@ -138,6 +165,17 @@ class Cluster:
             raise TypeError(f"unknown object {type(obj)}")
 
     # -- queries (the scheduling-relevant views) ---------------------------
+    def state_snapshot(self) -> StateSnapshot:
+        """Consistent point-in-time view for the metrics scrapers."""
+        with self._lock:
+            return StateSnapshot(
+                nodes=tuple(self.nodes.values()),
+                pods=tuple(self.pods.values()),
+                machines=tuple(self.machines.values()),
+                provisioners=tuple(self.provisioners.values()),
+                resource_version=self._version,
+            )
+
     def pending_pods(self) -> List[Pod]:
         with self._lock:
             return [

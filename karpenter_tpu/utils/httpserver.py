@@ -1,19 +1,31 @@
-"""Operator HTTP surface: /metrics, /healthz, /readyz.
+"""Operator HTTP surface: /metrics, /healthz, /readyz, /debug/*.
 
 Rebuild of the reference's manager endpoints
 (``/root/reference/cmd/controller/main.go:33-71`` wires the metrics server on
 :8080 and health probes on :8081 through controller-runtime): a small stdlib
 HTTP server exposing the Prometheus exposition of ``utils.metrics.REGISTRY``
 plus liveness/readiness probes backed by operator-supplied callables.
+
+Debug surface (the pprof-flag analogue, always on and cheap):
+
+* ``/debug/traces`` — JSON dump of the tracer's retained root span trees
+  (most recent first), e.g. the full encode -> solve -> decode -> validate
+  breakdown the solver records, with the controller kit's ``reconcile_id``
+  correlation attrs so a trace joins to its log lines;
+* ``/debug/events`` — the Recorder's recent-events ring (newest first,
+  ``?limit=N`` caps the window, default 256).
 """
 
 from __future__ import annotations
 
+import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Optional
+from urllib.parse import parse_qs
 
 from .metrics import REGISTRY, Registry
+from .tracing import TRACER, Tracer
 
 
 class OperatorHTTPServer:
@@ -24,6 +36,8 @@ class OperatorHTTPServer:
         ready_check: Optional[Callable[[], bool]] = None,
         healthy_check: Optional[Callable[[], bool]] = None,
         leader_check: Optional[Callable[[], bool]] = None,
+        tracer: Optional[Tracer] = None,
+        recorder: Optional[object] = None,
         host: str = "127.0.0.1",
     ):
         self.registry = registry or REGISTRY
@@ -34,11 +48,16 @@ class OperatorHTTPServer:
         # not leader — gating /readyz on leadership would wedge a
         # two-replica Deployment's rolling update at 1/2 Ready forever
         self.leader_check = leader_check or (lambda: True)
+        self.tracer = tracer or TRACER
+        # the events Recorder; the operator assigns this when it adopts a
+        # server started before it existed (the entrypoint boots the HTTP
+        # surface before leader election) — the handler reads it per request
+        self.recorder = recorder
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self) -> None:  # noqa: N802 (http.server API)
-                path = self.path.split("?", 1)[0]
+                path, _, query = self.path.partition("?")
                 if path == "/metrics":
                     body = outer.registry.exposition().encode()
                     self.send_response(200)
@@ -58,6 +77,24 @@ class OperatorHTTPServer:
                     body = (b"leader" if ok else b"standby") + b"\n"
                     self.send_response(200 if ok else 503)
                     self.send_header("Content-Type", "text/plain")
+                elif path == "/debug/traces":
+                    body = json.dumps(
+                        {"traces": outer.tracer.export()}, default=str
+                    ).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                elif path == "/debug/events":
+                    try:
+                        limit = max(0, int(parse_qs(query).get("limit", ["256"])[0]))
+                    except ValueError:
+                        limit = 256
+                    recorder = outer.recorder
+                    events = recorder.recent(limit) if recorder is not None else []
+                    body = json.dumps(
+                        {"events": [e.to_dict() for e in events]}, default=str
+                    ).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
                 else:
                     body = b"not found\n"
                     self.send_response(404)

@@ -14,10 +14,47 @@ from __future__ import annotations
 import json
 import logging
 import sys
+import threading
 import time
+from contextlib import contextmanager
 from typing import Dict, Optional
 
 ROOT = "karpenter_tpu"
+
+# -- log context (correlation ids) ------------------------------------------
+# The controller kit opens a per-reconcile context carrying a correlation id
+# (``reconcile_id``); every structured log line emitted inside the reconcile
+# inherits it, so a slow reconcile in the logs joins to its span tree on
+# /debug/traces (the kit stamps the same id on the root span) and to its
+# RECONCILE_DURATION sample.
+_log_ctx = threading.local()
+
+
+@contextmanager
+def log_context(**fields):
+    """Thread-local structured-log context: fields ride every record emitted
+    within the block (nested contexts merge, inner wins)."""
+    prev = getattr(_log_ctx, "fields", None)
+    _log_ctx.fields = {**(prev or {}), **fields}
+    try:
+        yield
+    finally:
+        _log_ctx.fields = prev
+
+
+def context_fields() -> Dict[str, object]:
+    return getattr(_log_ctx, "fields", None) or {}
+
+
+class _ContextFilter(logging.Filter):
+    """Folds the active log_context fields into each record's kv payload
+    (explicit kv fields win over context on key collisions)."""
+
+    def filter(self, record: logging.LogRecord) -> bool:
+        ctx = context_fields()
+        if ctx:
+            record.kv = {**ctx, **(getattr(record, "kv", None) or {})}
+        return True
 
 
 class JSONFormatter(logging.Formatter):
@@ -57,6 +94,7 @@ def configure(
         root.removeHandler(h)
     handler = logging.StreamHandler(stream or sys.stderr)
     handler.setFormatter(JSONFormatter() if fmt == "json" else ConsoleFormatter())
+    handler.addFilter(_ContextFilter())
     root.addHandler(handler)
     root.propagate = False
     for comp, lvl in (component_levels or {}).items():

@@ -2,12 +2,19 @@
 
 Mirrors the reference's metric catalog shape (counters/histograms with label
 dimensions — ``/root/reference/pkg/controllers/interruption/metrics.go:31-66``,
-``designs/metrics.md:199-247``). Exposition is text-format compatible so the
-registry can back a real scrape endpoint later.
+``designs/metrics.md:199-247``) plus the STATE gauges its
+``pkg/controllers/metrics/{pod,node,provisioner}`` scrapers maintain
+(``karpenter_pods_state``, ``karpenter_nodes_allocatable``,
+``karpenter_provisioner_usage``/``limit``). Exposition is text-format
+(version 0.0.4) compliant — ``# HELP``/``# TYPE`` lines, label-value
+escaping, artifact-free number rendering — so the registry backs the real
+``/metrics`` scrape endpoint (utils/httpserver.py) and external parsers
+round-trip it.
 """
 
 from __future__ import annotations
 
+import math
 import threading
 from bisect import bisect_right
 from contextlib import contextmanager
@@ -16,6 +23,10 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 _DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
 
+#: schedulable-latency shape: pod-created -> bound spans seconds-to-minutes,
+#: not the sub-second solver-latency shape of _DEFAULT_BUCKETS
+_LATENCY_BUCKETS = (0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 300.0)
+
 LabelKey = Tuple[Tuple[str, str], ...]
 
 
@@ -23,11 +34,74 @@ def _key(labels: Optional[Dict[str, str]]) -> LabelKey:
     return tuple(sorted((labels or {}).items()))
 
 
+def series_key(labels: Dict[str, str]) -> LabelKey:
+    """Prebuild a series key for ``Gauge.set_series`` (sorted label tuple —
+    the registry's canonical series identity)."""
+    return tuple(sorted(labels.items()))
+
+
+def _fmt_value(v: float) -> str:
+    """Render a sample value without Python float artifacts: integral values
+    as integers (``1`` not ``1.0``), others via repr (shortest round-trip
+    form, so ``0.1`` never renders as ``0.1000000000000000055``)."""
+    f = float(v)
+    if math.isnan(f):
+        return "NaN"
+    if math.isinf(f):
+        return "+Inf" if f > 0 else "-Inf"
+    if f.is_integer() and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+def _esc_label(value: str) -> str:
+    """Label-value escaping per the text format: backslash, double-quote and
+    line-feed must be escaped or the line is unparseable. Guarded fast path:
+    virtually no real label value needs escaping, and exposition renders
+    every label of every series per scrape."""
+    s = str(value)
+    if "\\" in s or '"' in s or "\n" in s:
+        s = s.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+    return s
+
+
+def _esc_help(text: str) -> str:
+    """HELP-line escaping: backslash and line-feed only (the text format
+    leaves quotes alone on comment lines)."""
+    return str(text).replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _fmt(k: LabelKey, le: Optional[str] = None) -> str:
+    items = list(k) + ([("le", le)] if le is not None else [])
+    if not items:
+        return ""
+    parts = [f'{name}="{_esc_label(value)}"' for name, value in items]
+    return "{" + ",".join(parts) + "}"
+
+
+#: rendered-label-string memo bound (series keys repeat scrape over scrape;
+#: the cache resets rather than grows past this, bounding label churn)
+_FMT_CACHE_MAX = 32768
+
+
+def _fmt_cached(cache: Dict, k: LabelKey, le: Optional[str] = None) -> str:
+    key = (k, le)
+    s = cache.get(key)
+    if s is None:
+        if len(cache) >= _FMT_CACHE_MAX:
+            cache.clear()
+        s = cache[key] = _fmt(k, le)
+    return s
+
+
 class Counter:
+    kind = "counter"
+
     def __init__(self, name: str, help: str = "", registry: "Registry | None" = None):
         self.name = name
         self.help = help
         self._values: Dict[LabelKey, float] = {}
+        self._fmt_cache: Dict = {}
         self._lock = threading.Lock()
         if registry is not None:
             registry.register(self)
@@ -40,26 +114,60 @@ class Counter:
     def value(self, labels: Optional[Dict[str, str]] = None) -> float:
         return self._values.get(_key(labels), 0.0)
 
+    def clear(self) -> None:
+        """Drop every labeled series (series for deleted objects must not
+        linger forever)."""
+        with self._lock:
+            self._values.clear()
+
+    def replace_series(self, values: Dict[LabelKey, float]) -> None:
+        """Atomically publish a full new series set (keys from
+        ``series_key``): the state scrapers build the next view off-lock and
+        swap it in one step, so a concurrent /metrics exposition never sees
+        an empty or half-populated gauge — and stale series drop with the
+        same swap."""
+        with self._lock:
+            self._values = dict(values)
+
+    def _header(self) -> List[str]:
+        lines = []
+        if self.help:
+            lines.append(f"# HELP {self.name} {_esc_help(self.help)}")
+        lines.append(f"# TYPE {self.name} {self.kind}")
+        return lines
+
     def collect(self) -> List[str]:
-        lines = [f"# TYPE {self.name} counter"]
-        for k, v in sorted(self._values.items()):
-            lines.append(f"{self.name}{_fmt(k)} {v}")
+        # insertion order, not sorted: the text format doesn't require sorted
+        # series, and sorting thousands of state-gauge series every scrape is
+        # the single biggest exposition cost
+        with self._lock:
+            items = list(self._values.items())
+        lines = self._header()
+        name, cache = self.name, self._fmt_cache
+        for k, v in items:
+            lines.append(f"{name}{_fmt_cached(cache, k)} {_fmt_value(v)}")
         return lines
 
 
 class Gauge(Counter):
+    kind = "gauge"
+
     def set(self, value: float, labels: Optional[Dict[str, str]] = None) -> None:
         with self._lock:
             self._values[_key(labels)] = value
 
-    def collect(self) -> List[str]:
-        lines = [f"# TYPE {self.name} gauge"]
-        for k, v in sorted(self._values.items()):
-            lines.append(f"{self.name}{_fmt(k)} {v}")
-        return lines
+    def set_series(self, key: LabelKey, value: float) -> None:
+        """Hot-path set with a prebuilt series key (``series_key``): the
+        state scrapers emit the same label set into several gauges per
+        resource — building and sorting the key once, not per gauge, is a
+        third of a large-fleet scrape pass."""
+        with self._lock:
+            self._values[key] = value
 
 
 class Histogram:
+    kind = "histogram"
+
     def __init__(
         self,
         name: str,
@@ -73,6 +181,7 @@ class Histogram:
         self._counts: Dict[LabelKey, List[int]] = {}
         self._sums: Dict[LabelKey, float] = {}
         self._totals: Dict[LabelKey, int] = {}
+        self._fmt_cache: Dict = {}
         self._lock = threading.Lock()
         if registry is not None:
             registry.register(self)
@@ -106,22 +215,23 @@ class Histogram:
         return self._sums.get(_key(labels), 0.0)
 
     def collect(self) -> List[str]:
-        lines = [f"# TYPE {self.name} histogram"]
-        for k in sorted(self._counts):
-            for b, c in zip(self.buckets, self._counts[k]):
-                lines.append(f'{self.name}_bucket{_fmt(k, le=str(b))} {c}')
-            lines.append(f'{self.name}_bucket{_fmt(k, le="+Inf")} {self._totals[k]}')
-            lines.append(f"{self.name}_sum{_fmt(k)} {self._sums[k]}")
-            lines.append(f"{self.name}_count{_fmt(k)} {self._totals[k]}")
+        lines = []
+        if self.help:
+            lines.append(f"# HELP {self.name} {_esc_help(self.help)}")
+        lines.append(f"# TYPE {self.name} {self.kind}")
+        with self._lock:
+            snapshot = [
+                (k, list(counts), self._sums[k], self._totals[k])
+                for k, counts in self._counts.items()
+            ]
+        name, cache = self.name, self._fmt_cache
+        for k, counts, total_sum, total in snapshot:
+            for b, c in zip(self.buckets, counts):
+                lines.append(f"{name}_bucket{_fmt_cached(cache, k, le=_fmt_value(b))} {c}")
+            lines.append(f'{name}_bucket{_fmt_cached(cache, k, le="+Inf")} {total}')
+            lines.append(f"{name}_sum{_fmt_cached(cache, k)} {_fmt_value(total_sum)}")
+            lines.append(f"{name}_count{_fmt_cached(cache, k)} {total}")
         return lines
-
-
-def _fmt(k: LabelKey, le: Optional[str] = None) -> str:
-    items = list(k) + ([("le", le)] if le is not None else [])
-    if not items:
-        return ""
-    inner = ",".join(f'{name}="{value}"' for name, value in items)
-    return "{" + inner + "}"
 
 
 class Registry:
@@ -133,11 +243,14 @@ class Registry:
         with self._lock:
             self._collectors.append(collector)
 
+    def collectors(self) -> List:
+        with self._lock:
+            return list(self._collectors)
+
     def exposition(self) -> str:
         lines: List[str] = []
-        with self._lock:
-            for c in self._collectors:
-                lines.extend(c.collect())
+        for c in self.collectors():
+            lines.extend(c.collect())
         return "\n".join(lines) + "\n"
 
 
@@ -145,41 +258,139 @@ class Registry:
 # reference's karpenter_* metrics, designs/metrics.md).
 REGISTRY = Registry()
 
-PODS_SCHEDULED = Counter("karpenter_tpu_pods_scheduled_total", registry=REGISTRY)
-PODS_UNSCHEDULABLE = Gauge("karpenter_tpu_pods_unschedulable", registry=REGISTRY)
-NODES_CREATED = Counter("karpenter_tpu_nodes_created_total", registry=REGISTRY)
-NODES_TERMINATED = Counter("karpenter_tpu_nodes_terminated_total", registry=REGISTRY)
-SOLVE_DURATION = Histogram("karpenter_tpu_solve_duration_seconds", registry=REGISTRY)
+# -- action counters/timers (what the controllers DID) -----------------------
+PODS_SCHEDULED = Counter(
+    "karpenter_tpu_pods_scheduled_total",
+    help="Pods bound to a node by the provisioning controller.",
+    registry=REGISTRY,
+)
+PODS_UNSCHEDULABLE = Gauge(
+    "karpenter_tpu_pods_unschedulable",
+    help="Pods the last provisioning pass could not place on any offering.",
+    registry=REGISTRY,
+)
+NODES_CREATED = Counter(
+    "karpenter_tpu_nodes_created_total",
+    help="Nodes launched, labeled by owning provisioner.",
+    registry=REGISTRY,
+)
+NODES_TERMINATED = Counter(
+    "karpenter_tpu_nodes_terminated_total",
+    help="Nodes drained and deleted by the termination controller.",
+    registry=REGISTRY,
+)
+SOLVE_DURATION = Histogram(
+    "karpenter_tpu_solve_duration_seconds",
+    help="End-to-end solver latency (encode, backend race, decode, validate).",
+    registry=REGISTRY,
+)
 RECONCILE_DURATION = Histogram(
-    "karpenter_tpu_controller_reconcile_duration_seconds", registry=REGISTRY
+    "karpenter_tpu_controller_reconcile_duration_seconds",
+    help="Reconcile wall time per controller loop.",
+    registry=REGISTRY,
 )
 RECONCILE_ERRORS = Counter(
-    "karpenter_tpu_controller_reconcile_errors_total", registry=REGISTRY
+    "karpenter_tpu_controller_reconcile_errors_total",
+    help="Reconcile crashes per controller (each backs that loop off exponentially).",
+    registry=REGISTRY,
 )
 PROVISIONING_DURATION = Histogram(
-    "karpenter_tpu_provisioning_duration_seconds", registry=REGISTRY
+    "karpenter_tpu_provisioning_duration_seconds",
+    help="Full provisioning pass latency: solve plus launch plus bind.",
+    registry=REGISTRY,
 )
 DEPROVISIONING_ACTIONS = Counter(
-    "karpenter_tpu_deprovisioning_actions_total", registry=REGISTRY
+    "karpenter_tpu_deprovisioning_actions_total",
+    help="Executed deprovisioning actions (delete/replace), labeled by action.",
+    registry=REGISTRY,
 )
 CONSOLIDATION_SWEEP = Histogram(
-    "karpenter_tpu_consolidation_sweep_seconds", registry=REGISTRY
+    "karpenter_tpu_consolidation_sweep_seconds",
+    help="Multi-node consolidation sweep duration per pass.",
+    registry=REGISTRY,
 )
 CONSOLIDATION_SWEEP_TRUNCATED = Counter(
-    "karpenter_tpu_consolidation_sweep_truncated_total", registry=REGISTRY
+    "karpenter_tpu_consolidation_sweep_truncated_total",
+    help="Consolidation sweeps cut short by the wall-clock budget.",
+    registry=REGISTRY,
 )
 INTERRUPTION_MESSAGES = Counter(
-    "karpenter_tpu_interruption_messages_total", registry=REGISTRY
+    "karpenter_tpu_interruption_messages_total",
+    help="Interruption queue messages processed, labeled by message kind.",
+    registry=REGISTRY,
 )
 CLOUDPROVIDER_DURATION = Histogram(
-    "karpenter_tpu_cloudprovider_duration_seconds", registry=REGISTRY
+    "karpenter_tpu_cloudprovider_duration_seconds",
+    help="Cloud provider API call latency, labeled by method.",
+    registry=REGISTRY,
 )
-CLOUDPROVIDER_ERRORS = Counter("karpenter_tpu_cloudprovider_errors_total", registry=REGISTRY)
+CLOUDPROVIDER_ERRORS = Counter(
+    "karpenter_tpu_cloudprovider_errors_total",
+    help="Cloud provider API call failures.",
+    registry=REGISTRY,
+)
 # pattern column generation (solver/patterns.py, solver/topo.py): improved
 # plans RETURNED (cached or freshly built) and the savings they delivered
 PATTERN_IMPROVEMENTS = Counter(
-    "karpenter_tpu_pattern_improvements_total", registry=REGISTRY
+    "karpenter_tpu_pattern_improvements_total",
+    help="Improved packing plans returned by the pattern column generator.",
+    registry=REGISTRY,
 )
 PATTERN_SAVINGS = Counter(
-    "karpenter_tpu_pattern_savings_dollars_total", registry=REGISTRY
+    "karpenter_tpu_pattern_savings_dollars_total",
+    help="Cumulative $/hr saved by pattern-generated plans over the baseline plan.",
+    registry=REGISTRY,
+)
+
+# -- cluster-state gauges (what the cluster IS — maintained by the
+# controllers/metricsscraper scrapers, mirroring the reference's
+# pkg/controllers/metrics/{node,pod,provisioner} controllers) ---------------
+NODES_ALLOCATABLE = Gauge(
+    "karpenter_tpu_nodes_allocatable",
+    help="Node allocatable per resource, labeled by node identity "
+         "(provisioner/zone/instance-type/capacity-type/phase).",
+    registry=REGISTRY,
+)
+NODES_POD_REQUESTS = Gauge(
+    "karpenter_tpu_nodes_total_pod_requests",
+    help="Sum of resource requests of pods bound to the node, per resource.",
+    registry=REGISTRY,
+)
+NODES_UTILIZATION = Gauge(
+    "karpenter_tpu_nodes_utilization",
+    help="Requested/allocatable ratio per node and resource (0 to 1; >1 means overcommit).",
+    registry=REGISTRY,
+)
+PODS_STATE = Gauge(
+    "karpenter_tpu_pods_state",
+    help="Pod count by phase, owner kind and hosting provisioner.",
+    registry=REGISTRY,
+)
+POD_SCHEDULE_LATENCY = Histogram(
+    "karpenter_tpu_pods_schedule_latency_seconds",
+    help="Pod-created to pod-bound latency, labeled by hosting provisioner.",
+    buckets=_LATENCY_BUCKETS,
+    registry=REGISTRY,
+)
+PROVISIONER_USAGE = Gauge(
+    "karpenter_tpu_provisioner_usage",
+    help="Capacity footprint of a provisioner's nodes per resource (compared against limits).",
+    registry=REGISTRY,
+)
+PROVISIONER_LIMIT = Gauge(
+    "karpenter_tpu_provisioner_limit",
+    help="Provisioner resource ceiling per resource, when spec.limits is set.",
+    registry=REGISTRY,
+)
+STATE_SCRAPE_DURATION = Histogram(
+    "karpenter_tpu_state_scrape_duration_seconds",
+    help="Wall time of one state-scraper pass, labeled by scraper.",
+    registry=REGISTRY,
+)
+
+# -- event stream ------------------------------------------------------------
+EVENTS_TOTAL = Counter(
+    "karpenter_tpu_events_total",
+    help="Recorder events published, labeled by event type and reason.",
+    registry=REGISTRY,
 )

@@ -7,23 +7,29 @@ side of that observability story, TPU-control-plane shaped:
 
 * ``span("solve.encode")`` context-managers nest into a thread-local stack,
   producing a tree of timed spans per operation;
-* the last completed ROOT span tree per name is kept for inspection
-  (``last_trace``), and every span can be exported to the structured logger;
+* the last completed ROOT span tree per name is kept in true LRU order
+  (re-recording a name refreshes it; the stalest name is evicted), exported
+  as JSON on the operator's ``/debug/traces`` endpoint;
+* per-span child lists are capped (``max_children``) so a pathological loop
+  recording thousands of sub-spans cannot balloon a trace tree — overflow is
+  counted on the parent instead of stored;
 * always-on cheap (perf_counter + list append); no-op when disabled.
 
-Controllers wrap their reconcile bodies; the solver wraps encode/solve/
-decode/validate, which is how "where did the 100ms go" questions get
-answered without a profiler attached (spans show up in SolveResult.stats
-via the solver's timings too).
+Controllers wrap their reconcile bodies (the controller kit stamps a
+``reconcile_id`` correlation attr shared with the structured logger); the
+solver wraps encode/solve/decode/validate, which is how "where did the 100ms
+go" questions get answered without a profiler attached (spans show up in
+SolveResult.stats via the solver's timings too).
 """
 
 from __future__ import annotations
 
 import threading
 import time
+from collections import OrderedDict
 from contextlib import contextmanager
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 _state = threading.local()
 
@@ -35,6 +41,7 @@ class Span:
     end: float = 0.0
     children: List["Span"] = field(default_factory=list)
     attrs: Dict[str, object] = field(default_factory=dict)
+    children_dropped: int = 0  # overflow beyond the tracer's max_children cap
 
     @property
     def duration_ms(self) -> float:
@@ -46,6 +53,8 @@ class Span:
             out["attrs"] = dict(self.attrs)
         if self.children:
             out["children"] = [c.to_dict() for c in self.children]
+        if self.children_dropped:
+            out["children_dropped"] = self.children_dropped
         return out
 
     def flat(self, prefix: str = "") -> Dict[str, float]:
@@ -58,11 +67,15 @@ class Span:
 
 
 class Tracer:
-    def __init__(self, enabled: bool = True, keep: int = 16):
+    def __init__(self, enabled: bool = True, keep: int = 16, max_children: int = 128):
         self.enabled = enabled
         self.keep = keep
+        self.max_children = max_children
         self._lock = threading.Lock()
-        self._last: Dict[str, Span] = {}  # root span name -> most recent tree
+        # root span name -> (most recent tree, wall-clock recorded_at), kept
+        # in LRU order: recording moves the name to most-recent, eviction
+        # drops the least-recently-RECORDED name (not merely insertion order)
+        self._last: "OrderedDict[str, Tuple[Span, float]]" = OrderedDict()
 
     @contextmanager
     def span(self, name: str, **attrs):
@@ -79,20 +92,39 @@ class Tracer:
             s.end = time.perf_counter()
             stack.pop()
             if stack:
-                stack[-1].children.append(s)
+                parent = stack[-1]
+                if len(parent.children) < self.max_children:
+                    parent.children.append(s)
+                else:
+                    parent.children_dropped += 1
             else:
                 with self._lock:
-                    self._last[name] = s
+                    self._last[name] = (s, time.time())
+                    self._last.move_to_end(name)
                     while len(self._last) > self.keep:
-                        self._last.pop(next(iter(self._last)))
+                        self._last.popitem(last=False)
 
     def last_trace(self, name: str) -> Optional[Span]:
         with self._lock:
-            return self._last.get(name)
+            entry = self._last.get(name)
+            return entry[0] if entry is not None else None
 
     def last_flat(self, name: str) -> Dict[str, float]:
         s = self.last_trace(name)
         return s.flat() if s is not None else {}
+
+    def traces(self) -> List[Tuple[str, Span, float]]:
+        """(name, root span, recorded_at) most-recently-recorded first."""
+        with self._lock:
+            return [(n, s, at) for n, (s, at) in reversed(self._last.items())]
+
+    def export(self) -> List[Dict]:
+        """JSON-ready dump of every retained root span tree, most recent
+        first — the payload of the operator's /debug/traces endpoint."""
+        return [
+            {"recorded_at": round(at, 3), **s.to_dict()}
+            for _, s, at in self.traces()
+        ]
 
 
 #: process-wide default tracer (controllers/solver import this)

@@ -1,0 +1,488 @@
+"""The state-observability layer end to end: Prometheus text-format
+compliance (parser round-trip), the metricsscraper controllers against both
+cluster backends, /debug/traces + /debug/events, tracer retention, recorder
+ring buffer, and reconcile correlation ids.
+
+Reference: karpenter-core's pkg/controllers/metrics/{pod,node,provisioner}
+and designs/metrics.md."""
+
+import io
+import json
+import logging
+import re
+import time
+import urllib.request
+
+import pytest
+
+from karpenter_tpu.api import Node, ObjectMeta, Pod, Resources
+from karpenter_tpu.api import labels as wk
+from karpenter_tpu.api.settings import Settings
+from karpenter_tpu.cloudprovider import FakeCloudProvider, generate_catalog
+from karpenter_tpu.controllers.metricsscraper import (
+    NodeScraper,
+    PodScraper,
+    ProvisionerScraper,
+    build_scrapers,
+)
+from karpenter_tpu.operator import Operator
+from karpenter_tpu.state import Cluster
+from karpenter_tpu.utils import metrics as m
+from karpenter_tpu.utils.cache import FakeClock
+
+from helpers import make_pod, make_pods, make_provisioner
+
+
+# -- a tiny text-format parser (the round-trip side of satellite 1) ----------
+
+_SAMPLE_RE = re.compile(r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{(.*)\})?\s+(\S+)$")
+
+
+def _unescape(v: str) -> str:
+    out, i = [], 0
+    while i < len(v):
+        c = v[i]
+        if c == "\\" and i + 1 < len(v):
+            nxt = v[i + 1]
+            out.append({"\\": "\\", '"': '"', "n": "\n"}.get(nxt, "\\" + nxt))
+            i += 2
+        else:
+            out.append(c)
+            i += 1
+    return "".join(out)
+
+
+def _parse_labels(s: str) -> dict:
+    """Parse `k="v",k2="v2"` honoring escaped quotes/backslashes/newlines."""
+    labels, i = {}, 0
+    while i < len(s):
+        eq = s.index("=", i)
+        key = s[i:eq]
+        assert s[eq + 1] == '"', s
+        j = eq + 2
+        buf = []
+        while s[j] != '"':
+            if s[j] == "\\":
+                buf.append(s[j:j + 2])
+                j += 2
+            else:
+                buf.append(s[j])
+                j += 1
+        labels[key] = _unescape("".join(buf))
+        i = j + 1
+        if i < len(s) and s[i] == ",":
+            i += 1
+    return labels
+
+
+def parse_prometheus(text: str) -> dict:
+    """{(name, frozen labels): float value} for every sample line, plus the
+    set of # HELP / # TYPE'd metric names."""
+    samples, helped, typed = {}, set(), {}
+    for line in text.splitlines():
+        if not line.strip():
+            continue
+        if line.startswith("# HELP "):
+            helped.add(line.split()[2])
+            continue
+        if line.startswith("# TYPE "):
+            typed[line.split()[2]] = line.split()[3]
+            continue
+        if line.startswith("#"):
+            continue
+        match = _SAMPLE_RE.match(line)
+        assert match, f"unparseable exposition line: {line!r}"
+        name, labelstr, value = match.groups()
+        labels = _parse_labels(labelstr) if labelstr else {}
+        samples[(name, frozenset(labels.items()))] = float(value)
+    return {"samples": samples, "helped": helped, "typed": typed}
+
+
+class TestTextFormat:
+    def test_label_escaping_round_trips(self):
+        reg = m.Registry()
+        g = m.Gauge("rt_gauge", help="gauge with nasty labels", registry=reg)
+        nasty = {"path": 'C:\\tmp\\"x"', "msg": "line1\nline2"}
+        g.set(2.5, nasty)
+        parsed = parse_prometheus(reg.exposition())
+        key = ("rt_gauge", frozenset(nasty.items()))
+        assert parsed["samples"][key] == 2.5
+        assert "rt_gauge" in parsed["helped"]
+        assert parsed["typed"]["rt_gauge"] == "gauge"
+
+    def test_values_render_without_float_artifacts(self):
+        reg = m.Registry()
+        c = m.Counter("rt_counter", help="h", registry=reg)
+        c.inc(value=1.0)
+        g = m.Gauge("rt_g2", help="h", registry=reg)
+        g.set(0.1 + 0.2)  # 0.30000000000000004 — repr keeps it round-trippable
+        text = reg.exposition()
+        assert "rt_counter 1\n" in text  # integral -> no trailing .0
+        parsed = parse_prometheus(text)
+        assert parsed["samples"][("rt_g2", frozenset())] == 0.1 + 0.2
+
+    def test_histogram_round_trips(self):
+        reg = m.Registry()
+        h = m.Histogram("rt_hist", help="h", buckets=(0.5, 1.0, 2.5), registry=reg)
+        for v in (0.1, 0.7, 3.0):
+            h.observe(v, {"op": "solve"})
+        parsed = parse_prometheus(reg.exposition())
+        s = parsed["samples"]
+        lbl = lambda le: frozenset({"op": "solve", "le": le}.items())
+        # le values render artifact-free: 0.5 stays, 1.0 -> "1"
+        assert s[("rt_hist_bucket", lbl("0.5"))] == 1
+        assert s[("rt_hist_bucket", lbl("1"))] == 2
+        assert s[("rt_hist_bucket", lbl("+Inf"))] == 3
+        assert s[("rt_hist_count", frozenset({("op", "solve")}))] == 3
+        assert s[("rt_hist_sum", frozenset({("op", "solve")}))] == pytest.approx(3.8)
+
+    def test_full_registry_exposition_parses(self):
+        # whatever prior tests left in the default registry must parse clean
+        parse_prometheus(m.REGISTRY.exposition())
+
+
+class TestCatalogDocs:
+    def test_every_metric_has_help(self):
+        for c in m.REGISTRY.collectors():
+            assert c.help, f"{c.name} has an empty help string"
+
+    def test_docs_cover_registry(self):
+        """docs/metrics.md must name every registered metric with its help —
+        drift fails here even before the gen_docs --check freshness test."""
+        import os
+
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        with open(os.path.join(root, "docs", "metrics.md")) as f:
+            text = f.read()
+        for c in m.REGISTRY.collectors():
+            assert f"`{c.name}`" in text, f"{c.name} missing from docs/metrics.md"
+            assert c.help in text, f"{c.name} help text missing from docs/metrics.md"
+
+
+class TestRecorder:
+    def test_ring_buffer_bounds_retention(self):
+        from karpenter_tpu.utils.events import Recorder
+
+        rec = Recorder(capacity=8)
+        for i in range(20):
+            rec.publish("Reason", f"msg-{i}")
+        events = rec.events()
+        assert len(events) == 8
+        assert events[0].message == "msg-12"  # oldest 12 evicted
+        assert rec.recent(3)[0].message == "msg-19"  # newest first
+
+    def test_default_sink_feeds_events_counter(self):
+        from karpenter_tpu.utils.events import Recorder
+
+        labels = {"type": "Warning", "reason": "RingTestUnique"}
+        before = m.EVENTS_TOTAL.value(labels)
+        rec = Recorder(capacity=4)
+        rec.publish("RingTestUnique", "m", type="Warning")
+        rec.publish("RingTestUnique", "m2", type="Warning")
+        assert m.EVENTS_TOTAL.value(labels) == before + 2
+
+
+class TestTracer:
+    def test_lru_retention_refreshes_on_rerecord(self):
+        from karpenter_tpu.utils.tracing import Tracer
+
+        tr = Tracer(keep=2)
+        with tr.span("a"):
+            pass
+        with tr.span("b"):
+            pass
+        with tr.span("a"):  # re-record: a becomes most recent, b is stalest
+            pass
+        with tr.span("c"):  # evicts b, NOT a
+            pass
+        assert tr.last_trace("a") is not None
+        assert tr.last_trace("c") is not None
+        assert tr.last_trace("b") is None
+        # export is most-recent-first
+        assert [t["name"] for t in tr.export()] == ["c", "a"]
+
+    def test_child_cap_bounds_pathological_loops(self):
+        from karpenter_tpu.utils.tracing import Tracer
+
+        tr = Tracer(max_children=4)
+        with tr.span("root"):
+            for _ in range(10):
+                with tr.span("child"):
+                    pass
+        root = tr.last_trace("root")
+        assert len(root.children) == 4
+        assert root.children_dropped == 6
+        assert root.to_dict()["children_dropped"] == 6
+
+
+def _seed_cluster(cluster):
+    """A provisioner with limits, one node, one bound + one pending pod."""
+    prov = make_provisioner()
+    prov.limits = Resources(cpu=64)
+    cluster.add_provisioner(prov)
+    node = Node(
+        meta=ObjectMeta(
+            name="obs-node-1",
+            labels={wk.PROVISIONER_NAME: "default", wk.ZONE: "zone-a",
+                    wk.INSTANCE_TYPE: "tpu-std-4", wk.CAPACITY_TYPE: "spot"},
+        ),
+        capacity=Resources(cpu=4, memory="16Gi", pods=32),
+        allocatable=Resources(cpu=4, memory="15Gi", pods=32),
+        ready=True,
+    )
+    cluster.add_node(node)
+    bound = make_pod("obs-bound", cpu="1", memory="2Gi")
+    cluster.add_pod(bound)
+    cluster.bind_pod(bound.name, node.name)
+    cluster.add_pod(make_pod("obs-pending", cpu="1"))
+    return prov, node
+
+
+def _assert_state_gauges(samples):
+    def find(name, **labels):
+        want = set(labels.items())
+        hits = [v for (n, k), v in samples.items() if n == name and want <= set(k)]
+        assert hits, f"no {name} sample with {labels}"
+        return hits[0]
+
+    alloc = find("karpenter_tpu_nodes_allocatable", node_name="obs-node-1",
+                 provisioner="default", zone="zone-a", instance_type="tpu-std-4",
+                 capacity_type="spot", phase="Ready", resource_type="cpu")
+    assert alloc == 4
+    req = find("karpenter_tpu_nodes_total_pod_requests",
+               node_name="obs-node-1", resource_type="cpu")
+    assert req == 1
+    util = find("karpenter_tpu_nodes_utilization",
+                node_name="obs-node-1", resource_type="cpu")
+    assert util == pytest.approx(0.25)
+    assert find("karpenter_tpu_pods_state", phase="Running",
+                owner="ReplicaSet", provisioner="default") == 1
+    assert find("karpenter_tpu_pods_state", phase="Pending",
+                owner="ReplicaSet", provisioner="") == 1
+    assert find("karpenter_tpu_provisioner_usage", provisioner="default",
+                resource_type="cpu") == 4
+    assert find("karpenter_tpu_provisioner_limit", provisioner="default",
+                resource_type="cpu") == 64
+
+
+class TestScrapers:
+    def test_scrape_embedded_cluster(self):
+        cluster = Cluster()
+        _seed_cluster(cluster)
+        for s in build_scrapers(cluster):
+            s.scrape()
+        parsed = parse_prometheus(m.REGISTRY.exposition())
+        _assert_state_gauges(parsed["samples"])
+
+    def test_scrape_http_cluster(self):
+        """The same scrapers against the apiserver wire surface: reads come
+        from HTTPCluster's informer cache, so state_snapshot works unchanged."""
+        from karpenter_tpu.state import ClusterAPIServer, HTTPCluster
+
+        server = ClusterAPIServer(port=0).start()
+        client = None
+        try:
+            client = HTTPCluster(server.endpoint)
+            _seed_cluster(client)
+            for s in build_scrapers(client):
+                s.scrape()
+            parsed = parse_prometheus(m.REGISTRY.exposition())
+            _assert_state_gauges(parsed["samples"])
+        finally:
+            if client is not None:
+                client.close()
+            server.stop()
+
+    def test_stale_series_dropped_on_rescrape(self):
+        cluster = Cluster()
+        _seed_cluster(cluster)
+        scraper = NodeScraper(cluster)
+        scraper.scrape()
+        assert any(
+            dict(k).get("node_name") == "obs-node-1"
+            for k in m.NODES_ALLOCATABLE._values
+        )
+        cluster.delete_node("obs-node-1")
+        scraper.scrape()
+        assert not m.NODES_ALLOCATABLE._values  # deleted node leaves no series
+
+    def test_pod_schedule_latency_observed_once_per_bind(self):
+        cluster = Cluster()
+        before = m.POD_SCHEDULE_LATENCY.count({"provisioner": "default"})
+        _seed_cluster(cluster)  # binds obs-bound -> provisioner default
+        scraper = [s for s in build_scrapers(cluster) if isinstance(s, PodScraper)][0]
+        assert m.POD_SCHEDULE_LATENCY.count({"provisioner": "default"}) == before
+        pod = make_pod("obs-late", cpu="1")
+        cluster.add_pod(pod)
+        cluster.bind_pod(pod.name, "obs-node-1")
+        after = m.POD_SCHEDULE_LATENCY.count({"provisioner": "default"})
+        assert after == before + 1
+        cluster.update(pod)  # a re-announce must NOT double-observe
+        assert m.POD_SCHEDULE_LATENCY.count({"provisioner": "default"}) == after
+
+
+def _get(port, path):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}", timeout=5) as r:
+        return r.status, r.read().decode()
+
+
+class TestObservabilityE2E:
+    def test_metrics_and_traces_after_provision_consolidate(self):
+        """The acceptance flow: provision -> consolidate, then scrape
+        /metrics (state gauges present, text-format parseable) and
+        /debug/traces (the solver's span tree as JSON)."""
+        from karpenter_tpu.utils.httpserver import OperatorHTTPServer
+
+        settings = Settings(
+            batch_idle_duration=0, batch_max_duration=0,
+            consolidation_validation_ttl=0, stabilization_window=0.0,
+        )
+        clock = FakeClock(start=time.time())
+        op = Operator.new(
+            provider=FakeCloudProvider(catalog=generate_catalog(n_types=40)),
+            settings=settings, clock=clock,
+        )
+        prov = make_provisioner(consolidation_enabled=True)
+        prov.limits = Resources(cpu=256)
+        op.cluster.add_provisioner(prov)
+        srv = OperatorHTTPServer(port=0, recorder=op.recorder).start()
+        try:
+            for p in make_pods(12, prefix="obs", cpu="500m"):
+                op.cluster.add_pod(p)
+            op.step()
+            assert not op.cluster.pending_pods()
+
+            # the tracer retains the LAST tree per root name: read the
+            # provisioning trace while it still holds this step's solve
+            # (later empty reconciles re-record the root without one)
+            status, body = _get(srv.port, "/debug/traces")
+            assert status == 200
+            traces = json.loads(body)["traces"]
+            roots = {t["name"]: t for t in traces}
+            assert "provisioning.reconcile" in roots
+
+            def walk(span):
+                yield span["name"]
+                for c in span.get("children", ()):
+                    yield from walk(c)
+
+            spans = list(walk(roots["provisioning.reconcile"]))
+            assert "solve" in spans
+            assert "solve.encode" in spans
+
+            # shrink the workload so consolidation has something to do
+            for p in list(op.cluster.pods.values())[::2]:
+                op.cluster.delete_pod(p.name)
+            for _ in range(4):
+                op.step()
+                clock.step(30)
+
+            status, body = _get(srv.port, "/metrics")
+            assert status == 200
+            parsed = parse_prometheus(body)
+            names = {n for (n, _) in parsed["samples"]}
+            assert "karpenter_tpu_nodes_allocatable" in names
+            assert "karpenter_tpu_nodes_total_pod_requests" in names
+            assert "karpenter_tpu_nodes_utilization" in names
+            assert "karpenter_tpu_pods_state" in names
+            assert "karpenter_tpu_provisioner_usage" in names
+            assert "karpenter_tpu_provisioner_limit" in names
+            assert "karpenter_tpu_pods_schedule_latency_seconds_count" in names
+            # every node gauge carries the full label set
+            node_keys = [dict(k) for (n, k) in parsed["samples"]
+                         if n == "karpenter_tpu_nodes_allocatable"]
+            assert node_keys
+            for k in node_keys:
+                assert {"node_name", "provisioner", "zone", "instance_type",
+                        "capacity_type", "phase", "resource_type"} <= set(k)
+
+            status, body = _get(srv.port, "/debug/events")
+            assert status == 200
+            events = json.loads(body)["events"]
+            for e in events:
+                assert {"type", "reason", "message", "timestamp"} <= set(e)
+            # limit is clamped: 0 empties, negative does not wrap around
+            assert json.loads(_get(srv.port, "/debug/events?limit=0")[1])["events"] == []
+            assert json.loads(_get(srv.port, "/debug/events?limit=-5")[1])["events"] == []
+        finally:
+            srv.stop()
+            op.close()
+
+    def test_run_loop_scrapes_on_cadence(self):
+        """Scrapers ride the controller kit in Operator.run: state gauges
+        appear without any explicit scrape() call."""
+        import threading
+
+        settings = Settings(batch_idle_duration=0, batch_max_duration=0,
+                            metrics_scrape_interval=0.0)
+        op = Operator.new(
+            provider=FakeCloudProvider(catalog=generate_catalog(n_types=10)),
+            settings=settings,
+        )
+        op.cluster.add_provisioner(make_provisioner())
+        for p in make_pods(4, prefix="loop", cpu="250m"):
+            op.cluster.add_pod(p)
+        stop = threading.Event()
+        t = threading.Thread(target=op.run, args=(stop,),
+                             kwargs={"tick": 0.01, "http_port": 0})
+        t.start()
+        try:
+            deadline = time.time() + 30
+            names = set()
+            while time.time() < deadline:
+                if getattr(op, "http_server", None) is not None:
+                    _, body = _get(op.http_server.port, "/metrics")
+                    names = {n for (n, _) in parse_prometheus(body)["samples"]}
+                    if ("karpenter_tpu_nodes_allocatable" in names
+                            and not op.cluster.pending_pods()):
+                        break
+                time.sleep(0.05)
+            assert "karpenter_tpu_nodes_allocatable" in names
+            assert "karpenter_tpu_pods_state" in names
+        finally:
+            stop.set()
+            t.join(timeout=10)
+        assert not t.is_alive()
+
+
+class TestCorrelationId:
+    def test_reconcile_logs_and_trace_share_id(self):
+        from karpenter_tpu.controllers.kit import SingletonController
+        from karpenter_tpu.utils.logging import configure, get_logger, kv
+        from karpenter_tpu.utils.tracing import TRACER
+
+        stream = io.StringIO()
+        configure(level="INFO", fmt="json", stream=stream)
+        try:
+            log = get_logger("controller.obs-test")
+
+            def reconcile():
+                kv(log, logging.INFO, "doing work", step=1)
+
+            ctl = SingletonController("obs-test", reconcile)
+            assert ctl.run_if_due()
+            line = json.loads(stream.getvalue().splitlines()[0])
+            assert line["reconcile_id"].startswith("obs-test.")
+            trace = TRACER.last_trace("reconcile.obs-test")
+            assert trace is not None
+            assert trace.attrs["reconcile_id"] == line["reconcile_id"]
+        finally:
+            configure()  # restore default handler on stderr
+
+    def test_failed_reconcile_log_carries_id(self):
+        from karpenter_tpu.controllers.kit import SingletonController
+        from karpenter_tpu.utils.logging import configure
+
+        stream = io.StringIO()
+        configure(level="ERROR", fmt="json", stream=stream)
+        try:
+            ctl = SingletonController(
+                "obs-fail", lambda: (_ for _ in ()).throw(RuntimeError("boom"))
+            )
+            assert ctl.run_if_due()
+            line = json.loads(stream.getvalue().splitlines()[0])
+            assert line["message"] == "reconcile failed"
+            assert line["reconcile_id"].startswith("obs-fail.")
+            assert "boom" in line["error"]
+        finally:
+            configure()
