@@ -594,6 +594,78 @@ def bench_observability_overhead(repeats=8, n_nodes=300, pods_per_node=3):
     }
 
 
+def bench_rpc_overhead(repeats=10, n_pods=300):
+    """Resilience-overhead guard: the retry/breaker wrappers
+    (utils/resilience.py) ride every launch, so a full provisioning round
+    (solve + launch + bind) is measured with the wrappers on vs. off, no
+    faults injected. ``rpc_overhead_ms`` is the p50 delta per round and
+    ``within_budget`` asserts the <5%-of-solve-p50 budget; ``per_call_us``
+    is the deterministic cost of one no-fault resilient_call (the direct
+    number to watch for creep)."""
+    import statistics as _st
+
+    from karpenter_tpu.api import ObjectMeta, Pod, Provisioner, Resources
+    from karpenter_tpu.api.settings import Settings
+    from karpenter_tpu.cloudprovider import FakeCloudProvider, generate_catalog
+    from karpenter_tpu.controllers.provisioning import ProvisioningController
+    from karpenter_tpu.state import Cluster
+    from karpenter_tpu.utils.resilience import BreakerSet, RetryPolicy, resilient_call
+
+    def one_round(retry_on: bool) -> float:
+        cluster = Cluster()
+        provider = FakeCloudProvider(catalog=generate_catalog(n_types=60))
+        controller = ProvisioningController(
+            cluster, provider,
+            settings=Settings(batch_idle_duration=0, batch_max_duration=0),
+        )
+        if not retry_on:
+            controller.retry_policy = None  # launch path runs bare
+        cluster.add_provisioner(Provisioner(meta=ObjectMeta(name="default")))
+        for i in range(n_pods):
+            cluster.add_pod(
+                Pod(meta=ObjectMeta(name=f"rpc-{i}"),
+                    requests=Resources(cpu="250m", memory="512Mi"))
+            )
+        t0 = time.perf_counter()
+        controller.reconcile()
+        return time.perf_counter() - t0
+
+    on_times, off_times = [], []
+    # interleaved ABBA batches, like the observability guard: run-to-run
+    # drift dwarfs the per-call wrapper cost in a two-phase design
+    for flip in (False, True, True, False) * (repeats // 2):
+        (on_times if flip else off_times).append(one_round(flip))
+    on_p50, off_p50 = _st.median(on_times), _st.median(off_times)
+
+    # deterministic per-call cost of a no-fault resilient_call
+    policy = RetryPolicy()
+    breaker = BreakerSet("bench").get("/call")
+    fn = lambda: None  # noqa: E731
+    for _ in range(200):  # warm caches/metrics series
+        resilient_call(fn, policy=policy, breaker=breaker, service="bench", endpoint="/call")
+    t0 = time.perf_counter()
+    n = 2000
+    for _ in range(n):
+        resilient_call(fn, policy=policy, breaker=breaker, service="bench", endpoint="/call")
+    wrapped = (time.perf_counter() - t0) / n
+    t0 = time.perf_counter()
+    for _ in range(n):
+        fn()
+    bare = (time.perf_counter() - t0) / n
+
+    overhead_ms = (on_p50 - off_p50) * 1e3
+    overhead_pct = 100.0 * (on_p50 - off_p50) / off_p50 if off_p50 > 0 else 0.0
+    return {
+        "pods": n_pods,
+        "round_p50_ms_resilience_on": round(on_p50 * 1e3, 3),
+        "round_p50_ms_resilience_off": round(off_p50 * 1e3, 3),
+        "rpc_overhead_ms": round(overhead_ms, 3),
+        "rpc_overhead_pct": round(overhead_pct, 2),
+        "per_call_us": round((wrapped - bare) * 1e6, 2),
+        "within_budget": bool(overhead_pct < 5.0),
+    }
+
+
 def bench_config(name, make, repeats=REPEATS):
     from karpenter_tpu.solver import TPUSolver, best_lower_bound, encode, validate
 
@@ -753,6 +825,10 @@ def main():
         details["observability_overhead"] = bench_observability_overhead()
     except Exception as e:
         details["observability_overhead"] = {"error": f"{type(e).__name__}: {e}"}
+    try:
+        details["rpc_overhead"] = bench_rpc_overhead()
+    except Exception as e:
+        details["rpc_overhead"] = {"error": f"{type(e).__name__}: {e}"}
     try:
         from karpenter_tpu.solver.solver import TPUSolver as _S
 

@@ -77,17 +77,28 @@ def main(argv=None) -> int:
     if overrides:
         settings.apply(overrides)
 
+    from .utils.resilience import breaker_set_from_settings, retry_policy_from_settings
+
     provider = None
     if args.cloud_endpoint:
         from .cloudprovider.httpcloud import HTTPCloudProvider
 
-        provider = HTTPCloudProvider(args.cloud_endpoint)
+        provider = HTTPCloudProvider(
+            args.cloud_endpoint,
+            retry_policy=retry_policy_from_settings(settings),
+            breakers=breaker_set_from_settings("cloud", settings),
+            ice_ttl_s=settings.insufficient_capacity_ttl,
+        )
     ctx = OperatorContext.discover(provider=provider, settings=settings)
     cluster = None
     if args.cluster_endpoint:
         from .state import HTTPCluster
 
-        cluster = HTTPCluster(args.cluster_endpoint)
+        cluster = HTTPCluster(
+            args.cluster_endpoint,
+            retry_policy=retry_policy_from_settings(settings),
+            breakers=breaker_set_from_settings("apiserver", settings),
+        )
     op = Operator.new(provider=ctx.provider, settings=ctx.settings, cluster=cluster)
     cluster_api = None
     if args.serve_cluster_api is not None:

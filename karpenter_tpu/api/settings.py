@@ -34,6 +34,13 @@ class Settings:
     # cadence of the state-observability scrapers (controllers/metricsscraper)
     # on the operator loop; 0 scrapes every tick
     metrics_scrape_interval: float = 10.0
+    # RPC resilience knobs (utils/resilience.py): attempts per call through
+    # the retry layer (1 disables retries), consecutive failures before an
+    # endpoint's circuit opens, and how long an insufficient-capacity
+    # offering stays masked (reference: 3m ICE TTL, cache.go:20-36)
+    rpc_retry_max_attempts: int = 4
+    rpc_breaker_failure_threshold: int = 5
+    insufficient_capacity_ttl: float = 180.0
 
     def validate(self) -> None:
         if not self.cluster_name:
@@ -46,6 +53,12 @@ class Settings:
             raise ValueError("consolidationTimeout must be >= 0 (0 disables the multi-node sweep)")
         if self.metrics_scrape_interval < 0:
             raise ValueError("metricsScrapeInterval must be >= 0 (0 scrapes every tick)")
+        if self.rpc_retry_max_attempts < 1:
+            raise ValueError("rpcRetryMaxAttempts must be >= 1 (1 disables retries)")
+        if self.rpc_breaker_failure_threshold < 1:
+            raise ValueError("rpcBreakerFailureThreshold must be >= 1")
+        if self.insufficient_capacity_ttl < 0:
+            raise ValueError("insufficientCapacityTTL must be >= 0")
 
     # -- config system (reference: karpenter-global-settings ConfigMap,
     # settings.go:40-93; env/flag ingestion in the operator bootstrap) -------

@@ -48,10 +48,15 @@ class FakeCloudProvider(WindowedBatchers, CloudProvider):
         catalog: Optional[List[InstanceType]] = None,
         unavailable_offerings: Optional[UnavailableOfferings] = None,
         max_instance_types: int = 60,
+        fault_plan=None,
     ):
         self.catalog = catalog if catalog is not None else generate_catalog()
         self._by_name = {it.name: it for it in self.catalog}
         self.unavailable_offerings = unavailable_offerings or UnavailableOfferings()
+        # scripted per-endpoint failures (utils/faults.FaultPlan): create/
+        # terminate/describe/list consume one fault per call — the
+        # deterministic analogue of inject_next_error for resilience tests
+        self.fault_plan = fault_plan
         # (type, zone, capacity_type) pools that will ICE on launch — the analogue of
         # fake EC2's InsufficientCapacityPools (/root/reference/pkg/fake/ec2api.go:107-150).
         self.insufficient_capacity_pools: Set[OfferingKey] = set()
@@ -151,6 +156,16 @@ class FakeCloudProvider(WindowedBatchers, CloudProvider):
     def inject_next_error(self, error: Exception) -> None:
         self.next_errors.append(error)
 
+    def _apply_fault(self, endpoint: str) -> None:
+        """Consume one scripted fault for this endpoint, if any (raises
+        TransientCloudError / InsufficientCapacityError or sleeps through
+        the plan's injectable sleeper)."""
+        if self.fault_plan is None:
+            return
+        from ..utils.faults import raise_for_fault
+
+        raise_for_fault(self.fault_plan.next(endpoint), self.fault_plan, endpoint)
+
     def rotate_image(self, family: str = "default", variant: Optional[str] = None) -> str:
         """Advance the current image for (family, variant), making previously
         launched machines of that personality drifted."""
@@ -242,6 +257,7 @@ class FakeCloudProvider(WindowedBatchers, CloudProvider):
         with self._lock:
             if self.next_errors:
                 raise self.next_errors.pop(0)
+            self._apply_fault("create")
             self.create_calls.append(machine)
             candidates = candidate_offerings(
                 machine.requirements,
@@ -379,6 +395,7 @@ class FakeCloudProvider(WindowedBatchers, CloudProvider):
     def _execute_terminate(self, machines: Sequence[Machine]) -> List[Optional[Exception]]:
         out: List[Optional[Exception]] = []
         with self._lock:
+            self._apply_fault("terminate")
             self.terminate_calls += 1  # ONE backend call for the whole set
             for m in machines:
                 try:
@@ -391,6 +408,7 @@ class FakeCloudProvider(WindowedBatchers, CloudProvider):
     def _execute_describe(self, provider_ids: Sequence[str]) -> List[object]:
         out: List[object] = []
         with self._lock:
+            self._apply_fault("describe")
             self.describe_calls += 1
             for pid in provider_ids:
                 instance = self.instances.get(_instance_id(pid))
@@ -409,6 +427,7 @@ class FakeCloudProvider(WindowedBatchers, CloudProvider):
 
     def list(self) -> List[Machine]:
         with self._lock:
+            self._apply_fault("list")
             return [self._instance_to_machine(i) for i in self.instances.values()]
 
     def get_instance_types(self, provisioner: Optional[Provisioner]) -> List[InstanceType]:

@@ -29,6 +29,7 @@ EC2's DescribeInstances.
 
 from __future__ import annotations
 
+import http.client
 import json
 import threading
 import time
@@ -39,6 +40,13 @@ from typing import Dict, List, Optional, Sequence, Tuple
 from ..api import labels as wk
 from ..api.objects import Machine, MachineStatus, ObjectMeta, Provisioner
 from ..utils.cache import UnavailableOfferings
+from ..utils.faults import FaultPlan
+from ..utils.resilience import (
+    BreakerSet,
+    CircuitOpenError,
+    RetryPolicy,
+    resilient_call,
+)
 from .interface import (
     CloudProvider,
     CloudProviderError,
@@ -136,6 +144,16 @@ def _instance_to_dict(inst: Instance) -> Dict:
 # Server
 # ---------------------------------------------------------------------------
 
+#: reservation marker: the launch token is taken but its instance has not
+#: committed yet (first attempt still in flight)
+_PENDING = "__pending__"
+
+
+class LaunchInFlight(Exception):
+    """A retry raced its own still-in-flight first attempt; served as a
+    retryable 503 so the client backs off and replays against the committed
+    instance."""
+
 
 class CloudHTTPService:
     """The cloud side: instance store + subnet IPs + ICE pools behind HTTP.
@@ -150,6 +168,7 @@ class CloudHTTPService:
         latency_s: float = 0.0,
         consistency_lag_s: float = 0.0,
         port: int = 0,
+        fault_plan: Optional[FaultPlan] = None,
     ):
         from .pricing import PricingProvider
         from .subnet import SubnetProvider
@@ -169,7 +188,15 @@ class CloudHTTPService:
         self.subnet_provider = SubnetProvider(self.subnets)
         self.latency_s = latency_s
         self.consistency_lag_s = consistency_lag_s
+        # scripted server-side failures (utils/faults.py): handle() consumes
+        # one fault per matching request BEFORE dispatch, so retry/breaker
+        # behavior is exercisable against the real HTTP boundary
+        self.fault_plan = fault_plan
         self.instances: Dict[str, Instance] = {}
+        # idempotency index: client launch token -> instance id, or _PENDING
+        # while the first attempt is still in flight (EC2 client-token
+        # semantics; see run_instances)
+        self._launch_tokens: Dict[str, str] = {}
         self.insufficient_capacity_pools: set = set()
         self.request_log: List[str] = []  # endpoint per backend call
         self._counter = 0
@@ -200,9 +227,29 @@ class CloudHTTPService:
     # -- operations (all called under the HTTP handler) ---------------------
     def run_instances(self, body: Dict) -> Dict:
         """Walk the client's price-ordered overrides with the shared fallback
-        policy; the server contributes ICE pools + subnet IP accounting."""
+        policy; the server contributes ICE pools + subnet IP accounting.
+
+        ``client_token`` is an IDEMPOTENCY KEY (EC2 client-token semantics):
+        the client mints one token per logical launch and every transport
+        retry carries it, so a retried launch whose first attempt actually
+        landed — the client's timeout fired after the server committed —
+        returns the existing instance instead of a duplicate. A retry racing
+        a still-IN-FLIGHT first attempt finds the token reserved and gets a
+        retryable 503 (LaunchInFlight) rather than a second launch."""
         from .launchpolicy import launch_with_fallback
 
+        token = body.get("client_token", "")
+        if token:
+            with self._lock:
+                reserved = self._launch_tokens.get(token)
+                if reserved == _PENDING:
+                    raise LaunchInFlight(token)
+                if reserved is not None and reserved in self.instances:
+                    return {
+                        "instance": _instance_to_dict(self.instances[reserved]),
+                        "attempted": [],
+                    }
+                self._launch_tokens[token] = _PENDING
         machine = Machine(
             meta=ObjectMeta(name=body.get("name", "")),
             provisioner_name=body.get("provisioner_name", ""),
@@ -229,12 +276,15 @@ class CloudHTTPService:
                             wk.MANAGED_BY: "karpenter-tpu",
                             wk.PROVISIONER_NAME: machine.provisioner_name,
                             "subnet": subnet.id,
+                            **({"launch-token": token} if token else {}),
                             **body.get("tags", {}),
                         },
                         created=time.time(),
                     )
                     self.subnet_provider.commit(subnet.id)
                     self.instances[iid] = inst
+                    if token:
+                        self._launch_tokens[token] = iid
                     self._publish()
                 return _instance_to_dict(inst)
             except Exception:
@@ -262,6 +312,13 @@ class CloudHTTPService:
                 "error": {"type": "ICE", "message": "all offerings exhausted"},
                 "attempted": attempted,
             }
+        finally:
+            if token:
+                with self._lock:
+                    # a failed/aborted launch releases the reservation so a
+                    # fresh retry with the same token can attempt again
+                    if self._launch_tokens.get(token) == _PENDING:
+                        self._launch_tokens.pop(token)
 
     def terminate(self, body: Dict) -> Dict:
         results = []
@@ -274,6 +331,9 @@ class CloudHTTPService:
                 subnet_id = inst.tags.get("subnet")
                 if subnet_id:
                     self.subnet_provider.release_ip(subnet_id)
+                token = inst.tags.get("launch-token")
+                if token:
+                    self._launch_tokens.pop(token, None)
                 results.append(None)
             self._publish()
         return {"results": results}
@@ -291,6 +351,25 @@ class CloudHTTPService:
         if self.latency_s:
             time.sleep(self.latency_s)
         self.request_log.append(path)
+        if self.fault_plan is not None:
+            fault = self.fault_plan.next(path)
+            if fault is not None:
+                if fault.kind == "latency":
+                    if fault.latency_s > 0:
+                        self.fault_plan.sleep(fault.latency_s)
+                elif fault.kind == "capacity" and path == "/v1/run-instances":
+                    # the all-offerings-exhausted wire shape run_instances
+                    # itself produces; attempted= lets the client mark the
+                    # offerings it asked for
+                    return 200, {
+                        "error": {"type": "ICE", "message": fault.reason},
+                        "attempted": [
+                            {"key": list(k), "reason": fault.reason}
+                            for k in (body or {}).get("overrides", [])
+                        ],
+                    }
+                else:
+                    return (fault.status or 503), {"error": fault.reason}
         if path == "/v1/instance-types":
             return 200, {
                 "catalog_version": len(self.request_log),
@@ -309,7 +388,10 @@ class CloudHTTPService:
                 ],
             }
         if path == "/v1/run-instances":
-            return 200, self.run_instances(body or {})
+            try:
+                return 200, self.run_instances(body or {})
+            except LaunchInFlight:
+                return 503, {"error": "launch in flight; retry"}
         if path == "/v1/terminate":
             return 200, self.terminate(body or {})
         if path == "/v1/describe":
@@ -427,12 +509,24 @@ class HTTPCloudProvider(WindowedBatchers, CloudProvider):
         max_instance_types: int = 60,
         catalog_ttl_s: float = 10.0,
         timeout_s: float = 10.0,
+        retry_policy: Optional[RetryPolicy] = None,
+        breakers: Optional[BreakerSet] = None,
+        ice_ttl_s: Optional[float] = None,
     ):
         self.endpoint = endpoint.rstrip("/")
         self.max_instance_types = max_instance_types
         self.catalog_ttl_s = catalog_ttl_s
         self.timeout_s = timeout_s
-        self.unavailable_offerings = UnavailableOfferings()
+        # shared resilience layer (utils/resilience.py): transient failures
+        # retry with jittered backoff under per-endpoint circuit breakers
+        self.retry_policy = retry_policy or RetryPolicy()
+        self.breakers = breakers or BreakerSet("cloud")
+        self._transport = self._http_transport  # swappable (ScriptedTransport)
+        self.unavailable_offerings = (
+            UnavailableOfferings(ttl=ice_ttl_s)
+            if ice_ttl_s is not None
+            else UnavailableOfferings()
+        )
         self.node_template_lookup = None  # protocol attr; templates unsupported
         self._lock = threading.Lock()
         self._catalog_cache: Optional[Tuple[float, List[InstanceType]]] = None
@@ -441,21 +535,40 @@ class HTTPCloudProvider(WindowedBatchers, CloudProvider):
         self._images_cache: Optional[Tuple[float, Dict[str, str]]] = None
 
     # -- transport -----------------------------------------------------------
-    def _call(self, path: str, body: Optional[Dict] = None) -> Dict:
+    def _http_transport(self, path: str, body: Optional[Dict]) -> Dict:
+        """One wire attempt; raises the raw urllib errors for classification."""
         url = f"{self.endpoint}{path}"
+        if body is None:
+            req = urllib.request.Request(url)
+        else:
+            req = urllib.request.Request(
+                url,
+                data=json.dumps(body).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+        timeout = self.retry_policy.attempt_timeout_s or self.timeout_s
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return json.loads(r.read())
+
+    def _call(self, path: str, body: Optional[Dict] = None) -> Dict:
+        """Transport with retries (429/5xx/connection errors, full-jitter
+        backoff, total deadline) under the endpoint's circuit breaker.
+        Terminal failures and exhausted retries surface as CloudProviderError
+        so callers keep one exception seam."""
         try:
-            if body is None:
-                req = urllib.request.Request(url)
-            else:
-                req = urllib.request.Request(
-                    url,
-                    data=json.dumps(body).encode(),
-                    headers={"Content-Type": "application/json"},
-                )
-            with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
-                return json.loads(r.read())
+            return resilient_call(
+                lambda: self._transport(path, body),
+                policy=self.retry_policy,
+                breaker=self.breakers.get(path),
+                service="cloud",
+                endpoint=path,
+            )
+        except CircuitOpenError as e:
+            raise CloudProviderError(f"cloud API circuit open: {e}") from e
         except urllib.error.URLError as e:
             raise CloudProviderError(f"cloud API unreachable: {e}") from e
+        except (ConnectionError, TimeoutError, http.client.HTTPException) as e:
+            raise CloudProviderError(f"cloud API transport error: {e}") from e
 
     # -- catalog -------------------------------------------------------------
     def _catalog(self) -> List[InstanceType]:
@@ -531,11 +644,19 @@ class HTTPCloudProvider(WindowedBatchers, CloudProvider):
             raise InsufficientCapacityError(
                 f"no compatible offerings for machine {machine.name}"
             )
+        import uuid
+
         resp = self._call(
             "/v1/run-instances",
             {
                 "name": machine.meta.name,
                 "provisioner_name": machine.provisioner_name,
+                # idempotency token, minted once per logical launch: every
+                # transport retry reuses this body, so an ambiguous failure
+                # (timeout after the server committed) replays instead of
+                # double-launching; a fresh process mints fresh tokens, so a
+                # restarted operator can never collide with old launches
+                "client_token": uuid.uuid4().hex,
                 "overrides": [
                     [it.name, o.zone, o.capacity_type] for it, o in candidates
                 ],

@@ -17,7 +17,18 @@ from .types import InstanceType
 
 
 class CloudProviderError(Exception):
-    pass
+    #: retry classification consumed by utils/resilience.is_retryable:
+    #: provider errors are terminal unless a subclass (or wrapper) says
+    #: otherwise — retrying an unclassified failure risks double-launches.
+    retryable = False
+
+
+class TransientCloudError(CloudProviderError):
+    """Retryable control-plane failure: throttle (429), 5xx, connection
+    reset/timeout. The provisioning path retries these through the shared
+    RetryPolicy instead of failing the reconcile round."""
+
+    retryable = True
 
 
 class InsufficientCapacityError(CloudProviderError):

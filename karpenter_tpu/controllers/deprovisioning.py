@@ -77,6 +77,10 @@ class DeprovisioningController:
         self.settings = settings or Settings()
         self.recorder = recorder or Recorder()
         self.clock = clock or Clock()
+        from ..utils.resilience import retry_policy_from_settings
+
+        # replacement launches retry transient failures like provisioning does
+        self.retry_policy = retry_policy_from_settings(self.settings)
         # Quality-budget sweep solver (round-4 verdict item 3): consolidation
         # is not latency-critical (15s validation TTL, out-of-band cadence),
         # so LARGE repack simulations get a quality-mode TPUSolver — the
@@ -524,7 +528,10 @@ class DeprovisioningController:
             requests = merge(
                 [self.cluster.pods[n].requests for n in pods if n in self.cluster.pods]
             )
-            launch_from_spec(self.cluster, self.provider, replacement, requests)
+            launch_from_spec(
+                self.cluster, self.provider, replacement, requests,
+                retry_policy=self.retry_policy,
+            )
         for name in action.nodes:
             self.termination.delete_node(name)
         self.termination.reconcile()

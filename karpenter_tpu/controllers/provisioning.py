@@ -32,6 +32,7 @@ from ..solver.solver import Solver, TPUSolver
 from ..state.cluster import Cluster
 from ..utils import metrics
 from ..utils.events import Recorder
+from ..utils.resilience import RetryPolicy, retry_policy_from_settings
 
 _machine_ids = itertools.count(1)
 
@@ -97,6 +98,10 @@ class ProvisioningController:
         self.batcher = PodBatcher(
             idle=self.settings.batch_idle_duration, max_duration=self.settings.batch_max_duration
         )
+        # transient launch failures (throttle/5xx through the provider seam)
+        # retry in-round with jittered backoff instead of failing the whole
+        # reconcile and stalling on the kit's loop-level backoff
+        self.retry_policy = retry_policy_from_settings(self.settings)
         self._pending_seen: set = set()
         cluster.watch(self._on_event)
 
@@ -146,18 +151,28 @@ class ProvisioningController:
             self.batcher.reset(upto_generation=batch_gen)
             return result
 
-        provs = [(p, self.provider.get_instance_types(p)) for p in provisioners]
         daemonsets = self.cluster.daemonsets()
 
         # Pool cascade (reference: provisioners are tried highest-weight-first
         # and a pool that cannot host — limits reached, zone coverage too
         # narrow — is skipped for the next one): each round solves the still-
         # pending pods against the non-exhausted pools; a round that exhausts
-        # a pool's limits re-solves without it.
+        # a pool's limits re-solves without it. A round whose launches ICE
+        # re-solves too (bounded by _ICE_RETRIES): the failed offerings are in
+        # the unavailable cache by then, so the next solve degrades to the
+        # next-cheapest feasible offering instead of failing the round.
         batch = list(pods)
         exhausted: set = set()
-        for round_no in range(max(len(provisioners), 1) + 1):
-            round_provs = [(p, t) for (p, t) in provs if p.name not in exhausted]
+        ice_retries = 0
+        for round_no in range(max(len(provisioners), 1) + 1 + self._ICE_RETRIES):
+            # instance-type lists refresh each round: an ICE mark from the
+            # previous round's launches must mask the offering NOW, not next
+            # reconcile (get_instance_types is seqnum-cached — cheap when
+            # nothing changed)
+            round_provs = [
+                (p, self.provider.get_instance_types(p))
+                for p in provisioners if p.name not in exhausted
+            ]
             if not round_provs or not batch:
                 for p in batch:
                     result.unschedulable.append(p.name)
@@ -176,8 +191,11 @@ class ProvisioningController:
             if result.solve is None:
                 result.solve = solve
             metrics.SOLVE_DURATION.observe(solve.stats.get("total_s", 0.0))
-            limit_hit = self._apply_solve(solve, result)
-            if limit_hit:
+            limit_hit, ice_failed = self._apply_solve(solve, result)
+            retry_ice = bool(ice_failed) and ice_retries < self._ICE_RETRIES
+            if retry_ice:
+                ice_retries += 1
+            if limit_hit or retry_ice:
                 exhausted |= limit_hit
                 # EVERYTHING still pending gets another round against the
                 # remaining pools — both the limit-blocked specs' pods and the
@@ -208,10 +226,16 @@ class ProvisioningController:
         self.batcher.reset(upto_generation=batch_gen)
         return result
 
-    def _apply_solve(self, solve: SolveResult, result: ProvisioningResult) -> set:
+    #: bounded in-round re-solves after ICE launch failures: each retry has
+    #: the failed offering(s) freshly masked, so one retry normally lands the
+    #: next-cheapest offering; a storm falls back to the next reconcile
+    _ICE_RETRIES = 2
+
+    def _apply_solve(self, solve: SolveResult, result: ProvisioningResult) -> Tuple[set, set]:
         """Bind existing-node assignments and launch new nodes for one solve,
-        honoring provisioner limits. Returns the names of provisioners whose
-        limits blocked specs this pass (the caller cascades to other pools)."""
+        honoring provisioner limits. Returns (provisioners whose limits
+        blocked specs, pods whose launch failed with insufficient capacity) —
+        the caller cascades to other pools / re-solves with the ICE mask."""
         for node_name, pod_names in solve.existing_assignments.items():
             for pod_name in pod_names:
                 self.cluster.bind_pod(pod_name, node_name)
@@ -247,12 +271,16 @@ class ProvisioningController:
         # batcher, so same-shape machines coalesce into one cloud call
         # (reference: parallel machine launches + createfleet.go batching)
         outcomes = self._launch_all(launchable)
+        ice_failed: set = set()
         for spec, outcome in zip(launchable, outcomes):
             prov = spec.option.provisioner
             if isinstance(outcome, InsufficientCapacityError):
-                # offerings exhausted even after in-provider fallback: pods stay
-                # pending; the ICE cache masks these offerings next cycle
-                # (instance.go:400-406)
+                # offerings exhausted even after in-provider fallback: the ICE
+                # cache masks them, and the caller re-solves this round so the
+                # pods degrade to the next-cheapest offering (instance.go:
+                # 400-406); past the retry budget they stay pending with the
+                # mask applied next cycle
+                ice_failed.update(spec.pod_names)
                 result.unschedulable.extend(spec.pod_names)
                 continue
             if isinstance(outcome, BaseException):
@@ -272,12 +300,13 @@ class ProvisioningController:
                 self.cluster.bind_pod(pod_name, node.name)
                 result.bound[pod_name] = node.name
                 metrics.PODS_SCHEDULED.inc()
-        return limit_hit
+        return limit_hit, ice_failed
 
     def _launch(self, spec: NewNodeSpec, create_fn=None) -> Tuple[Machine, Node]:
         requests = merge([self._pod_requests(n) for n in spec.pod_names])
         return launch_from_spec(
-            self.cluster, self.provider, spec, requests, create_fn=create_fn
+            self.cluster, self.provider, spec, requests, create_fn=create_fn,
+            retry_policy=self.retry_policy,
         )
 
     def _launch_all(self, specs: List[NewNodeSpec]) -> List[object]:
@@ -318,10 +347,15 @@ def launch_from_spec(
     spec: NewNodeSpec,
     requests: Resources,
     create_fn=None,
+    retry_policy: Optional[RetryPolicy] = None,
 ) -> Tuple[Machine, Node]:
     """Launch one machine for a solver node spec and register its node. Shared by
     the provisioning loop and consolidation replacements (which the reference also
-    routes through CloudProvider.Create)."""
+    routes through CloudProvider.Create).
+
+    ``retry_policy`` retries TRANSIENT create failures (TransientCloudError /
+    retryable-flagged errors) in-round; insufficient capacity stays terminal —
+    the ICE cache plus the in-provider fallback walk own that path."""
     option = spec.option
     prov = option.provisioner
     name = f"{prov.name}-{next(_machine_ids)}"
@@ -341,7 +375,13 @@ def launch_from_spec(
         node_template_ref=prov.node_template_ref,
     )
     t0 = time.perf_counter()
-    machine = (create_fn or provider.create)(machine)
+    create = create_fn or provider.create
+    if retry_policy is not None:
+        machine = retry_policy.call(
+            lambda: create(machine), service="provider", endpoint="create"
+        )
+    else:
+        machine = create(machine)
     metrics.CLOUDPROVIDER_DURATION.observe(time.perf_counter() - t0, {"method": "create"})
     cluster.add_machine(machine)
     node = register_node(cluster, machine, prov)

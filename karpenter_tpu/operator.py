@@ -77,6 +77,9 @@ class Operator:
             # let the cloud provider resolve NodeTemplate refs at launch time
             # (the reference fetches the AWSNodeTemplate by ref inside Create)
             provider.node_template_lookup = cluster.node_templates.get
+        if getattr(provider, "unavailable_offerings", None) is not None:
+            # settings own the ICE TTL (reference: 3m, cache.go:20-36)
+            provider.unavailable_offerings.set_ttl(settings.insufficient_capacity_ttl)
         recorder = Recorder()
         solver = solver or TPUSolver()
         provisioning = ProvisioningController(

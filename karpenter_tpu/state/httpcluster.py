@@ -22,6 +22,7 @@ that stack). ``HTTPCluster`` is the same shape against
 from __future__ import annotations
 
 import json
+import logging
 import threading
 import urllib.error
 import urllib.request
@@ -37,6 +38,13 @@ from ..api.objects import (
     PodDisruptionBudget,
     Provisioner,
 )
+from ..utils.logging import get_logger, kv
+from ..utils.resilience import (
+    BreakerSet,
+    CircuitOpenError,
+    RetryPolicy,
+    resilient_call,
+)
 from .cluster import Cluster
 
 _COLLECTION_ATTR = {
@@ -50,10 +58,25 @@ _COLLECTION_ATTR = {
 
 
 class HTTPCluster(Cluster):
-    def __init__(self, endpoint: str, timeout_s: float = 10.0, watch: bool = True):
+    def __init__(
+        self,
+        endpoint: str,
+        timeout_s: float = 10.0,
+        watch: bool = True,
+        retry_policy: Optional[RetryPolicy] = None,
+        breakers: Optional[BreakerSet] = None,
+    ):
         super().__init__()
         self.endpoint = endpoint.rstrip("/")
         self.timeout_s = timeout_s
+        # shared resilience layer (utils/resilience.py): every apiserver call
+        # retries transient failures with jittered backoff under a
+        # per-endpoint breaker; the watch thread reuses the same policy's
+        # backoff schedule for reconnects (see _watch_loop)
+        self.retry_policy = retry_policy or RetryPolicy()
+        self.breakers = breakers or BreakerSet("apiserver")
+        self._transport = self._http_transport  # swappable (ScriptedTransport)
+        self._log = get_logger("httpcluster")
         self._bookmark = 0  # server watch seq consumed so far
         # (kind, name) -> deferred events: the watch echo for a self-initiated
         # write can land BEFORE the write path's own cache apply (the
@@ -74,16 +97,58 @@ class HTTPCluster(Cluster):
             self._watch_thread.start()
 
     # -- wire ----------------------------------------------------------------
-    def _call(self, method: str, path: str, body: Optional[Dict] = None) -> Dict:
+    def _http_transport(self, method: str, path: str, body: Optional[Dict]) -> Dict:
+        """One wire attempt; raw urllib errors propagate for classification."""
         data = json.dumps(body).encode() if body is not None else None
         req = urllib.request.Request(
             f"{self.endpoint}{path}", data=data, method=method
         )
         if data is not None:
             req.add_header("Content-Type", "application/json")
+        timeout = self.retry_policy.attempt_timeout_s or self.timeout_s
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return json.loads(resp.read() or b"{}")
+
+    @staticmethod
+    def _route(path: str) -> str:
+        """Normalize a request path to its route TEMPLATE for breaker and
+        metric keying: raw per-object paths (/api/pods/<name>, .../bind)
+        would mint one breaker + one metric series per object — unbounded
+        growth, and per-object breakers see ~1 call each so they could
+        never accumulate enough consecutive failures to open."""
+        parts = path.split("?", 1)[0].strip("/").split("/")
+        if len(parts) >= 2 and parts[0] == "api":
+            route = f"/api/{parts[1]}"
+            if len(parts) >= 3:
+                route += "/{name}"
+            if len(parts) >= 4:
+                route += "/" + parts[3]  # the verb, e.g. bind
+            return route
+        return "/" + parts[0] if parts and parts[0] else "/"
+
+    def _call(self, method: str, path: str, body: Optional[Dict] = None) -> Dict:
+        """Transport with retries + per-endpoint breaker. 5xx/connection
+        failures retry with jittered backoff; 4xx (admission, not-found,
+        conflicts) are terminal and surface immediately. NOTE on writes:
+        a retried POST/PUT whose first attempt actually landed replays as an
+        idempotent per-object-version no-op on the server side (the same
+        guard that absorbs watch echoes)."""
+        endpoint = self._route(path)
+        # the watch long-poll is exempt from the breaker: it is a single
+        # self-paced consumer (the watch loop already backs off between
+        # reconnects), and an open circuit would delay post-restart resync
+        # by the whole recovery window for no protective benefit
+        breaker = None if endpoint == "/watch" else self.breakers.get(endpoint)
         try:
-            with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
-                return json.loads(resp.read() or b"{}")
+            return resilient_call(
+                lambda: self._transport(method, path, body),
+                policy=self.retry_policy,
+                breaker=breaker,
+                service="apiserver",
+                endpoint=endpoint,
+            )
+        except CircuitOpenError as e:
+            raise RuntimeError(f"{method} {path}: {e}") from e
         except urllib.error.HTTPError as e:
             payload = {}
             try:
@@ -157,18 +222,35 @@ class HTTPCluster(Cluster):
         self._emit(event, obj)
 
     def _watch_loop(self) -> None:
+        """Informer watch with server-restart survival: failures reconnect on
+        the shared RetryPolicy's backoff schedule (the _call-level retries
+        already absorbed the transient window), logging ONCE at WARN when the
+        watch first disconnects — not per iteration — then at DEBUG until it
+        recovers. A rejected bookmark (server "gone", k8s 410 semantics)
+        falls back to a full relist, which also re-reads the bookmark."""
+        failures = 0
         while not self._stop.is_set():
             try:
                 out = self._call(
                     "GET", f"/watch?since={self._bookmark}&timeout=5"
                 )
-            except Exception:
-                if self._stop.wait(0.2):
+                if out.get("gone"):
+                    self.relist()  # bookmark rejected: full resync
+                    continue
+            except Exception as e:
+                failures += 1
+                delay = self.retry_policy.backoff(min(failures - 1, 8))
+                level = logging.WARNING if failures == 1 else logging.DEBUG
+                kv(self._log, level, "watch disconnected; reconnecting",
+                   failures=failures, delay_s=round(delay, 3),
+                   error=f"{type(e).__name__}: {e}")
+                if self._stop.wait(delay):
                     return
                 continue
-            if out.get("gone"):
-                self.relist()
-                continue
+            if failures:
+                kv(self._log, logging.INFO, "watch reconnected",
+                   after_failures=failures)
+                failures = 0
             for ev in out.get("events", ()):
                 self._apply_wire(
                     ev["resourceVersion"], ev["event"], ev["kind"], ev["object"]

@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import threading
 import time
+import weakref
 from typing import Any, Callable, Dict, Generic, Iterator, Optional, Tuple, TypeVar
 
 DEFAULT_TTL = 60.0
@@ -89,9 +90,11 @@ class UnavailableOfferings:
     """
 
     def __init__(self, ttl: float = UNAVAILABLE_OFFERINGS_TTL, clock: Optional[Clock] = None):
-        self._cache: TTLCache[str, bool] = TTLCache(ttl, clock)
+        self._clock = clock or Clock()
+        self._cache: TTLCache[str, bool] = TTLCache(ttl, self._clock)
         self.seqnum = 0
         self._lock = threading.Lock()
+        _track_for_gauge(self)
 
     @staticmethod
     def _key(capacity_type: str, instance_type: str, zone: str) -> str:
@@ -106,8 +109,65 @@ class UnavailableOfferings:
         with self._lock:
             self._cache.set(self._key(capacity_type, instance_type, zone), True)
             self.seqnum += 1
+        self._publish_gauge()
+
+    def set_ttl(self, ttl: float) -> None:
+        """Retarget the ICE TTL (settings.insufficient_capacity_ttl): applies
+        to subsequent marks; live entries keep their original expiry."""
+        self._cache.ttl = ttl
+
+    def entries(self) -> list:
+        """Live (instance_type, zone, capacity_type) entries, expiry applied."""
+        out = []
+        for key in self._cache.keys():
+            capacity_type, instance_type, zone = key.split(":", 2)
+            out.append((instance_type, zone, capacity_type))
+        return out
+
+    def _publish_gauge(self) -> None:
+        publish_offering_gauge()
 
     def flush(self) -> None:
         with self._lock:
             self._cache.flush()
             self.seqnum += 1
+        self._publish_gauge()
+
+
+# -- karpenter_tpu_rpc_offering_unavailable export ---------------------------
+# All live UnavailableOfferings instances feed ONE merged gauge, refreshed on
+# mark/flush AND at scrape time (a registry pre-scrape refresher), so expired
+# entries leave /metrics even while the operator is idle — no mark required.
+
+_live_caches: "weakref.WeakSet[UnavailableOfferings]" = weakref.WeakSet()
+_gauge_lock = threading.Lock()
+_refresher_registered = False
+
+
+def publish_offering_gauge() -> None:
+    """Swap the merged live mask of every tracked cache into the gauge —
+    full replace, so expired/flushed entries drop with the same swap."""
+    from . import metrics
+
+    series: Dict = {}
+    with _gauge_lock:
+        caches = list(_live_caches)
+    for cache in caches:
+        for it, z, ct in cache.entries():
+            series[
+                metrics.series_key(
+                    {"instance_type": it, "zone": z, "capacity_type": ct}
+                )
+            ] = 1.0
+    metrics.RPC_OFFERING_UNAVAILABLE.replace_series(series)
+
+
+def _track_for_gauge(cache: "UnavailableOfferings") -> None:
+    global _refresher_registered
+    from . import metrics
+
+    with _gauge_lock:
+        _live_caches.add(cache)
+        if not _refresher_registered:
+            metrics.REGISTRY.add_refresher(publish_offering_gauge)
+            _refresher_registered = True

@@ -1,0 +1,156 @@
+"""Deterministic fault-injection harness for the RPC layer.
+
+A :class:`FaultPlan` scripts per-endpoint failures — N errors then success,
+latency spikes, insufficient-capacity errors — and is consumed by the fault
+seams in :class:`~karpenter_tpu.cloudprovider.fake.FakeCloudProvider`, the
+HTTP cloud service (``CloudHTTPService(fault_plan=...)``) and the scripted
+transport below. Scripts are ordered queues, so every retry/breaker/ICE
+behavior is testable deterministically: "2 transient 5xx then success" is a
+script, not a probability, and the plan's ``log`` records exactly which
+faults fired in which order. No randomness, and no real sleeps unless a
+latency fault explicitly asks for one (tests inject ``sleep=lambda s: None``
+and assert on the recorded delay instead).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import urllib.error
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+
+@dataclass(frozen=True)
+class Fault:
+    """One scripted failure.
+
+    kind:
+      * ``"error"``    — transient failure; ``status`` is the HTTP status the
+        wire surfaces (0 means a connection-level error with no response).
+      * ``"capacity"`` — insufficient capacity: the provider raises/returns
+        its ICE shape so the offering lands in the unavailable cache.
+      * ``"latency"``  — delay ``latency_s`` then proceed normally.
+    """
+
+    kind: str = "error"
+    status: int = 503
+    latency_s: float = 0.0
+    reason: str = "injected"
+
+
+def errors(n: int, status: int = 503) -> List[Fault]:
+    """N transient errors then success — the canonical retry script."""
+    return [Fault(kind="error", status=status) for _ in range(n)]
+
+
+class FaultPlan:
+    """Scripted per-endpoint fault queues.
+
+    ``script(endpoint, faults)`` appends faults to the endpoint's queue;
+    each matching call pops one fault until the queue drains, after which
+    the endpoint behaves normally. ``"*"`` scripts apply to any endpoint
+    without its own queue. ``log`` records ``(endpoint, fault)`` in firing
+    order; ``sleep`` is the latency-fault sleeper (injectable so tests run
+    latency scripts without wall-clock delay).
+    """
+
+    def __init__(self, sleep: Callable[[float], None] = time.sleep):
+        self._scripts: Dict[str, List[Fault]] = {}
+        self._lock = threading.Lock()
+        self.sleep = sleep
+        self.log: List[Tuple[str, Fault]] = []
+
+    def script(self, endpoint: str, faults: Sequence[Fault]) -> "FaultPlan":
+        with self._lock:
+            self._scripts.setdefault(endpoint, []).extend(faults)
+        return self
+
+    def fail(self, endpoint: str, n: int = 1, status: int = 503) -> "FaultPlan":
+        """Convenience: N transient errors then success on ``endpoint``."""
+        return self.script(endpoint, errors(n, status=status))
+
+    def capacity_error(self, endpoint: str, n: int = 1, reason: str = "ICE") -> "FaultPlan":
+        return self.script(endpoint, [Fault(kind="capacity", reason=reason)] * n)
+
+    def latency(self, endpoint: str, seconds: float, n: int = 1) -> "FaultPlan":
+        return self.script(endpoint, [Fault(kind="latency", latency_s=seconds)] * n)
+
+    def next(self, endpoint: str) -> Optional[Fault]:
+        """Pop the next scripted fault for ``endpoint`` (exact queue first,
+        then the ``"*"`` wildcard queue); None when the script is drained."""
+        with self._lock:
+            for key in (endpoint, "*"):
+                queue = self._scripts.get(key)
+                if queue:
+                    fault = queue.pop(0)
+                    self.log.append((endpoint, fault))
+                    return fault
+        return None
+
+    def pending(self, endpoint: Optional[str] = None) -> int:
+        with self._lock:
+            if endpoint is not None:
+                return len(self._scripts.get(endpoint, []))
+            return sum(len(q) for q in self._scripts.values())
+
+
+def raise_for_fault(fault: Optional[Fault], plan: "FaultPlan", endpoint: str) -> None:
+    """Provider-side fault application: turn a scripted fault into the
+    exception the in-process provider seam raises (transient errors become
+    ``TransientCloudError``, capacity becomes ``InsufficientCapacityError``,
+    latency sleeps through the plan's injectable sleeper)."""
+    if fault is None:
+        return
+    from ..cloudprovider.interface import InsufficientCapacityError, TransientCloudError
+
+    if fault.kind == "latency":
+        if fault.latency_s > 0:
+            plan.sleep(fault.latency_s)
+        return
+    if fault.kind == "capacity":
+        raise InsufficientCapacityError(
+            f"injected capacity failure on {endpoint}", reason=fault.reason
+        )
+    raise TransientCloudError(
+        f"injected {fault.status or 'connection'} error on {endpoint}"
+    )
+
+
+class ScriptedTransport:
+    """A fake HTTP transport for the client retry tests: wraps a real
+    transport callable and applies a FaultPlan in front of it, raising the
+    wire-shaped exceptions a urllib transport would (HTTPError for status
+    faults, URLError for connection faults) — so ``HTTPCloudProvider._call``
+    and ``HTTPCluster._call`` exercise their true classification paths
+    without a flaky server."""
+
+    def __init__(self, plan: FaultPlan, inner: Callable[..., dict]):
+        self.plan = plan
+        self.inner = inner
+        self.calls: List[str] = []
+
+    def __call__(self, *args, **kwargs):
+        endpoint = _endpoint_of(args)
+        self.calls.append(endpoint)
+        fault = self.plan.next(endpoint)
+        if fault is not None:
+            if fault.kind == "latency":
+                if fault.latency_s > 0:
+                    self.plan.sleep(fault.latency_s)
+            elif fault.status == 0:
+                raise urllib.error.URLError("injected connection failure")
+            else:
+                raise urllib.error.HTTPError(
+                    endpoint, fault.status, fault.reason, hdrs=None, fp=None
+                )
+        return self.inner(*args, **kwargs)
+
+
+def _endpoint_of(args: tuple) -> str:
+    """The path-like positional arg: transports are called (path, body) or
+    (method, path, body)."""
+    for a in args:
+        if isinstance(a, str) and a.startswith("/"):
+            return a.split("?", 1)[0]
+    return args[0] if args else ""

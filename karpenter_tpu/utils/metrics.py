@@ -237,17 +237,30 @@ class Histogram:
 class Registry:
     def __init__(self) -> None:
         self._collectors: List = []
+        self._refreshers: List = []
         self._lock = threading.Lock()
 
     def register(self, collector) -> None:
         with self._lock:
             self._collectors.append(collector)
 
+    def add_refresher(self, fn) -> None:
+        """Register a pre-scrape hook: called at the start of every
+        exposition so gauges fed from expiring state (e.g. the ICE cache)
+        render CURRENT values. Refreshers are not collectors — they emit no
+        series of their own."""
+        with self._lock:
+            self._refreshers.append(fn)
+
     def collectors(self) -> List:
         with self._lock:
             return list(self._collectors)
 
     def exposition(self) -> str:
+        with self._lock:
+            refreshers = list(self._refreshers)
+        for fn in refreshers:
+            fn()
         lines: List[str] = []
         for c in self.collectors():
             lines.extend(c.collect())
@@ -385,6 +398,37 @@ PROVISIONER_LIMIT = Gauge(
 STATE_SCRAPE_DURATION = Histogram(
     "karpenter_tpu_state_scrape_duration_seconds",
     help="Wall time of one state-scraper pass, labeled by scraper.",
+    registry=REGISTRY,
+)
+
+# -- RPC resilience (utils/resilience.py: retries, breakers, ICE cache) ------
+RPC_REQUESTS = Counter(
+    "karpenter_tpu_rpc_requests_total",
+    help="RPC calls through the resilience layer by service, endpoint and "
+         "outcome (ok/terminal/exhausted/deadline).",
+    registry=REGISTRY,
+)
+RPC_RETRIES = Counter(
+    "karpenter_tpu_rpc_retries_total",
+    help="Retries of transient RPC failures (429/5xx/connection errors), "
+         "by service and endpoint.",
+    registry=REGISTRY,
+)
+RPC_BREAKER_STATE = Gauge(
+    "karpenter_tpu_rpc_breaker_state",
+    help="Circuit breaker state per service and endpoint "
+         "(0=closed, 1=open, 2=half-open).",
+    registry=REGISTRY,
+)
+RPC_BREAKER_TRANSITIONS = Counter(
+    "karpenter_tpu_rpc_breaker_transitions_total",
+    help="Circuit breaker state transitions by service, endpoint and target state.",
+    registry=REGISTRY,
+)
+RPC_OFFERING_UNAVAILABLE = Gauge(
+    "karpenter_tpu_rpc_offering_unavailable",
+    help="Offerings currently masked by the insufficient-capacity (ICE) cache, "
+         "labeled by instance type, zone and capacity type (1 while masked).",
     registry=REGISTRY,
 )
 
