@@ -236,6 +236,316 @@ CONFIGS = [
 ]
 
 
+def bench_delta_reconcile(n_pods=50_000, churn=0.01, rounds=8, n_types=400):
+    """Incremental-encode scenario (ISSUE 3 acceptance): 50k deployment-shaped
+    pods, 1% churn per round (one deployment scales down, another scales up —
+    watch events feed the EncodeSession's dirty sets), steady-state DELTA
+    encode timed against a full re-encode of the same inputs. Equivalence is
+    checked at content level (problem digest vs a from-scratch encode of the
+    session's canonical pod order) and at answer level (two independent
+    solvers on the delta and full problems: identical cost, zero violations).
+    Event feeding is inside the timed region — the delta number is the whole
+    incremental path, not just the array patching."""
+    import statistics as _st
+
+    from karpenter_tpu.api import ObjectMeta, Pod, Provisioner, Resources
+    from karpenter_tpu.cloudprovider import generate_catalog
+    from karpenter_tpu.solver import EncodeSession, TPUSolver, encode, validate
+    from karpenter_tpu.solver.solver import problem_digest
+
+    prov = Provisioner(meta=ObjectMeta(name="default"))
+    provs = [(prov, generate_catalog(n_types=n_types))]
+    cpus = ["100m", "250m", "500m", "1", "2", "4"]
+    mems = ["256Mi", "512Mi", "1Gi", "2Gi", "4Gi", "8Gi"]
+    n_deploys = 30
+
+    def mkpod(name, shape):
+        return Pod(
+            meta=ObjectMeta(name=name),
+            requests=Resources(cpu=cpus[shape % 6], memory=mems[(shape // 2) % 6]),
+        )
+
+    pods = []
+    per = n_pods // n_deploys + 1
+    for shape in range(n_deploys):
+        pods += [mkpod(f"d{shape}-{i}", shape) for i in range(per)]
+    pods = pods[:n_pods]
+    session = EncodeSession()
+    session.encode(pods, provs)
+
+    n_churn = max(int(n_pods * churn) // 2, 1)
+    serial = 0
+    delta_times, full_times, modes = [], [], []
+    digests_equal = True
+    delta_problem = full_problem = None
+    for r in range(rounds):
+        down, up = r % n_deploys, (r + 7) % n_deploys
+        removed = [p for p in pods if p.meta.name.startswith(f"d{down}-")][:n_churn]
+        added = [mkpod(f"up{serial + i}-d{up}", up) for i in range(n_churn)]
+        serial += n_churn
+        gone = {p.meta.name for p in removed}
+        pods = [p for p in pods if p.meta.name not in gone] + added
+        t0 = time.perf_counter()
+        for p in removed:
+            session.pod_event("DELETED", p)
+        for p in added:
+            session.pod_event("ADDED", p)
+        delta_problem = session.encode(pods, provs)
+        delta_times.append(time.perf_counter() - t0)
+        modes.append(session.last_mode)
+        t0 = time.perf_counter()
+        full_problem = encode(session.ordered_pods(), provs)
+        full_times.append(time.perf_counter() - t0)
+        digests_equal = digests_equal and (
+            problem_digest(delta_problem) == problem_digest(full_problem)
+        )
+    d, f = _st.median(delta_times), _st.median(full_times)
+    # answer equivalence on the final round: independent solvers, no shared
+    # interned state between them
+    s1, s2 = TPUSolver(portfolio=8), TPUSolver(portfolio=8)
+    r1, r2 = s1.solve(delta_problem), s2.solve(full_problem)
+    violations = len(validate(delta_problem, r1)) + len(validate(full_problem, r2))
+    return {
+        "pods": n_pods,
+        "churn_per_round": 2 * n_churn,
+        "rounds": rounds,
+        "encode_delta_p50_ms": round(d * 1e3, 2),
+        "encode_full_p50_ms": round(f * 1e3, 2),
+        "encode_speedup": round(f / d, 1) if d > 0 else 0.0,
+        "delta_rounds": modes.count("delta"),
+        "digests_equal": bool(digests_equal),
+        "cost_per_hour_delta": round(float(r1.cost), 3),
+        "cost_per_hour_full": round(float(r2.cost), 3),
+        "cost_equal": bool(abs(r1.cost - r2.cost) < 1e-9),
+        "violations": violations,
+    }
+
+
+def _sweep_fixture(workers, n_candidates=160, pods_per_cand=40, fleet_nodes=200):
+    """Consolidation-sweep fixture: (n_candidates-1) spot nodes whose pods
+    deterministically force a replacement (their 1-vCPU pods fit nowhere in
+    the fleet's residual headroom, so ANY solver opens one cheap new node ->
+    replacement -> spot rule -> no action), plus one on-demand node whose
+    tiny pods deterministically drain into the reserved headroom (delete).
+    A protected ``fleet_nodes``-node utilized fleet rides along as existing
+    capacity so each simulation carries production-scale encode+solve work.
+    Disruption-cost ranking puts the winner LAST, so the sweep must scan
+    every candidate — the worst case the parallel fan-out exists for."""
+    from karpenter_tpu.api import Machine, ObjectMeta, Pod, Provisioner, Requirement, Requirements, Resources
+    from karpenter_tpu.api import labels as wk
+    from karpenter_tpu.api.settings import Settings
+    from karpenter_tpu.cloudprovider import FakeCloudProvider, generate_catalog
+    from karpenter_tpu.controllers.deprovisioning import DeprovisioningController
+    from karpenter_tpu.controllers.provisioning import register_node
+    from karpenter_tpu.controllers.termination import TerminationController
+    from karpenter_tpu.solver import TPUSolver
+    from karpenter_tpu.state import Cluster
+    from karpenter_tpu.utils.cache import FakeClock
+
+    provider = FakeCloudProvider(catalog=generate_catalog(n_types=100))
+    for s in provider.subnets:
+        s.available_ips = 1 << 20
+    cluster = Cluster()
+    settings = Settings(
+        batch_idle_duration=0, batch_max_duration=0,
+        consolidation_validation_ttl=0, stabilization_window=0,
+        consolidation_timeout=0,  # multi-node prefix search off: this
+        # scenario measures the single-node scan
+        consolidation_sweep_workers=workers,
+    )
+    clock = FakeClock(start=100_000.0)
+    prov = Provisioner(meta=ObjectMeta(name="default"), consolidation_enabled=True)
+    cluster.add_provisioner(prov)
+    term = TerminationController(cluster, provider, clock=clock)
+    deprov = DeprovisioningController(
+        cluster, provider, term, solver=TPUSolver(portfolio=8),
+        settings=settings, clock=clock, quality_budget_s=0.0,
+    )
+    mids = sorted(
+        [it for it in provider.catalog if 14 <= it.capacity["cpu"] <= 20],
+        key=lambda t: t.name,
+    )
+    big = sorted(
+        [it for it in provider.catalog if it.capacity["cpu"] >= 30],
+        key=lambda t: t.name,
+    )
+
+    def mknode(i, it, ct, protect=False):
+        machine = Machine(
+            meta=ObjectMeta(name=f"cand-{i}", labels=dict(prov.labels)),
+            provisioner_name=prov.name,
+            requirements=Requirements([
+                Requirement.in_values(wk.INSTANCE_TYPE, [it.name]),
+                Requirement.in_values(wk.ZONE, [["zone-a", "zone-b", "zone-c"][i % 3]]),
+                Requirement.in_values(wk.CAPACITY_TYPE, [ct]),
+            ]),
+            requests=Resources(cpu="1"),
+        )
+        machine = provider.create(machine)
+        cluster.add_machine(machine)
+        node = register_node(cluster, machine, prov)
+        if protect:
+            node.meta.annotations[wk.DO_NOT_CONSOLIDATE_ANNOTATION] = "true"
+            cluster.update(node)
+        return node
+
+    shapes = [("250m", "512Mi"), ("500m", "1Gi"), ("1", "2Gi"),
+              ("750m", "1536Mi"), ("300m", "768Mi"), ("100m", "256Mi"),
+              ("1500m", "2Gi"), ("400m", "1Gi")]
+    for i in range(n_candidates - 1):
+        node = mknode(i, mids[i % len(mids)], wk.CAPACITY_TYPE_SPOT)
+        for j in range(pods_per_cand):
+            cpu, mem = shapes[j % len(shapes)]
+            pod = Pod(
+                meta=ObjectMeta(name=f"sp-{i}-{j}", owner_kind="ReplicaSet"),
+                requests=Resources(cpu=cpu, memory=mem),
+            )
+            cluster.add_pod(pod)
+            cluster.bind_pod(pod.name, node.name)
+    # utilized fleet: protected nodes with <0.2 vCPU residual — existing
+    # capacity every simulation must scan, never a landing spot for a
+    # candidate's >=250m pods
+    for i in range(fleet_nodes):
+        node = mknode(3000 + i, mids[(i * 7) % len(mids)], wk.CAPACITY_TYPE_ON_DEMAND,
+                      protect=True)
+        filler_cpu = float(node.allocatable.get("cpu")) - 0.15
+        pod = Pod(
+            meta=ObjectMeta(name=f"fleet-{i}", owner_kind="ReplicaSet"),
+            requests=Resources(cpu=str(filler_cpu), memory="1Gi"),
+        )
+        cluster.add_pod(pod)
+        cluster.bind_pod(pod.name, node.name)
+    # headroom nodes: big on-demand, filled to ~1.5 vCPU free — room for the
+    # tiny-pod candidate's spillover, never for a spot candidate's 1-vCPU pods
+    for i in range(6):
+        node = mknode(1000 + i, big[i % len(big)], wk.CAPACITY_TYPE_ON_DEMAND, protect=True)
+        filler_cpu = float(node.allocatable.get("cpu")) - 1.5
+        pod = Pod(
+            meta=ObjectMeta(name=f"fill-{i}", owner_kind="ReplicaSet"),
+            requests=Resources(cpu=str(filler_cpu), memory="1Gi"),
+        )
+        cluster.add_pod(pod)
+        cluster.bind_pod(pod.name, node.name)
+    last = mknode(2000, mids[0], wk.CAPACITY_TYPE_ON_DEMAND)
+    for j in range(pods_per_cand + 10):  # most pods -> ranked last
+        pod = Pod(
+            meta=ObjectMeta(name=f"tiny-{j}", owner_kind="ReplicaSet"),
+            requests=Resources(cpu="100m", memory="64Mi"),
+        )
+        cluster.add_pod(pod)
+        cluster.bind_pod(pod.name, last.name)
+    return deprov
+
+
+def _cpu_scaling_probe(n=6_000_000):
+    """Raw 2-process CPU scaling of this host (1.0 = no parallel headroom,
+    2.0 = two full cores): the ceiling for ANY sweep parallelization,
+    reported so the sweep numbers are readable on shared/throttled boxes.
+    Spawned (not forked) children with a hard timeout: by the time this
+    probe runs, the process carries JAX/XLA and pool threads, and forking a
+    multithreaded interpreter can deadlock the child on a snapshotted lock
+    — a hang here would stall the whole bench, not fail it."""
+    import multiprocessing as mp
+
+    t0 = time.perf_counter()
+    _burn_worker(n)
+    _burn_worker(n)
+    serial = time.perf_counter() - t0
+    ctx = mp.get_context("spawn")
+    with ctx.Pool(2) as pool:
+        # boot both workers off the clock (spawn pays interpreter startup)
+        pool.map_async(_burn_worker, [1000, 1000]).get(timeout=120)
+        t0 = time.perf_counter()
+        pool.map_async(_burn_worker, [n, n]).get(timeout=120)
+        par = time.perf_counter() - t0
+    return round(serial / par, 2) if par > 0 else 0.0
+
+
+def _burn_worker(k):
+    x = 0
+    for i in range(k):
+        x += i * i
+    return x
+
+
+def bench_sweep_parallel(n_candidates=160):
+    """Parallel consolidation sweep (ISSUE 3 acceptance): the same 160-
+    candidate sweep run three ways — legacy (serial, per-candidate cluster
+    rescans and table rebuilds: the pre-optimization shape), serial
+    (snapshot reuse + derived tables + encode caches, one worker), parallel
+    (explicit 2-thread worker pool) — asserting the chosen action is
+    IDENTICAL across all three. ``speedup_total`` is what this round of
+    optimizations did to sweep wall time; ``speedup_parallel`` is the
+    worker pool's share alone, bounded above by ``cpu_scaling`` (the
+    host's raw 2-process scaling — ~1.0 on a shared 1-2 core box, where
+    the auto worker count therefore stays serial)."""
+    results = {}
+    actions = {}
+    for mode, workers in (("legacy", 1), ("serial", 1), ("parallel", 2)):
+        deprov = _sweep_fixture(workers, n_candidates=n_candidates)
+        # warm: scipy/LP import, solver caches (off the clock)
+        deprov._sweep_capacity = deprov.cluster.existing_capacity()
+        deprov._sweep_pods = {e.node.name: list(e.pods) for e in deprov._sweep_capacity}
+        deprov._sweep_daemonsets = deprov.cluster.daemonsets()
+        deprov._try_single_node(deprov.cluster.nodes["cand-3"])
+        deprov._sweep_capacity = None
+        deprov._sweep_pods = None
+        deprov._sweep_daemonsets = None
+        if mode == "legacy":
+            # pre-optimization sweep shape: no snapshot views (the fallback
+            # branches rescan the cluster per candidate), serial scan
+            def legacy():
+                action = None
+                deprov._sweep_capacity = deprov.cluster.existing_capacity()
+                try:
+                    for node in sorted(
+                        deprov._consolidatable(), key=deprov._disruption_cost
+                    ):
+                        action = deprov._try_single_node(node)
+                        if action is not None:
+                            break
+                finally:
+                    deprov._sweep_capacity = None
+                return action
+
+            run = legacy
+        else:
+            run = deprov._consolidation
+        t0 = time.perf_counter()
+        action = run()
+        results[mode] = time.perf_counter() - t0
+        actions[mode] = (
+            (action.reason, tuple(action.nodes)) if action is not None else None
+        )
+        workers_used = deprov.sweep_workers
+    equal = actions["legacy"] == actions["serial"] == actions["parallel"]
+    try:
+        cpu_scaling = _cpu_scaling_probe()
+    except Exception:
+        cpu_scaling = None
+    # what a DEFAULT-configured controller runs on this host: the auto
+    # worker count picks parallel only where the cores exist to pay for it
+    from karpenter_tpu.parallel.hostpool import default_workers
+
+    auto = default_workers(0)
+    default_s = results["serial"] if auto <= 1 else results["parallel"]
+    return {
+        "candidates": n_candidates,
+        "workers_equivalence_leg": workers_used,
+        "workers_auto": auto,
+        "cpu_scaling": cpu_scaling,
+        "sweep_legacy_ms": round(results["legacy"] * 1e3, 1),
+        "sweep_serial_ms": round(results["serial"] * 1e3, 1),
+        "sweep_parallel_ms": round(results["parallel"] * 1e3, 1),
+        "speedup_parallel": round(results["serial"] / results["parallel"], 2)
+        if results["parallel"] > 0 else 0.0,
+        "speedup_total": round(results["legacy"] / default_s, 2)
+        if default_s > 0 else 0.0,
+        "chosen_action": actions["parallel"][0] if actions["parallel"] else None,
+        "actions_equal": bool(equal),
+    }
+
+
 def bench_consolidation(n_nodes=300, pods_per_node=3, max_passes=40):
     """Consolidation savings metric (BASELINE 'repack to minimize cost'):
     seed a deliberately fragmented, overpriced fleet — mid-size on-demand nodes
@@ -806,6 +1116,14 @@ def main():
         except Exception as e:  # a config failure shouldn't kill the whole bench
             details[name] = {"error": f"{type(e).__name__}: {e}"}
     try:
+        details["delta_reconcile"] = bench_delta_reconcile()
+    except Exception as e:
+        details["delta_reconcile"] = {"error": f"{type(e).__name__}: {e}"}
+    try:
+        details["consolidation_sweep"] = bench_sweep_parallel()
+    except Exception as e:
+        details["consolidation_sweep"] = {"error": f"{type(e).__name__}: {e}"}
+    try:
         details["consolidation"] = bench_consolidation()
     except Exception as e:
         details["consolidation"] = {"error": f"{type(e).__name__}: {e}"}
@@ -852,6 +1170,29 @@ def main():
         "details": details,
     }
     print(json.dumps(line))
+    # FINAL line: a compact machine-parseable summary. The detailed line
+    # above runs to tens of KB and log-tail truncation was leaving harness
+    # parsers with a mid-JSON fragment (BENCH_r03-r05 "parsed": null) — the
+    # last line of stdout is always this short, self-contained record.
+    delta = details.get("delta_reconcile", {})
+    sweep = details.get("consolidation_sweep", {})
+    summary = {
+        "metric": line["metric"],
+        "value": line["value"],
+        "unit": "ms",
+        "vs_baseline": line["vs_baseline"],
+        "efficiency_vs_lb": line["efficiency_vs_lb"],
+        "cold_solve_ms": line["cold_solve_ms"],
+        "delta_encode_speedup": delta.get("encode_speedup"),
+        "delta_encode_p50_ms": delta.get("encode_delta_p50_ms"),
+        "delta_cost_equal": delta.get("cost_equal"),
+        "delta_violations": delta.get("violations"),
+        "sweep_speedup_total": sweep.get("speedup_total"),
+        "sweep_speedup_parallel": sweep.get("speedup_parallel"),
+        "sweep_actions_equal": sweep.get("actions_equal"),
+        "summary": True,
+    }
+    print(json.dumps(summary))
 
 
 if __name__ == "__main__":

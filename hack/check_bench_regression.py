@@ -1,0 +1,89 @@
+"""Bench-regression gate for the incremental-reconcile hot path.
+
+Runs the two ISSUE-3 scenarios from bench.py at reduced scale and FAILS
+(exit 1) when either regresses past its floor:
+
+* ``delta_reconcile``: steady-state delta encode must stay >= MIN_SPEEDUP x
+  faster than a full re-encode (the acceptance bar is 5x at full 50k scale;
+  the gate floor is 3x so box noise can't flap the check), with digest- and
+  answer-level equivalence intact (zero violations, identical cost).
+* ``consolidation_sweep``: the parallel sweep's chosen action must be
+  IDENTICAL to the serial sweep's — any divergence is a correctness bug,
+  whatever the timing says.
+
+Usage:  python hack/check_bench_regression.py [--full]
+        (--full runs the acceptance-scale 50k/160 configuration)
+
+Wired into the test suite as a ``slow``-marked pytest
+(tests/test_bench_regression.py) so tier-1 stays fast.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+MIN_DELTA_SPEEDUP = 3.0
+
+
+def run_checks(full: bool = False) -> list:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import bench
+
+    failures = []
+    if full:
+        delta = bench.bench_delta_reconcile()
+        sweep = bench.bench_sweep_parallel()
+    else:
+        delta = bench.bench_delta_reconcile(n_pods=20_000, rounds=5, n_types=100)
+        sweep = bench.bench_sweep_parallel(n_candidates=24)
+    print(json.dumps({"delta_reconcile": delta, "consolidation_sweep": sweep}))
+
+    if delta.get("encode_speedup", 0.0) < MIN_DELTA_SPEEDUP:
+        failures.append(
+            f"delta_reconcile encode speedup {delta.get('encode_speedup')}x "
+            f"< floor {MIN_DELTA_SPEEDUP}x"
+        )
+    if not delta.get("digests_equal", False):
+        failures.append("delta-encoded problem diverged from full encode (digest)")
+    if not delta.get("cost_equal", False):
+        failures.append(
+            f"delta/full answers diverged: {delta.get('cost_per_hour_delta')} "
+            f"vs {delta.get('cost_per_hour_full')}"
+        )
+    if delta.get("violations", 1) != 0:
+        failures.append(f"delta_reconcile produced {delta.get('violations')} violations")
+    if delta.get("delta_rounds", 0) < delta.get("rounds", 1):
+        failures.append(
+            f"only {delta.get('delta_rounds')}/{delta.get('rounds')} rounds took "
+            "the delta path — the session is falling back to full encodes"
+        )
+    if not sweep.get("actions_equal", False):
+        failures.append(
+            "parallel consolidation sweep diverged from the serial action: "
+            f"{sweep.get('chosen_action')!r}"
+        )
+    return failures
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--full", action="store_true",
+                        help="acceptance-scale run (50k pods / 160 candidates)")
+    args = parser.parse_args()
+    failures = run_checks(full=args.full)
+    if failures:
+        for f in failures:
+            print(f"REGRESSION: {f}", file=sys.stderr)
+        return 1
+    print("bench regression gate: OK", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

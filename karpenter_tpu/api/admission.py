@@ -142,10 +142,10 @@ def validate_node_template(nt: NodeTemplate) -> None:
                 f" got {bdm.volume_size_gib}"
             )
     if nt.user_data is not None and nt.image_family == "bottlerocket":
-        import tomllib
+        from .. import _toml
 
         try:
-            tomllib.loads(nt.user_data)
+            _toml.loads(nt.user_data)
         except Exception as e:
             errs.append(f"spec.userData: bottlerocket userdata must be valid TOML ({e})")
     if errs:

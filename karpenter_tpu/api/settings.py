@@ -41,6 +41,16 @@ class Settings:
     rpc_retry_max_attempts: int = 4
     rpc_breaker_failure_threshold: int = 5
     insufficient_capacity_ttl: float = 180.0
+    # incremental encoding (solver/session.py EncodeSession): delta-encode
+    # steady-state reconciles from watch-event dirty sets, with a forced
+    # full encode every N delta rounds as an out-of-band-mutation backstop.
+    # encode_delta_enabled=false pins every encode to the full path.
+    encode_delta_enabled: bool = True
+    encode_full_resync_every: int = 64
+    # consolidation sweep worker pool: per-candidate what-if simulations fan
+    # out across this many threads (the LP/numpy host solves release the
+    # GIL). 0 sizes from the host's CPU count; 1 forces the serial sweep.
+    consolidation_sweep_workers: int = 0
 
     def validate(self) -> None:
         if not self.cluster_name:
@@ -59,6 +69,14 @@ class Settings:
             raise ValueError("rpcBreakerFailureThreshold must be >= 1")
         if self.insufficient_capacity_ttl < 0:
             raise ValueError("insufficientCapacityTTL must be >= 0")
+        if self.encode_full_resync_every < 0:
+            raise ValueError(
+                "encodeFullResyncEvery must be >= 0 (0 disables the periodic full encode)"
+            )
+        if self.consolidation_sweep_workers < 0:
+            raise ValueError(
+                "consolidationSweepWorkers must be >= 0 (0 = auto-size from CPU count)"
+            )
 
     # -- config system (reference: karpenter-global-settings ConfigMap,
     # settings.go:40-93; env/flag ingestion in the operator bootstrap) -------

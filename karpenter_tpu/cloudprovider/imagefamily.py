@@ -148,10 +148,10 @@ class BottlerocketFamily(ImageFamily):
     def user_data(self, ctx: BootstrapContext) -> str:
         settings: Dict[str, Dict] = {}
         if ctx.custom_user_data:
-            import tomllib
+            from .. import _toml
 
             try:
-                settings = tomllib.loads(ctx.custom_user_data)
+                settings = _toml.loads(ctx.custom_user_data)
             except Exception:
                 settings = {}
         k8s = settings.setdefault("settings", {}).setdefault("kubernetes", {})

@@ -24,6 +24,7 @@ option set. That solve is the second half of the BASELINE north star.
 
 from __future__ import annotations
 
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -103,11 +104,27 @@ class DeprovisioningController:
                 quality_sync=False,
             )
         # sweep solves attributed by winning backend (observability for the
-        # "which engine answered" question; surfaced by the benchmark)
+        # "which engine answered" question; surfaced by the benchmark).
+        # Guarded by _counts_lock: parallel sweep workers report here.
         self.sweep_backend_counts: Dict[str, int] = {}
+        self._counts_lock = threading.Lock()
+        # Parallel single-node sweep (ISSUE 3 tentpole): the per-candidate
+        # what-if simulations are independent reads of one snapshot, so they
+        # fan out across a bounded worker pool (parallel/hostpool.py) with
+        # per-worker solver clones — encode serializes on ENCODE_LOCK, the
+        # LP/numpy solve releases the GIL. first_hit() preserves the serial
+        # sweep's chosen action exactly (lowest-index hit wins).
+        from ..parallel.hostpool import default_workers
+
+        self.sweep_workers = default_workers(self.settings.consolidation_sweep_workers)
+        self._worker_solvers: Optional[List[tuple]] = None  # lazy clones
         self.pending_action: Optional[PlannedAction] = None
         # sweep-scoped existing-capacity snapshot (see _consolidation)
         self._sweep_capacity = None
+        # sweep-scoped bound-pod and daemonset views from the same snapshot:
+        # the serial sweep re-scanned the whole pod map once per candidate
+        self._sweep_pods: Optional[Dict[str, list]] = None
+        self._sweep_daemonsets: Optional[list] = None
         # Stabilization window (designs/consolidation.md:59-67): consolidation
         # waits until the node population has been quiet for the whole window.
         self._last_node_change = float("-inf")
@@ -256,20 +273,92 @@ class DeprovisioningController:
         # The whole sweep is a READ-ONLY what-if over one cluster snapshot
         # (the chosen action executes after), so the existing-capacity view is
         # computed once here instead of once per candidate simulation —
-        # rebuilding it was the dominant cost of a 200-node sweep.
+        # rebuilding it was the dominant cost of a 200-node sweep. The bound-
+        # pod view rides the same snapshot (ExistingNode.pods already excludes
+        # daemonsets, matching the per-candidate filter).
         self._sweep_capacity = self.cluster.existing_capacity()
+        self._sweep_pods = {e.node.name: list(e.pods) for e in self._sweep_capacity}
+        self._sweep_daemonsets = self.cluster.daemonsets()
         try:
             # multi-node first (2..N cheapest-to-disrupt prefix), then single
             multi = self._try_multi_node(candidates)
             if multi is not None:
                 return multi
-            for node in candidates:
-                action = self._try_single_node(node)
-                if action is not None:
-                    return action
-            return None
+            return self._single_node_sweep(candidates)
         finally:
             self._sweep_capacity = None
+            self._sweep_pods = None
+            self._sweep_daemonsets = None
+
+    def _single_node_sweep(self, candidates: List[Node]) -> Optional[PlannedAction]:
+        """Per-candidate simulations across the worker pool; identical chosen
+        action to the serial scan (first_hit returns the lowest-index hit)."""
+        from ..parallel.hostpool import first_hit
+
+        # Prime the encoder's full-roster requirement table HERE, after the
+        # multi-node prefix search: every single-node simulation's roster is
+        # the snapshot minus its candidate, so each sim DERIVES its table by
+        # column deletion instead of rebuilding it. Priming before the
+        # multi-node pass would be wasted — its k>=2-exclusion rosters can't
+        # derive and would overwrite the base with an underivable one.
+        from ..solver.encode import ENCODE_LOCK, _get_surface_table, _node_surface
+
+        with ENCODE_LOCK:
+            _get_surface_table([_node_surface(e.node) for e in self._sweep_capacity])
+
+        workers = min(self.sweep_workers, len(candidates))
+        solvers = self._sweep_solver_pool(workers) if workers > 1 else None
+        if solvers is None:
+            workers = 1
+        mode = "parallel" if workers > 1 else "serial"
+        t0 = time.monotonic()
+
+        def try_one(i: int, node: Node) -> Optional[PlannedAction]:
+            metrics.CONSOLIDATION_SWEEP_CANDIDATES.inc({"mode": mode})
+            pair = solvers[i % workers] if solvers is not None else None
+            return self._try_single_node(node, solvers=pair)
+
+        _, action = first_hit(try_one, candidates, workers)
+        metrics.CONSOLIDATION_SWEEP.observe(time.monotonic() - t0)
+        return action
+
+    def _sweep_solver_pool(self, workers: int) -> Optional[List[tuple]]:
+        """Per-worker (solver, quality_solver) clones — solve_pods is
+        single-threaded per Solver instance (intern slots, device caches), so
+        concurrent simulations each need their own. None when the configured
+        solver can't be cloned (custom injected solver): sweep stays serial."""
+        cached = self._worker_solvers
+        if cached is not None and len(cached) >= workers:
+            return cached[:workers]
+        try:
+            pool = [
+                (self._clone_solver(self.solver), self._clone_solver(self.quality_solver))
+                for _ in range(workers)
+            ]
+        except Exception:
+            return None
+        self._worker_solvers = pool
+        return pool
+
+    @staticmethod
+    def _clone_solver(s: Optional[Solver]) -> Optional[Solver]:
+        if s is None:
+            return None
+        if isinstance(s, TPUSolver):
+            return TPUSolver(
+                portfolio=s.portfolio,
+                seed=s.seed,
+                max_slots=s.max_slots,
+                latency_budget_s=s.latency_budget_s,
+                mesh=s.mesh,
+                auto_mesh=False,
+                warmup_spike_s=s.warmup_spike_s,
+                quality_race=s.quality_race,
+                quality_sync=s.quality_sync,
+            )
+        if isinstance(s, GreedySolver):
+            return GreedySolver()
+        return type(s)()  # a solver type with a zero-arg constructor
 
     def _consolidatable(self) -> List[Node]:
         out = []
@@ -309,15 +398,20 @@ class DeprovisioningController:
             cost *= remaining / prov.ttl_seconds_until_expired
         return cost
 
-    def _try_single_node(self, node: Node):
-        pods = [p for p in self.cluster.pods_on_node(node.name) if not p.is_daemonset]
+    def _try_single_node(self, node: Node, solvers: Optional[tuple] = None):
+        if self._sweep_pods is not None:
+            pods = self._sweep_pods.get(node.name, [])
+        else:
+            pods = [p for p in self.cluster.pods_on_node(node.name) if not p.is_daemonset]
         if not pods:
             return PlannedAction(
                 reason="consolidation-delete", nodes=[node.name],
                 savings=self._node_price(node),
             )
         price = self._node_price(node)
-        fits, replacements = self._simulate(pods, exclude=[node.name], price_ceiling=price)
+        fits, replacements = self._simulate(
+            pods, exclude=[node.name], price_ceiling=price, solvers=solvers
+        )
         if not fits:
             return None
         if not replacements:
@@ -415,6 +509,7 @@ class DeprovisioningController:
         exclude: Sequence[str],
         price_ceiling: Optional[float] = None,
         max_new: Optional[int] = 1,
+        solvers: Optional[tuple] = None,
     ) -> Tuple[bool, List[object]]:
         """Re-schedule simulation: can `pods` land on the remaining nodes, plus at
         most `max_new` new nodes (each strictly cheaper than `price_ceiling`, when
@@ -445,16 +540,27 @@ class DeprovisioningController:
             for prov in self.cluster.provisioners.values()
         ]
         pods = list(pods)
-        solver = self.solver
-        if self.quality_solver is not None and len(pods) >= self.quality_min_pods:
-            solver = self.quality_solver
+        base, quality = (
+            solvers if solvers is not None else (self.solver, self.quality_solver)
+        )
+        solver = base
+        if quality is not None and len(pods) >= self.quality_min_pods:
+            solver = quality
+        daemonsets = (
+            self._sweep_daemonsets
+            if self._sweep_daemonsets is not None
+            else self.cluster.daemonsets()
+        )
         result = solver.solve_pods(
-            pods, provisioners, existing=existing, daemonsets=self.cluster.daemonsets()
+            pods, provisioners, existing=existing, daemonsets=daemonsets
         )
         backend = {0.0: "greedy", 1.0: "kernel", 2.0: "host-lp", 3.0: "host-ffd"}.get(
             result.stats.get("backend"), "oracle"
         )
-        self.sweep_backend_counts[backend] = self.sweep_backend_counts.get(backend, 0) + 1
+        with self._counts_lock:
+            self.sweep_backend_counts[backend] = (
+                self.sweep_backend_counts.get(backend, 0) + 1
+            )
         over_ceiling = price_ceiling is not None and any(
             n.option.price >= price_ceiling - 1e-9 for n in result.new_nodes
         )
@@ -487,8 +593,7 @@ class DeprovisioningController:
                 filtered.append((prov, types))
             if dropped:
                 result = solver.solve_pods(
-                    pods, filtered, existing=existing,
-                    daemonsets=self.cluster.daemonsets(),
+                    pods, filtered, existing=existing, daemonsets=daemonsets,
                 )
                 over_ceiling = False
         if result.unschedulable:

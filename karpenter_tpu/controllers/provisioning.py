@@ -28,6 +28,7 @@ from ..api.settings import Settings
 from ..cloudprovider.interface import CloudProvider, CloudProviderError, InsufficientCapacityError
 from ..solver.encode import ExistingNode
 from ..solver.result import NewNodeSpec, SolveResult
+from ..solver.session import EncodeSession
 from ..solver.solver import Solver, TPUSolver
 from ..state.cluster import Cluster
 from ..utils import metrics
@@ -103,6 +104,13 @@ class ProvisioningController:
         # reconcile and stalling on the kit's loop-level backoff
         self.retry_policy = retry_policy_from_settings(self.settings)
         self._pending_seen: set = set()
+        # delta-aware encoder state: watch events below feed its dirty sets,
+        # so steady-state reconciles patch the previous round's encoding
+        # instead of re-walking the cluster (ARCHITECTURE.md "EncodeSession")
+        self.encode_session = EncodeSession(
+            full_resync_every=self.settings.encode_full_resync_every,
+            enabled=self.settings.encode_delta_enabled,
+        )
         cluster.watch(self._on_event)
 
     def _on_event(self, event: str, obj) -> None:
@@ -113,13 +121,24 @@ class ProvisioningController:
         # Only the TRANSITION into pending arms the window: status-only
         # MODIFIED heartbeats on an already-pending pod must not bump the
         # batch generation (that would void reset() and busy-loop reconciles).
+        if event == "RESYNCED":
+            # cache relist (HTTPCluster watch-gone recovery): individual
+            # events may have been skipped — incremental state is suspect
+            self.encode_session.mark_structural("relist")
+            return
         if not isinstance(obj, Pod) or obj.is_daemonset:
             return
         if event == "DELETED":
             self._pending_seen.discard(obj.name)
+            self.encode_session.pod_event("DELETED", obj)
             return
         if event in ("ADDED", "MODIFIED"):
-            if obj.is_pending():
+            # mirror pending_pods()' membership predicate exactly: the
+            # session's dirty set must track the same population the
+            # reconcile batch reads, or every round falls back to full
+            in_batch = obj.is_pending() and obj.meta.deletion_timestamp is None
+            self.encode_session.pod_event("ADDED" if in_batch else "DELETED", obj)
+            if in_batch:
                 if obj.name not in self._pending_seen:
                     self._pending_seen.add(obj.name)
                     self.batcher.note_arrival()
@@ -187,6 +206,7 @@ class ProvisioningController:
                 round_provs,
                 existing=self.cluster.existing_capacity(),
                 daemonsets=daemonsets,
+                session=self.encode_session,
             )
             if result.solve is None:
                 result.solve = solve

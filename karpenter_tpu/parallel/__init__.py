@@ -1,3 +1,11 @@
+from .hostpool import default_workers, first_hit
 from .mesh import PORTFOLIO_AXIS, make_mesh, round_up_portfolio, shard_portfolio
 
-__all__ = ["PORTFOLIO_AXIS", "make_mesh", "round_up_portfolio", "shard_portfolio"]
+__all__ = [
+    "PORTFOLIO_AXIS",
+    "default_workers",
+    "first_hit",
+    "make_mesh",
+    "round_up_portfolio",
+    "shard_portfolio",
+]

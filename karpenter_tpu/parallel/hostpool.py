@@ -1,0 +1,78 @@
+"""Host-side worker pool for embarrassingly-parallel controller work.
+
+``mesh.py`` distributes the solver portfolio across the DEVICE mesh; this
+module is its host analogue for work that is many independent CPU solves
+rather than one tensor program — the consolidation sweep's per-candidate
+what-if simulations. A thread pool avoids process-spawn and pickling costs
+and parallelizes whatever portions of a solve drop the GIL (large numpy
+kernels, BLAS-threaded LP builds); encode portions serialize on
+``solver.encode.ENCODE_LOCK`` and stay correct. CAVEAT, measured: this
+environment's scipy HiGHS holds the GIL for the whole solve, so on small
+simulations thread fan-out only pays off when the host has spare cores for
+the overlapping pure-numpy stages — ``default_workers`` therefore refuses
+to auto-parallelize cramped hosts, and the bench reports the machine's raw
+process-scaling headroom next to the sweep numbers.
+
+``first_hit`` preserves SERIAL SEMANTICS exactly: the returned hit is the
+lowest-index item whose function result is not None — the same item a
+serial first-match scan would have chosen — and evaluation stops within one
+chunk of the hit, so a hit near the front doesn't pay for the whole list.
+"""
+
+from __future__ import annotations
+
+import os
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, List, Optional, Sequence, Tuple, TypeVar
+
+T = TypeVar("T")
+R = TypeVar("R")
+
+
+def default_workers(setting: int = 0, cap: int = 8) -> int:
+    """Resolve a worker-count setting: 0 sizes from the host, anything else
+    is taken literally; 1 means serial. Auto mode only goes parallel with
+    >= 4 cores: thread fan-out of CPU-bound solves needs real core headroom
+    to beat GIL handoff costs, and on 1-2 core hosts it measurably LOSES —
+    operators who know their solve stack releases the GIL can force a count
+    explicitly."""
+    if setting > 0:
+        return setting
+    cpus = os.cpu_count() or 1
+    if cpus < 4:
+        return 1
+    return max(1, min(cap, cpus))
+
+
+def first_hit(
+    fn: Callable[[int, T], Optional[R]],
+    items: Sequence[T],
+    workers: int,
+) -> Tuple[Optional[int], Optional[R]]:
+    """Lowest-index ``(i, fn(i, item))`` with a non-None result, or
+    ``(None, None)``. ``fn`` receives (index, item) — the index selects a
+    per-worker resource (e.g. a solver clone) via ``index % workers``.
+
+    With ``workers <= 1`` this is a plain serial scan (no pool, no threads).
+    Otherwise items evaluate in index-ordered chunks of ``workers`` with a
+    barrier between chunks: results inside a chunk are examined in index
+    order, so the chosen hit is identical to the serial scan's; at most one
+    chunk of evaluations runs past the winning index.
+    """
+    if workers <= 1 or len(items) <= 1:
+        for i, item in enumerate(items):
+            out = fn(i, item)
+            if out is not None:
+                return i, out
+        return None, None
+    with ThreadPoolExecutor(max_workers=workers) as pool:
+        for base in range(0, len(items), workers):
+            chunk = items[base : base + workers]
+            results: List[Optional[R]] = list(
+                pool.map(lambda t: fn(t[0], t[1]),
+                         [(base + k, item) for k, item in enumerate(chunk)])
+            )
+            for k, out in enumerate(results):
+                if out is not None:
+                    return base + k, out
+    return None, None

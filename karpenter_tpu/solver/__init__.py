@@ -2,6 +2,7 @@ from .bounds import best_lower_bound, fractional_lower_bound, lp_lower_bound
 from .encode import EncodedProblem, ExistingNode, LaunchOption, PodGroup, build_options, encode, group_pods
 from .greedy import GreedyPacker
 from .result import NewNodeSpec, SolveResult
+from .session import EncodeSession
 from .solver import GreedySolver, Solver, TPUSolver, lower_bound
 from .validate import validate
 
@@ -12,6 +13,7 @@ __all__ = [
     "PodGroup",
     "build_options",
     "encode",
+    "EncodeSession",
     "group_pods",
     "GreedyPacker",
     "NewNodeSpec",

@@ -22,6 +22,7 @@ scalar caps interpreted inside the packing scan (see ``jax_solver.py``).
 
 from __future__ import annotations
 
+import threading
 from dataclasses import dataclass, field, fields as dataclass_fields
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -35,6 +36,15 @@ from ..api.taints import Taint, tolerates_all
 from ..cloudprovider.types import InstanceType
 
 BIG_CAP = 1 << 30  # "unlimited" per-node / per-zone count cap
+
+# Serializes every encode (full or delta) process-wide: the module's memo
+# caches (vocab codes, per-surface columns, option/table generations) are
+# mutated by table builds, and the parallel consolidation sweep runs
+# concurrent solve_pods calls whose encodes would otherwise race — two
+# threads minting the same vocab string different codes silently corrupts
+# compat masks. The solve itself (LP, FFD, kernel) runs OUTSIDE this lock,
+# so the sweep's numpy/scipy work still parallelizes.
+ENCODE_LOCK = threading.RLock()
 
 
 # ---------------------------------------------------------------------------
@@ -177,52 +187,54 @@ def _group_members(pods: Sequence[Pod]) -> List[List[Pod]]:
     return member_lists
 
 
+def derive_group(members: List[Pod]) -> PodGroup:
+    """One signature bucket -> PodGroup with the per-group placement caps
+    derived from the representative's spread/affinity constraints (members
+    are scheduling-identical, so any representative derives the same caps)."""
+    pod = members[0]
+    node_cap = BIG_CAP
+    zone_cap = BIG_CAP
+    zone_skew = 0
+    colocate = False
+    for c in pod.effective_spread():
+        if not c.selects(pod):
+            continue
+        if c.topology_key == wk.HOSTNAME:
+            # Conservative: capping each node at maxSkew keeps |max-min| <= skew
+            # for any node population (min can stay 0 on fresh nodes).
+            node_cap = min(node_cap, max(1, c.max_skew))
+        elif c.topology_key == wk.ZONE:
+            # TIGHTEST applicable skew: every constraint (hard and
+            # promoted-soft) is validated independently, so the quota must
+            # honor the strictest one, not the loosest
+            zone_skew = c.max_skew if zone_skew == 0 else min(zone_skew, c.max_skew)
+    for t in pod.affinity_terms:
+        if not t.selects(pod):
+            continue  # cross-group affinity handled only by the greedy fallback
+        if t.anti and t.topology_key == wk.HOSTNAME:
+            node_cap = min(node_cap, 1)
+        elif t.anti and t.topology_key == wk.ZONE:
+            # at most one pod of the group per zone
+            node_cap = min(node_cap, 1)
+            zone_cap = min(zone_cap, 1)
+        elif not t.anti and t.topology_key == wk.HOSTNAME:
+            colocate = True
+    return PodGroup(
+        pods=members,
+        requests=pod.requests,
+        terms=pod.scheduling_requirement_terms(),  # representative only
+        tolerations=tuple(pod.tolerations),
+        node_cap=node_cap,
+        zone_cap=zone_cap,
+        zone_skew=zone_skew,
+        colocate=colocate,
+    )
+
+
 def group_pods(pods: Sequence[Pod]) -> List[PodGroup]:
     """Deduplicate pods into scheduling-identical groups and derive the per-group
     placement caps from spread/affinity constraints."""
-    groups: List[PodGroup] = []
-    for members in _group_members(pods):
-        pod = members[0]
-        node_cap = BIG_CAP
-        zone_cap = BIG_CAP
-        zone_skew = 0
-        colocate = False
-        for c in pod.effective_spread():
-            if not c.selects(pod):
-                continue
-            if c.topology_key == wk.HOSTNAME:
-                # Conservative: capping each node at maxSkew keeps |max-min| <= skew
-                # for any node population (min can stay 0 on fresh nodes).
-                node_cap = min(node_cap, max(1, c.max_skew))
-            elif c.topology_key == wk.ZONE:
-                # TIGHTEST applicable skew: every constraint (hard and
-                # promoted-soft) is validated independently, so the quota must
-                # honor the strictest one, not the loosest
-                zone_skew = c.max_skew if zone_skew == 0 else min(zone_skew, c.max_skew)
-        for t in pod.affinity_terms:
-            if not t.selects(pod):
-                continue  # cross-group affinity handled only by the greedy fallback
-            if t.anti and t.topology_key == wk.HOSTNAME:
-                node_cap = min(node_cap, 1)
-            elif t.anti and t.topology_key == wk.ZONE:
-                # at most one pod of the group per zone
-                node_cap = min(node_cap, 1)
-                zone_cap = min(zone_cap, 1)
-            elif not t.anti and t.topology_key == wk.HOSTNAME:
-                colocate = True
-        groups.append(
-            PodGroup(
-                pods=members,
-                requests=pod.requests,
-                terms=pod.scheduling_requirement_terms(),  # representative only
-                tolerations=tuple(pod.tolerations),
-                node_cap=node_cap,
-                zone_cap=zone_cap,
-                zone_skew=zone_skew,
-                colocate=colocate,
-            )
-        )
-    return groups
+    return [derive_group(members) for members in _group_members(pods)]
 
 
 # ---------------------------------------------------------------------------
@@ -619,6 +631,21 @@ class _ReqTable:
             cplx[idx] = np.asarray(cplx_v, bool)
             self.keys[key] = (has, codes, nums, cplx)
 
+    def without_index(self, k: int) -> "_ReqTable":
+        """A new table over the same surfaces minus entry ``k`` — a handful
+        of np.delete column slices instead of a full rebuild. The
+        consolidation sweep evaluates N rosters that are each the full
+        fleet minus one candidate; deriving them from one full-roster table
+        removes the per-simulation rebuild from the encode hot path."""
+        t = _ReqTable.__new__(_ReqTable)
+        t.n = self.n - 1
+        t.surfaces = self.surfaces[:k] + self.surfaces[k + 1:]
+        t.keys = {
+            key: tuple(np.delete(a, k) for a in arrs)
+            for key, arrs in self.keys.items()
+        }
+        return t
+
     def eval_requirement(self, r: Requirement) -> np.ndarray:
         """ok[N]: can an entry's surface co-exist with requirement ``r``?"""
         entry = self.keys.get(r.key)
@@ -665,6 +692,7 @@ class _ReqTable:
 # ---------------------------------------------------------------------------
 
 _ex_table_cache: Dict[tuple, tuple] = {}  # surface-id roster -> (pins, table, gen)
+_ex_table_base: Optional[tuple] = None  # (pins, table, gen): last FULLY-built table
 
 
 def _get_surface_table(surfaces: Sequence[Requirements]) -> "_ReqTable":
@@ -675,7 +703,14 @@ def _get_surface_table(surfaces: Sequence[Requirements]) -> "_ReqTable":
     rebuilding; any add/remove/label-change produces a different key and
     rebuilds from the per-surface column memo (delta cost, not full re-derive).
     One-generation cache, like _options_cache: stale keys would pin dead
-    surface objects."""
+    surface objects.
+
+    A second BASE slot keeps the last fully-built table: a roster that is the
+    base minus exactly one entry (every consolidation-sweep simulation) is
+    DERIVED by column deletion instead of rebuilt — the base survives the
+    one-generation churn of the per-roster slot, so a 160-candidate sweep
+    builds one table and derives 160."""
+    global _ex_table_base
     key = tuple(map(id, surfaces))
     e = _ex_table_cache.get(key)
     if (
@@ -684,7 +719,25 @@ def _get_surface_table(surfaces: Sequence[Requirements]) -> "_ReqTable":
         and all(a is b for a, b in zip(e[0], surfaces))
     ):
         return e[1]
-    table = _ReqTable(surfaces)
+    table = None
+    base = _ex_table_base
+    if base is not None and base[2] == _VOCAB_GEN and len(base[0]) == len(surfaces) + 1:
+        pins = base[0]
+        missing = -1
+        j = 0
+        for i, p in enumerate(pins):
+            if j < len(surfaces) and p is surfaces[j]:
+                j += 1
+            elif missing < 0:
+                missing = i
+            else:
+                missing = -1  # more than one difference: no derivation
+                break
+        if missing >= 0 and j == len(surfaces):
+            table = base[1].without_index(missing)
+    if table is None:
+        table = _ReqTable(surfaces)
+        _ex_table_base = (list(surfaces), table, _VOCAB_GEN)
     _ex_table_cache.clear()
     _ex_table_cache[key] = (list(surfaces), table, _VOCAB_GEN)
     return table
@@ -791,24 +844,34 @@ def _vector(r: Resources, axes: Sequence[str], pods: float = 0.0) -> np.ndarray:
     return v
 
 
-def encode(
-    pods: Sequence[Pod],
-    provisioners: Sequence[Tuple[Provisioner, Sequence[InstanceType]]],
-    existing: Sequence[ExistingNode] = (),
-    daemonsets: Sequence[Pod] = (),
-    weight_degate: frozenset = frozenset(),
-) -> EncodedProblem:
-    # The ONLY vocab compaction boundary: every table built or reused inside
-    # one encode must share a code generation with the vocab that eval reads.
-    _maybe_compact_vocab()
-    groups = group_pods(pods)
-    options = build_options(provisioners, daemonsets)
+_opt_zone_set_cache: Dict[int, tuple] = {}  # id(options) -> (pin, zone set)
 
-    axes = _resource_axes(groups, options)
-    zones = sorted({o.zone for o in options} | {e.node.zone() for e in existing if e.node.zone()})
-    zone_index = {z: i for i, z in enumerate(zones)}
 
-    G, O, E, R = len(groups), len(options), len(existing), len(axes)
+def _option_zone_set(options: Sequence[LaunchOption]) -> set:
+    """Zone set of an option list, cached by list identity (the options
+    builder returns the same list object until inputs change; a steady-state
+    delta encode calls this every round)."""
+    e = _opt_zone_set_cache.get(id(options))
+    if e is not None and e[0] is options:
+        return e[1]
+    zones = {o.zone for o in options}
+    _opt_zone_set_cache.clear()
+    _opt_zone_set_cache[id(options)] = (options, zones)
+    return zones
+
+
+def zone_list(
+    options: Sequence[LaunchOption], existing: Sequence[ExistingNode]
+) -> List[str]:
+    return sorted(
+        _option_zone_set(options)
+        | {e.node.zone() for e in existing if e.node.zone()}
+    )
+
+
+def _group_arrays(groups: Sequence[PodGroup], axes: Sequence[str]):
+    """Per-group tensor rows (demand, count, topology caps)."""
+    G, R = len(groups), len(axes)
     demand = np.zeros((G, R), dtype=np.float64)
     count = np.zeros((G,), dtype=np.int32)
     node_cap = np.zeros((G,), dtype=np.int64)
@@ -822,7 +885,26 @@ def encode(
         zone_cap[i] = min(g.zone_cap, BIG_CAP)
         zone_skew[i] = g.zone_skew
         colocate[i] = g.colocate
+    return demand, count, node_cap, zone_cap, zone_skew, colocate
 
+
+_opt_array_cache: Dict[tuple, tuple] = {}  # (id(options), axes, zones) -> arrays
+
+
+def _option_arrays(
+    options: Sequence[LaunchOption], axes: Sequence[str], zone_index: Dict[str, int]
+):
+    """Per-option tensors (alloc/price/zone), cached by (option-list
+    identity, axes, zone order): a consolidation sweep encodes hundreds of
+    problems against the SAME cached option list, and this loop was ~1/3 of
+    each simulation's encode before the cache. Returned arrays are shared —
+    callers must not mutate them (encode stages treat them as inputs; the
+    only writes happen on the float32 copies _finalize makes)."""
+    key = (id(options), tuple(axes), tuple(sorted(zone_index, key=zone_index.get)))
+    e = _opt_array_cache.get(key)
+    if e is not None and e[0] is options:
+        return e[1]
+    O, R = len(options), len(axes)
     alloc = np.zeros((O, R), dtype=np.float64)
     price = np.zeros((O,), dtype=np.float64)
     opt_zone = np.zeros((O,), dtype=np.int32)
@@ -830,39 +912,74 @@ def encode(
         alloc[j] = _vector(o.allocatable, axes)
         price[j] = o.price
         opt_zone[j] = zone_index[o.zone]
+    _opt_array_cache.clear()
+    _opt_array_cache[key] = (options, (alloc, price, opt_zone))
+    return alloc, price, opt_zone
 
-    # -- compat masks, vectorized over the option/node axis ------------------
-    # taints come from the provisioner, so distinct taint tuples are few: one
-    # tolerates_all() call per (group, taint-set) instead of per (group, option)
-    opt_table = _get_option_table(options)
-    taint_groups: Dict[tuple, np.ndarray] = {}
+
+_opt_weight_cache: Dict[int, tuple] = {}  # id(options) -> (pin, weights)
+
+
+def _option_weights(options: Sequence[LaunchOption]) -> np.ndarray:
+    """Per-option provisioner weights, cached by list identity — the gate
+    reads them every encode and the list is identity-stable between option
+    rebuilds."""
+    e = _opt_weight_cache.get(id(options))
+    if e is not None and e[0] is options:
+        return e[1]
+    w = np.array([o.provisioner.weight for o in options], np.int64)
+    _opt_weight_cache.clear()
+    _opt_weight_cache[id(options)] = (options, w)
+    return w
+
+
+def _taint_index(options: Sequence[LaunchOption]) -> Dict[tuple, np.ndarray]:
+    """Option indices bucketed by taint tuple: taints come from the
+    provisioner, so distinct tuples are few — one tolerates_all() call per
+    (group, taint-set) instead of per (group, option)."""
+    taint_groups: Dict[tuple, list] = {}
     for j, o in enumerate(options):
         taint_groups.setdefault(o.taints, []).append(j)
-    taint_groups = {t: np.asarray(idx) for t, idx in taint_groups.items()}
+    return {t: np.asarray(idx) for t, idx in taint_groups.items()}
 
-    compat = np.zeros((G, O), dtype=bool)
-    for i, g in enumerate(groups):
-        if O == 0:
-            break
-        tol_ok = np.zeros(O, bool)
-        tols = list(g.tolerations)
-        for taints, idx in taint_groups.items():
-            if tolerates_all(tols, taints):
-                tol_ok[idx] = True
-        req_ok = opt_table.eval_terms(g.terms)
-        per_pod = _vector(g.requests, axes, pods=1.0)
-        cap_ok = ~np.any(per_pod[None, :] > alloc + 1e-9, axis=1)
-        compat[i] = tol_ok & req_ok & cap_ok
 
-    # Provisioner weight priority: when a group is compatible with options
-    # from provisioners of different weights, only the HIGHEST weight's
-    # options stay eligible — weights are a strict preference order (the
-    # reference tries provisioners highest-weight-first), not a tiebreak the
-    # price ordering may override. Existing-capacity reuse is not gated.
-    # ``weight_degate`` lists pods whose groups fall back to ALL weights —
-    # the controller's next-pool pass when the preferred pool cannot host
-    # them (limits exhausted, zone coverage too narrow for a spread).
-    opt_weight = np.array([o.provisioner.weight for o in options], np.int64)
+def _compat_row(
+    g: PodGroup,
+    opt_table: "_ReqTable",
+    taint_index: Dict[tuple, np.ndarray],
+    alloc: np.ndarray,
+    axes: Sequence[str],
+) -> np.ndarray:
+    """PRE-weight-gate compatibility of one group against every option."""
+    O = alloc.shape[0]
+    tol_ok = np.zeros(O, bool)
+    tols = list(g.tolerations)
+    for taints, idx in taint_index.items():
+        if tolerates_all(tols, taints):
+            tol_ok[idx] = True
+    req_ok = opt_table.eval_terms(g.terms)
+    per_pod = _vector(g.requests, axes, pods=1.0)
+    cap_ok = ~np.any(per_pod[None, :] > alloc + 1e-9, axis=1)
+    return tol_ok & req_ok & cap_ok
+
+
+def _apply_weight_gate(
+    groups: Sequence[PodGroup],
+    options: Sequence[LaunchOption],
+    compat: np.ndarray,
+    weight_degate: frozenset,
+) -> List[int]:
+    """Provisioner weight priority: when a group is compatible with options
+    from provisioners of different weights, only the HIGHEST weight's
+    options stay eligible — weights are a strict preference order (the
+    reference tries provisioners highest-weight-first), not a tiebreak the
+    price ordering may override. Existing-capacity reuse is not gated.
+    ``weight_degate`` lists pods whose groups fall back to ALL weights —
+    the controller's next-pool pass when the preferred pool cannot host
+    them (limits exhausted, zone coverage too narrow for a spread).
+    MUTATES compat rows; returns the indices of narrowed groups."""
+    O = len(options)
+    opt_weight = _option_weights(options)
     weight_gated_groups: List[int] = []
     if O and opt_weight.size and opt_weight.min() != opt_weight.max():
         for i, g in enumerate(groups):
@@ -876,50 +993,117 @@ def encode(
             if narrowed.sum() < row.sum():
                 weight_gated_groups.append(i)
             compat[i] = narrowed
+    return weight_gated_groups
 
+
+def _node_env(
+    existing: Sequence[ExistingNode],
+    provisioners: Sequence[Tuple[Provisioner, Sequence[InstanceType]]],
+):
+    """Per-node scheduling environment: (schedulable[E], effective taint
+    tuple per node). Startup taints are ignored in scheduling simulation
+    (the reference scheduler's taint filter, website concepts/scheduling.md
+    "startup taints"): a workload daemon strips them after bootstrap, so
+    treating them as permanent would exclude non-tolerating pods from this
+    capacity forever and drive perpetual scale-up."""
+    schedulable = np.array(
+        [
+            not e.node.unschedulable and e.node.meta.deletion_timestamp is None
+            for e in existing
+        ],
+        dtype=bool,
+    )
+    startup_by_prov: Dict[str, set] = {
+        p.name: {(t.key, t.value, t.effect) for t in p.startup_taints}
+        for p, _ in provisioners
+        if p.startup_taints
+    }
+    eff_taints: List[tuple] = []
+    for e in existing:
+        taints = tuple(e.node.taints)
+        startup = startup_by_prov.get(e.node.provisioner_name() or "")
+        if startup:
+            taints = tuple(
+                t for t in taints if (t.key, t.value, t.effect) not in startup
+            )
+        eff_taints.append(taints)
+    return schedulable, eff_taints
+
+
+def _existing_arrays(
+    groups: Sequence[PodGroup],
+    existing: Sequence[ExistingNode],
+    provisioners: Sequence[Tuple[Provisioner, Sequence[InstanceType]]],
+    zone_index: Dict[str, int],
+    axes: Sequence[str],
+    demand: np.ndarray,
+):
+    """PRE-topology-seed existing-capacity arrays (ex_rem, ex_zone, ex_compat)."""
+    G, E, R = len(groups), len(existing), len(axes)
     ex_rem = np.zeros((E, R), dtype=np.float64)
     ex_zone = np.zeros((E,), dtype=np.int32)
     ex_compat = np.zeros((G, E), dtype=bool)
-    if E:
-        for k, e in enumerate(existing):
-            ex_rem[k] = _vector(e.remaining, axes)
-            ex_zone[k] = zone_index.get(e.node.zone(), 0)
-        ex_table = _get_surface_table([_node_surface(e.node) for e in existing])
-        schedulable = np.array(
-            [
-                not e.node.unschedulable and e.node.meta.deletion_timestamp is None
-                for e in existing
-            ]
-        )
-        # Startup taints are ignored in scheduling simulation (the reference
-        # scheduler's taint filter, website concepts/scheduling.md "startup
-        # taints"): a workload daemon strips them after bootstrap, so treating
-        # them as permanent would exclude non-tolerating pods from this
-        # capacity forever and drive perpetual scale-up.
-        startup_by_prov: Dict[str, set] = {
-            p.name: {(t.key, t.value, t.effect) for t in p.startup_taints}
-            for p, _ in provisioners
-            if p.startup_taints
-        }
-        ex_taint_groups: Dict[tuple, list] = {}
-        for k, e in enumerate(existing):
-            taints = tuple(e.node.taints)
-            startup = startup_by_prov.get(e.node.provisioner_name() or "")
-            if startup:
-                taints = tuple(
-                    t for t in taints if (t.key, t.value, t.effect) not in startup
-                )
-            ex_taint_groups.setdefault(taints, []).append(k)
-        for i, g in enumerate(groups):
-            tol_ok = np.zeros(E, bool)
-            tols = list(g.tolerations)
-            for taints, idx in ex_taint_groups.items():
-                if tolerates_all(tols, taints):
-                    tol_ok[np.asarray(idx)] = True
-            req_ok = ex_table.eval_terms(g.terms)
-            cap_ok = ~np.any(demand[i][None, :] > ex_rem + 1e-9, axis=1)
-            ex_compat[i] = schedulable & tol_ok & req_ok & cap_ok
+    if not E:
+        return ex_rem, ex_zone, ex_compat
+    axes_t = tuple(axes)
+    for k, e in enumerate(existing):
+        # remaining-vector memo on the ExistingNode: a consolidation sweep
+        # encodes the SAME capacity snapshot objects across every candidate
+        # simulation, and re-deriving E vectors per sim was ~20% of its
+        # encode. Keyed by (axes, remaining identity) — a fresh reconcile
+        # builds fresh ExistingNodes, so staleness can't leak across rounds.
+        memo = e.__dict__.get("_rem_vec")
+        if memo is not None and memo[0] == axes_t and memo[1] is e.remaining:
+            ex_rem[k] = memo[2]
+        else:
+            row = _vector(e.remaining, axes)
+            e.__dict__["_rem_vec"] = (axes_t, e.remaining, row)
+            ex_rem[k] = row
+        ex_zone[k] = zone_index.get(e.node.zone(), 0)
+    ex_table = _get_surface_table([_node_surface(e.node) for e in existing])
+    schedulable, eff_taints = _node_env(existing, provisioners)
+    ex_taint_groups: Dict[tuple, list] = {}
+    for k, taints in enumerate(eff_taints):
+        ex_taint_groups.setdefault(taints, []).append(k)
+    for i, g in enumerate(groups):
+        tol_ok = np.zeros(E, bool)
+        tols = list(g.tolerations)
+        for taints, idx in ex_taint_groups.items():
+            if tolerates_all(tols, taints):
+                tol_ok[np.asarray(idx)] = True
+        req_ok = ex_table.eval_terms(g.terms)
+        cap_ok = ~np.any(demand[i][None, :] > ex_rem + 1e-9, axis=1)
+        ex_compat[i] = schedulable & tol_ok & req_ok & cap_ok
+    return ex_rem, ex_zone, ex_compat
 
+
+def _finalize(
+    groups: List[PodGroup],
+    options: List[LaunchOption],
+    existing: Sequence[ExistingNode],
+    axes: List[str],
+    zones: List[str],
+    zone_index: Dict[str, int],
+    demand: np.ndarray,
+    count: np.ndarray,
+    node_cap: np.ndarray,
+    zone_cap: np.ndarray,
+    zone_skew: np.ndarray,
+    colocate: np.ndarray,
+    alloc: np.ndarray,
+    price: np.ndarray,
+    opt_zone: np.ndarray,
+    compat: np.ndarray,
+    ex_rem: np.ndarray,
+    ex_zone: np.ndarray,
+    ex_compat: np.ndarray,
+    weight_degate: frozenset,
+) -> EncodedProblem:
+    """Shared tail of every encode, full or delta: weight gate, topology
+    seeds, cross-group relations, assembly. ``compat``/``ex_compat`` arrive
+    PRE-gate/PRE-seed and are mutated here — delta callers pass copies of
+    their cached arrays (the cached pre-state must survive the round)."""
+    weight_gated_groups = _apply_weight_gate(groups, options, compat, weight_degate)
     zone_seed, zone_occupied, seed_pods = _topology_seeds(
         groups, existing, zone_index, ex_compat, compat
     )
@@ -933,10 +1117,12 @@ def encode(
         resource_axes=axes,
         zones=zones,
         demand=demand.astype(np.float32),
-        count=count,
+        count=count.astype(np.int32),
         alloc=alloc.astype(np.float32),
         price=price.astype(np.float32),
-        opt_zone=opt_zone,
+        # copy: the cached option arrays are shared across encodes and the
+        # problem must own its tensors
+        opt_zone=opt_zone.copy(),
         compat=compat,
         node_cap=np.minimum(node_cap, BIG_CAP).astype(np.int32),
         zone_cap=np.minimum(zone_cap, BIG_CAP).astype(np.int32),
@@ -960,6 +1146,51 @@ def encode(
         rel_unsupported=relations[8],
         zone_spread_members=zone_spread_members,
     )
+
+
+def encode(
+    pods: Sequence[Pod],
+    provisioners: Sequence[Tuple[Provisioner, Sequence[InstanceType]]],
+    existing: Sequence[ExistingNode] = (),
+    daemonsets: Sequence[Pod] = (),
+    weight_degate: frozenset = frozenset(),
+) -> EncodedProblem:
+    with ENCODE_LOCK:
+        # The ONLY vocab compaction boundary: every table built or reused
+        # inside one encode must share a code generation with the vocab that
+        # eval reads.
+        _maybe_compact_vocab()
+        groups = group_pods(pods)
+        options = build_options(provisioners, daemonsets)
+
+        axes = _resource_axes(groups, options)
+        zones = zone_list(options, existing)
+        zone_index = {z: i for i, z in enumerate(zones)}
+
+        demand, count, node_cap, zone_cap, zone_skew, colocate = _group_arrays(
+            groups, axes
+        )
+        alloc, price, opt_zone = _option_arrays(options, axes, zone_index)
+
+        # -- compat masks, vectorized over the option/node axis --------------
+        opt_table = _get_option_table(options)
+        taint_index = _taint_index(options)
+        G, O = len(groups), len(options)
+        compat = np.zeros((G, O), dtype=bool)
+        if O:
+            for i, g in enumerate(groups):
+                compat[i] = _compat_row(g, opt_table, taint_index, alloc, axes)
+
+        ex_rem, ex_zone, ex_compat = _existing_arrays(
+            groups, existing, provisioners, zone_index, axes, demand
+        )
+
+        return _finalize(
+            groups, options, existing, axes, zones, zone_index,
+            demand, count, node_cap, zone_cap, zone_skew, colocate,
+            alloc, price, opt_zone, compat, ex_rem, ex_zone, ex_compat,
+            weight_degate,
+        )
 
 
 def equivalent_affinity_term(t, pod: Pod) -> bool:

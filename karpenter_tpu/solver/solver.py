@@ -405,16 +405,24 @@ class Solver(abc.ABC):
         provisioners: Sequence[Tuple[Provisioner, Sequence[InstanceType]]],
         existing: Sequence[ExistingNode] = (),
         daemonsets: Sequence[Pod] = (),
+        session=None,
     ) -> SolveResult:
+        """``session`` (an EncodeSession) makes the INITIAL encode delta-
+        aware: the session patches the previous round's arrays instead of
+        re-walking the cluster. The relaxation/degate re-encodes below stay
+        on the full path — they solve transient CLONES whose identities must
+        never enter the session's incremental state."""
         from ..utils.tracing import span
 
         t0 = time.perf_counter()
         encode_s = 0.0
         with span("solve", pods=len(pods)):
             with span("solve.encode"):
-                problem = self._intern_problem(
-                    encode(pods, provisioners, existing, daemonsets)
-                )
+                if session is not None:
+                    fresh = session.encode(pods, provisioners, existing, daemonsets)
+                else:
+                    fresh = encode(pods, provisioners, existing, daemonsets)
+                problem = self._intern_problem(fresh)
             encode_s += time.perf_counter() - t0
             # anchor the latency budget at ENTRY (before encode): the budget
             # is an end-to-end contract, so a fresh batch's encode time comes

@@ -78,6 +78,18 @@ class ClusterAPIServer:
             self._log_floor = 1
             self._seq = 1
         self._events_cv = threading.Condition()
+        # Highest resource version WRITTEN per kind — served by /version so
+        # clients can delta-relist: a watch-gone recovery only re-lists the
+        # kinds whose version moved since the client's last relist (the
+        # others provably saw no writes, so the client cache is current).
+        self._kind_versions: Dict[str, int] = {}
+        with self.backing._lock:
+            for kind, attr in _COLLECTIONS.items():
+                coll = getattr(self.backing, attr)
+                if coll:
+                    self._kind_versions[kind] = max(
+                        o.meta.resource_version for o in coll.values()
+                    )
         self._port = port
         self._server: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
@@ -90,8 +102,11 @@ class ClusterAPIServer:
             return
         with self._events_cv:
             self._seq += 1
+            version = obj.meta.resource_version
+            if version > self._kind_versions.get(kind, 0):
+                self._kind_versions[kind] = version
             self._events.append(
-                (self._seq, obj.meta.resource_version, event, kind, to_wire(obj))
+                (self._seq, version, event, kind, to_wire(obj))
             )
             if len(self._events) > 100_000:
                 # compaction: a client whose bookmark predates the log start
@@ -148,7 +163,16 @@ class ClusterAPIServer:
                     version = self.backing._version
                 with self._events_cv:
                     seq = self._seq
-                return 200, {"resourceVersion": version, "watchSeq": seq}
+                    kind_versions = dict(self._kind_versions)
+                # A committed-but-unrecorded write can lag kindVersions here;
+                # that is safe: its event seq exceeds the watchSeq returned in
+                # the same response, so a client skipping the kind still
+                # receives the write through its watch replay.
+                return 200, {
+                    "resourceVersion": version,
+                    "watchSeq": seq,
+                    "kindVersions": kind_versions,
+                }
             if not parts or parts[0] != "api" or len(parts) < 2:
                 return 404, {"error": f"unknown path {path}"}
             kind = parts[1]

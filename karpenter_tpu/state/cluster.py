@@ -31,7 +31,11 @@ from ..api.objects import (
 from ..api.resources import Resources, merge
 from ..solver.encode import ExistingNode
 
-WatchFn = Callable[[str, object], None]  # (event_type: ADDED|MODIFIED|DELETED, obj)
+# (event_type, obj): ADDED|MODIFIED|DELETED carry the object; RESYNCED
+# carries obj=None and means the cache was rebuilt wholesale (HTTPCluster
+# relist) — incremental consumers must treat their event-derived state as
+# suspect. Watchers MUST type-check obj rather than assume a kind.
+WatchFn = Callable[[str, object], None]
 
 
 @dataclass(frozen=True)

@@ -87,6 +87,10 @@ class HTTPCluster(Cluster):
         # replayed when the write completes (per-object version guard makes
         # the replay idempotent).
         self._inflight: Dict[tuple, list] = {}
+        # per-kind server version at the LAST relist: a recovery relist skips
+        # kinds whose server-side version hasn't moved since (no writes ->
+        # the local cache plus applied watch events is provably current)
+        self._kind_seen: Dict[str, int] = {}
         self._stop = threading.Event()
         self._watch_thread: Optional[threading.Thread] = None
         self.relist()
@@ -167,26 +171,52 @@ class HTTPCluster(Cluster):
 
     # -- informer cache ------------------------------------------------------
     def relist(self) -> None:
-        """Full list of every kind, replacing the cache (initial sync and
-        watch-gone recovery). The watch bookmark is the server version read
-        BEFORE the lists: writes landing between the per-kind lists replay as
-        watch events and the per-object version guard in ``_apply_wire``
-        makes the replay idempotent — a max-across-lists bookmark would skip
-        events for kinds listed early (review finding)."""
+        """List-and-replace sync (initial sync and watch-gone recovery),
+        DELTA-AWARE: the server's per-kind versions (``/version``
+        kindVersions) let a recovery skip every kind that saw no writes
+        since the last relist — a reconnect storm against a quiet cluster
+        then costs one /version round-trip, not six full lists. The watch
+        bookmark is the server version read BEFORE the lists: writes landing
+        between the per-kind lists replay as watch events and the per-object
+        version guard in ``_apply_wire`` makes the replay idempotent — a
+        max-across-lists bookmark would skip events for kinds listed early
+        (review finding). Ends by emitting a ``RESYNCED`` event (obj=None)
+        when anything was re-listed, so incremental consumers (the encoder's
+        dirty-set session) know individual events may have been skipped."""
         version_info = self._call("GET", "/version")
         bookmark = version_info.get("watchSeq", 0)
-        for kind, attr in _COLLECTION_ATTR.items():
-            out = self._call("GET", f"/api/{kind}")
-            decode = KINDS[kind][2]
+        kind_versions = version_info.get("kindVersions", None)
+        relisted = False
+        try:
+            for kind, attr in _COLLECTION_ATTR.items():
+                if kind_versions is not None:
+                    server_v = kind_versions.get(kind, 0)
+                    if self._kind_seen.get(kind) == server_v:
+                        continue  # no writes since our last list of this kind
+                out = self._call("GET", f"/api/{kind}")
+                decode = KINDS[kind][2]
+                relisted = True
+                with self._lock:
+                    coll = getattr(self, attr)
+                    coll.clear()
+                    for item in out["items"]:
+                        obj = decode(item)
+                        coll[obj.meta.name] = obj
+                    if kind_versions is not None:
+                        self._kind_seen[kind] = kind_versions.get(kind, 0)
             with self._lock:
-                coll = getattr(self, attr)
-                coll.clear()
-                for item in out["items"]:
-                    obj = decode(item)
-                    coll[obj.meta.name] = obj
-        with self._lock:
-            self._bookmark = bookmark
-            self._version = max(self._version, version_info.get("resourceVersion", 0))
+                self._bookmark = bookmark
+                self._version = max(
+                    self._version, version_info.get("resourceVersion", 0)
+                )
+        finally:
+            # in a finally: a PARTIAL relist (a later kind's list failed
+            # mid-loop) has already replaced earlier kinds' caches wholesale
+            # — incremental consumers must hear about it even though the
+            # relist will be retried, or their dirty-set state goes stale
+            # against the half-swapped cache
+            if relisted:
+                self._emit("RESYNCED", None)
 
     def _apply_wire(self, version: int, event: str, kind: str, wire: Dict) -> None:
         """Apply one remote event to the cache, idempotently, and fire the

@@ -319,7 +319,8 @@ DEPROVISIONING_ACTIONS = Counter(
 )
 CONSOLIDATION_SWEEP = Histogram(
     "karpenter_tpu_consolidation_sweep_seconds",
-    help="Multi-node consolidation sweep duration per pass.",
+    help="Consolidation sweep duration (the multi-node prefix search and the "
+         "single-node candidate scan each observe one sample per pass).",
     registry=REGISTRY,
 )
 CONSOLIDATION_SWEEP_TRUNCATED = Counter(
@@ -352,6 +353,26 @@ PATTERN_IMPROVEMENTS = Counter(
 PATTERN_SAVINGS = Counter(
     "karpenter_tpu_pattern_savings_dollars_total",
     help="Cumulative $/hr saved by pattern-generated plans over the baseline plan.",
+    registry=REGISTRY,
+)
+# incremental reconcile encoding (solver/session.py EncodeSession)
+ENCODE_MODE = Counter(
+    "karpenter_tpu_encode_mode_total",
+    help="Encodes by mode: delta (row/column patch of the previous round) "
+         "vs full (first encode, structural change, or fallback).",
+    registry=REGISTRY,
+)
+ENCODE_FULL_REASONS = Counter(
+    "karpenter_tpu_encode_full_reasons_total",
+    help="Why an EncodeSession round fell back to a full encode "
+         "(first-encode, axes-changed, zones-changed, pod-set-desync, "
+         "weight-degate, periodic-resync, relist, provisioner-change, ...).",
+    registry=REGISTRY,
+)
+CONSOLIDATION_SWEEP_CANDIDATES = Counter(
+    "karpenter_tpu_consolidation_sweep_candidates_total",
+    help="Single-node consolidation what-if simulations evaluated, labeled "
+         "by execution mode (serial/parallel).",
     registry=REGISTRY,
 )
 

@@ -14,3 +14,11 @@ if "xla_force_host_platform_device_count" not in xla_flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: out-of-band checks (bench regression gates) excluded from "
+        "tier-1 via -m 'not slow'",
+    )

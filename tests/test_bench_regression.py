@@ -1,0 +1,17 @@
+"""Slow-marked wrapper around hack/check_bench_regression.py: the bench
+regression gate runs under pytest (``-m slow``) without slowing tier-1."""
+
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "hack"))
+
+
+@pytest.mark.slow
+def test_bench_regression_gate():
+    from check_bench_regression import run_checks
+
+    failures = run_checks(full=False)
+    assert not failures, "; ".join(failures)

@@ -1,0 +1,418 @@
+"""EncodeSession delta-vs-full equivalence, native/python grouping parity,
+and the parallel consolidation sweep's serial-equivalence guarantee
+(ISSUE 3: incremental reconcile hot path)."""
+
+import dataclasses
+import random
+
+import numpy as np
+import pytest
+
+from karpenter_tpu.api import (
+    Node,
+    ObjectMeta,
+    Pod,
+    PodAffinityTerm,
+    Provisioner,
+    Resources,
+    Toleration,
+    TopologySpreadConstraint,
+)
+from karpenter_tpu.api import labels as wk
+from karpenter_tpu.cloudprovider import generate_catalog
+from karpenter_tpu.solver import EncodeSession, ExistingNode, encode
+from karpenter_tpu.solver.encode import _group_members, _signature
+from karpenter_tpu.solver.solver import _problems_content_equal, problem_digest
+
+from helpers import make_pod
+
+
+# ---------------------------------------------------------------------------
+# native / python encoder parity (fuzz)
+# ---------------------------------------------------------------------------
+
+def _random_pod(rng: random.Random, i: int) -> Pod:
+    """A pod sampled across the simple/complex signature split the native
+    encoder specializes on: most pods are plain requests(+labels), a tail
+    carries tolerations / spread / affinity / selectors that force the C
+    path's python-signature callback."""
+    cpu = rng.choice(["100m", "250m", "500m", "1", "2"])
+    mem = rng.choice(["128Mi", "512Mi", "1Gi", "2Gi"])
+    labels = {}
+    if rng.random() < 0.6:
+        labels["app"] = f"app{rng.randrange(4)}"
+    kw = {}
+    roll = rng.random()
+    if roll < 0.15:
+        kw["tolerations"] = [
+            Toleration(key="team", operator="Equal", value=f"t{rng.randrange(2)}")
+        ]
+    elif roll < 0.3:
+        kw["spread"] = [
+            TopologySpreadConstraint(
+                max_skew=1 + rng.randrange(2),
+                topology_key=wk.ZONE,
+                label_selector={"app": f"app{rng.randrange(4)}"},
+            )
+        ]
+    elif roll < 0.4:
+        kw["affinity"] = [
+            PodAffinityTerm(
+                label_selector={"app": f"app{rng.randrange(4)}"},
+                topology_key=wk.HOSTNAME,
+                anti=True,
+            )
+        ]
+    elif roll < 0.5:
+        kw["node_selector"] = {wk.ZONE: rng.choice(["zone-a", "zone-b", "zone-c"])}
+    return make_pod(name=f"fz-{i}", cpu=cpu, memory=mem, labels=labels, **kw)
+
+
+def _python_groups(pods):
+    """The pure-python reference bucketing (the fallback _group_members
+    loop), run standalone so the test controls which path computes."""
+    buckets, order = {}, []
+    for pod in pods:
+        sig = _signature(pod)
+        members = buckets.get(sig)
+        if members is None:
+            members = buckets[sig] = []
+            order.append(members)
+        members.append(pod)
+    return order
+
+
+@pytest.mark.parametrize("seed", range(5))
+def test_native_python_grouping_parity_fuzz(seed):
+    """native.group_pods and the pure-python path produce identical
+    groupings across the simple/complex signature split — the delta path
+    leans on cached ``_sched_sig`` from whichever path ran first, so the
+    two implementations must agree bucket for bucket."""
+    from karpenter_tpu.native import load_encoder
+
+    enc = load_encoder()
+    if enc is None:
+        pytest.skip("native encoder unavailable on this platform")
+    rng = random.Random(seed)
+    pods = [_random_pod(rng, i) for i in range(300)]
+    expected = [[p.meta.name for p in g] for g in _python_groups(pods)]
+    # drop the python-computed signature cache: the native path must derive
+    # its own signatures and still land in the same buckets
+    for p in pods:
+        p.__dict__.pop("_sched_sig", None)
+    got = [[p.meta.name for p in g] for g in enc.group_pods(pods, _signature)]
+    assert got == expected
+    # and the cached signatures interoperate: re-running python on the
+    # native-stamped pods reproduces the same buckets again
+    again = [[p.meta.name for p in g] for g in _group_members(pods)]
+    assert again == expected
+
+
+# ---------------------------------------------------------------------------
+# delta-vs-full equivalence (property test)
+# ---------------------------------------------------------------------------
+
+def _mk_node(i: int, it, version: int = 1) -> ExistingNode:
+    node = Node(
+        meta=ObjectMeta(
+            name=f"en-{i}",
+            labels={
+                **it.requirements.labels(),
+                wk.ZONE: ["zone-a", "zone-b", "zone-c"][i % 3],
+                wk.PROVISIONER_NAME: "default",
+                wk.INSTANCE_TYPE: it.name,
+            },
+        ),
+        capacity=it.capacity,
+        allocatable=it.allocatable(),
+        ready=True,
+    )
+    node.meta.resource_version = version
+    return ExistingNode(node=node, remaining=it.allocatable() * 0.5)
+
+
+class TestDeltaFullEquivalence:
+    SHAPES = [("100m", "128Mi"), ("250m", "512Mi"), ("1", "2Gi"), ("2", "4Gi")]
+
+    def _rand_pod(self, rng, serial):
+        cpu, mem = rng.choice(self.SHAPES)
+        return make_pod(name=f"pp-{serial}", cpu=cpu, memory=mem)
+
+    @pytest.mark.parametrize("seed", range(6))
+    def test_random_mutation_sequences(self, seed):
+        """ANY sequence of pod/node/offering mutations produces a
+        delta-encoded problem content-identical (digest AND field-level) to
+        a from-scratch encode() of the same inputs in the session's
+        canonical order."""
+        rng = random.Random(seed)
+        cat = generate_catalog(n_types=8)
+        prov = Provisioner(meta=ObjectMeta(name="default"))
+        prov.meta.resource_version = 1
+        types = list(cat)
+        nodes = [_mk_node(i, cat[i % len(cat)], version=i + 1) for i in range(6)]
+        serial = 0
+        pods = []
+        for _ in range(40):
+            serial += 1
+            pods.append(self._rand_pod(rng, serial))
+        session = EncodeSession(full_resync_every=0)
+        session.encode(pods, [(prov, types)], existing=nodes)
+        next_version = 100
+
+        for step in range(12):
+            op = rng.randrange(6)
+            if op == 0 and pods:  # delete a pod
+                victim = pods.pop(rng.randrange(len(pods)))
+                session.pod_event("DELETED", victim)
+            elif op == 1:  # add pods
+                for _ in range(rng.randrange(1, 4)):
+                    serial += 1
+                    p = self._rand_pod(rng, serial)
+                    pods.append(p)
+                    session.pod_event("ADDED", p)
+            elif op == 2 and pods:  # modify a pod (signature change)
+                i = rng.randrange(len(pods))
+                cpu, mem = rng.choice(self.SHAPES)
+                newp = dataclasses.replace(
+                    pods[i], requests=Resources(cpu=cpu, memory=mem)
+                )
+                pods[i] = newp
+                session.pod_event("MODIFIED", newp)
+            elif op == 3 and len(nodes) > 1:  # remove a node
+                nodes.pop(rng.randrange(len(nodes)))
+            elif op == 4:  # add a node / change a node's remaining
+                if rng.random() < 0.5:
+                    next_version += 1
+                    nodes.append(_mk_node(50 + step, cat[step % len(cat)], next_version))
+                elif nodes:
+                    k = rng.randrange(len(nodes))
+                    nodes[k] = dataclasses.replace(
+                        nodes[k], remaining=nodes[k].remaining * 0.7
+                    )
+            else:  # offering availability flip (the ICE-mask path)
+                ti = rng.randrange(len(types))
+                it = types[ti]
+                oi = rng.randrange(len(it.offerings))
+                flipped = [
+                    dataclasses.replace(o, available=not o.available)
+                    if k == oi else o
+                    for k, o in enumerate(it.offerings)
+                ]
+                types[ti] = it.with_offerings(flipped)
+            delta = session.encode(pods, [(prov, list(types))], existing=list(nodes))
+            oracle = encode(
+                session.ordered_pods(), [(prov, list(types))], existing=list(nodes)
+            )
+            assert problem_digest(delta) == problem_digest(oracle), (
+                f"seed={seed} step={step} op={op} mode={session.last_mode} "
+                f"reason={session.last_full_reason}"
+            )
+            assert _problems_content_equal(delta, oracle)
+
+    def test_delta_actually_engages(self):
+        """Guard against the session silently falling back to full every
+        round (the equivalence test would still pass): steady pod churn on
+        an unchanged catalog must take the delta path."""
+        cat = generate_catalog(n_types=8)
+        prov = Provisioner(meta=ObjectMeta(name="default"))
+        pods = [make_pod(name=f"de-{i}", cpu="250m") for i in range(50)]
+        session = EncodeSession()
+        session.encode(pods, [(prov, cat)])
+        assert session.last_mode == "full"
+        session.pod_event("DELETED", pods[0])
+        extra = make_pod(name="de-extra", cpu="1")
+        session.pod_event("ADDED", extra)
+        session.encode(pods[1:] + [extra], [(prov, cat)])
+        assert session.last_mode == "delta"
+        assert session.stats["delta"] == 1
+
+    def test_weight_gate_equivalence(self):
+        """Two pools with different weights exercise the weight gate, which
+        runs fresh on every delta encode over the cached pre-gate rows."""
+        cat = generate_catalog(n_types=6)
+        hi = Provisioner(meta=ObjectMeta(name="hi"), weight=10)
+        lo = Provisioner(meta=ObjectMeta(name="lo"), weight=1)
+        provs = [(hi, cat), (lo, cat)]
+        pods = [make_pod(name=f"wg-{i}", cpu="250m") for i in range(20)]
+        session = EncodeSession()
+        session.encode(pods, provs)
+        session.pod_event("DELETED", pods[0])
+        delta = session.encode(pods[1:], provs)
+        assert session.last_mode == "delta"
+        oracle = encode(session.ordered_pods(), provs)
+        assert problem_digest(delta) == problem_digest(oracle)
+        assert delta.weight_gated_groups == oracle.weight_gated_groups
+
+    def test_desync_falls_back_to_full(self):
+        """A pod set the session was never told about (missed events) must
+        not be silently delta-encoded: the cardinality check forces full."""
+        cat = generate_catalog(n_types=6)
+        prov = Provisioner(meta=ObjectMeta(name="default"))
+        pods = [make_pod(name=f"ds-{i}") for i in range(10)]
+        session = EncodeSession()
+        session.encode(pods, [(prov, cat)])
+        sneaky = pods + [make_pod(name="ds-sneaky")]  # no event fed
+        problem = session.encode(sneaky, [(prov, cat)])
+        assert session.last_mode == "full"
+        assert session.last_full_reason == "pod-set-desync"
+        assert problem.count.sum() == len(sneaky)
+
+    def test_structural_mark_forces_full(self):
+        cat = generate_catalog(n_types=6)
+        prov = Provisioner(meta=ObjectMeta(name="default"))
+        pods = [make_pod(name=f"st-{i}") for i in range(5)]
+        session = EncodeSession()
+        session.encode(pods, [(prov, cat)])
+        session.mark_structural("relist")
+        session.encode(pods, [(prov, cat)])
+        assert session.last_mode == "full"
+        assert session.last_full_reason == "relist"
+        session.encode(pods, [(prov, cat)])
+        assert session.last_mode == "delta"
+
+
+# ---------------------------------------------------------------------------
+# controller wiring
+# ---------------------------------------------------------------------------
+
+class TestControllerSession:
+    def test_reconcile_uses_delta_on_second_round(self):
+        from karpenter_tpu.api.settings import Settings
+        from karpenter_tpu.cloudprovider import FakeCloudProvider
+        from karpenter_tpu.controllers.provisioning import ProvisioningController
+        from karpenter_tpu.state import Cluster
+
+        cluster = Cluster()
+        provider = FakeCloudProvider(catalog=generate_catalog(n_types=10))
+        controller = ProvisioningController(
+            cluster, provider,
+            settings=Settings(batch_idle_duration=0, batch_max_duration=0),
+        )
+        cluster.add_provisioner(Provisioner(meta=ObjectMeta(name="default")))
+        for i in range(6):
+            cluster.add_pod(make_pod(name=f"cs-{i}", cpu="250m"))
+        result = controller.reconcile()
+        assert not result.unschedulable
+        assert controller.encode_session.last_mode == "full"
+        # a new pod arrives; binds from round 1 flowed through the watch as
+        # leave-events, so round 2 is an incremental encode
+        cluster.add_pod(make_pod(name="cs-late", cpu="500m"))
+        result = controller.reconcile()
+        assert not result.unschedulable
+        assert controller.encode_session.last_mode == "delta"
+
+    def test_resynced_event_forces_full(self):
+        from karpenter_tpu.api.settings import Settings
+        from karpenter_tpu.cloudprovider import FakeCloudProvider
+        from karpenter_tpu.controllers.provisioning import ProvisioningController
+        from karpenter_tpu.state import Cluster
+
+        cluster = Cluster()
+        provider = FakeCloudProvider(catalog=generate_catalog(n_types=10))
+        controller = ProvisioningController(
+            cluster, provider,
+            settings=Settings(batch_idle_duration=0, batch_max_duration=0),
+        )
+        cluster.add_provisioner(Provisioner(meta=ObjectMeta(name="default")))
+        cluster.add_pod(make_pod(name="rs-0"))
+        controller.reconcile()
+        controller._on_event("RESYNCED", None)
+        cluster.add_pod(make_pod(name="rs-1"))
+        controller.reconcile()
+        assert controller.encode_session.last_mode == "full"
+        assert controller.encode_session.last_full_reason == "relist"
+
+
+# ---------------------------------------------------------------------------
+# parallel sweep: serial equivalence
+# ---------------------------------------------------------------------------
+
+class TestParallelSweep:
+    def test_first_hit_matches_serial_scan(self):
+        from karpenter_tpu.parallel.hostpool import first_hit
+
+        items = list(range(23))
+        calls = []
+
+        def fn(i, item):
+            calls.append(i)
+            return item if item in (7, 11, 19) else None
+
+        idx, out = first_hit(fn, items, workers=4)
+        assert (idx, out) == (7, 7)
+        # bounded overshoot: nothing past the chunk containing the hit ran
+        assert max(calls) < 8 + 4
+        idx, out = first_hit(lambda i, x: None, items, workers=4)
+        assert (idx, out) == (None, None)
+
+    def _build_sweep_cluster(self, workers):
+        from karpenter_tpu.api import Machine, Requirement, Requirements
+        from karpenter_tpu.api.settings import Settings
+        from karpenter_tpu.cloudprovider import FakeCloudProvider
+        from karpenter_tpu.controllers.deprovisioning import DeprovisioningController
+        from karpenter_tpu.controllers.provisioning import register_node
+        from karpenter_tpu.controllers.termination import TerminationController
+        from karpenter_tpu.state import Cluster
+        from karpenter_tpu.utils.cache import FakeClock
+
+        provider = FakeCloudProvider(catalog=generate_catalog(n_types=20))
+        cluster = Cluster()
+        settings = Settings(
+            batch_idle_duration=0, batch_max_duration=0,
+            consolidation_validation_ttl=0, stabilization_window=0,
+            consolidation_timeout=0, consolidation_sweep_workers=workers,
+        )
+        clock = FakeClock(start=100_000.0)
+        prov = Provisioner(meta=ObjectMeta(name="default"), consolidation_enabled=True)
+        cluster.add_provisioner(prov)
+        term = TerminationController(cluster, provider, clock=clock)
+        deprov = DeprovisioningController(
+            cluster, provider, term, settings=settings, clock=clock,
+        )  # default GreedySolver: fully deterministic across workers
+        mids = sorted(
+            [it for it in provider.catalog if 8 <= it.capacity["cpu"] <= 20],
+            key=lambda t: t.name,
+        )
+
+        def mknode(i, it, ct):
+            machine = Machine(
+                meta=ObjectMeta(name=f"sw-{i}", labels=dict(prov.labels)),
+                provisioner_name=prov.name,
+                requirements=Requirements([
+                    Requirement.in_values(wk.INSTANCE_TYPE, [it.name]),
+                    Requirement.in_values(wk.ZONE, ["zone-a"]),
+                    Requirement.in_values(wk.CAPACITY_TYPE, [ct]),
+                ]),
+                requests=Resources(cpu="1"),
+            )
+            machine = provider.create(machine)
+            cluster.add_machine(machine)
+            return register_node(cluster, machine, prov)
+
+        # spot candidates whose pods need a (cheap) replacement -> no action
+        for i in range(8):
+            node = mknode(i, mids[2], wk.CAPACITY_TYPE_SPOT)
+            for j in range(4):
+                pod = make_pod(name=f"swp-{i}-{j}", cpu="2", memory="2Gi")
+                cluster.add_pod(pod)
+                cluster.bind_pod(pod.name, node.name)
+        # one on-demand node whose pods drain into a half-empty sibling
+        sink = mknode(100, mids[-1], wk.CAPACITY_TYPE_ON_DEMAND)
+        sink.meta.annotations[wk.DO_NOT_CONSOLIDATE_ANNOTATION] = "true"
+        cluster.update(sink)
+        winner = mknode(200, mids[0], wk.CAPACITY_TYPE_ON_DEMAND)
+        for j in range(5):
+            pod = make_pod(name=f"swt-{j}", cpu="100m", memory="64Mi")
+            cluster.add_pod(pod)
+            cluster.bind_pod(pod.name, winner.name)
+        return deprov
+
+    def test_parallel_sweep_chooses_serial_action(self):
+        serial = self._build_sweep_cluster(workers=1)
+        parallel = self._build_sweep_cluster(workers=3)
+        a1 = serial._consolidation()
+        a2 = parallel._consolidation()
+        assert parallel.sweep_workers == 3
+        assert a1 is not None and a2 is not None
+        assert (a1.reason, a1.nodes) == (a2.reason, a2.nodes)
+        assert abs(a1.savings - a2.savings) < 1e-9
