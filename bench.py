@@ -976,6 +976,73 @@ def bench_rpc_overhead(repeats=10, n_pods=300):
     }
 
 
+def bench_decision_overhead(repeats=10, n_pods=300):
+    """Decision-audit + trace-propagation overhead guard: a full provisioning
+    round (solve + launch + bind) with the decision ring recording vs.
+    disabled, no faults. The ring rides every placement/nomination on the hot
+    path, so ``decision_overhead_pct`` must stay under the 5% budget
+    (``within_budget``); ``per_record_us`` is the deterministic cost of one
+    record() call (the direct number to watch for creep)."""
+    import statistics as _st
+
+    from karpenter_tpu.api import ObjectMeta, Pod, Provisioner, Resources
+    from karpenter_tpu.api.settings import Settings
+    from karpenter_tpu.cloudprovider import FakeCloudProvider, generate_catalog
+    from karpenter_tpu.controllers.provisioning import ProvisioningController
+    from karpenter_tpu.state import Cluster
+    from karpenter_tpu.utils.decisions import DECISIONS
+
+    def one_round(decisions_on: bool) -> float:
+        DECISIONS.configure(2048 if decisions_on else 0)
+        DECISIONS.clear()
+        cluster = Cluster()
+        provider = FakeCloudProvider(catalog=generate_catalog(n_types=60))
+        controller = ProvisioningController(
+            cluster, provider,
+            settings=Settings(batch_idle_duration=0, batch_max_duration=0),
+        )
+        cluster.add_provisioner(Provisioner(meta=ObjectMeta(name="default")))
+        for i in range(n_pods):
+            cluster.add_pod(
+                Pod(meta=ObjectMeta(name=f"dec-{i}"),
+                    requests=Resources(cpu="250m", memory="512Mi"))
+            )
+        t0 = time.perf_counter()
+        controller.reconcile()
+        return time.perf_counter() - t0
+
+    on_times, off_times = [], []
+    try:
+        # interleaved ABBA batches, like the other overhead guards: run-to-run
+        # drift dwarfs the per-record cost in a two-phase design
+        for flip in (False, True, True, False) * (repeats // 2):
+            (on_times if flip else off_times).append(one_round(flip))
+    finally:
+        DECISIONS.configure(2048)
+    on_p50, off_p50 = _st.median(on_times), _st.median(off_times)
+
+    # deterministic per-record cost
+    for _ in range(200):  # warm the metric series + ring
+        DECISIONS.record("placement", "bench", pod="warm")
+    n = 5000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        DECISIONS.record("placement", "bench", pod="warm")
+    per_record_s = (time.perf_counter() - t0) / n
+    DECISIONS.clear()
+
+    overhead_pct = 100.0 * (on_p50 - off_p50) / off_p50 if off_p50 > 0 else 0.0
+    return {
+        "pods": n_pods,
+        "round_p50_ms_decisions_on": round(on_p50 * 1e3, 3),
+        "round_p50_ms_decisions_off": round(off_p50 * 1e3, 3),
+        "decision_overhead_ms": round((on_p50 - off_p50) * 1e3, 3),
+        "decision_overhead_pct": round(overhead_pct, 2),
+        "per_record_us": round(per_record_s * 1e6, 2),
+        "within_budget": bool(overhead_pct < 5.0),
+    }
+
+
 def bench_config(name, make, repeats=REPEATS):
     from karpenter_tpu.solver import TPUSolver, best_lower_bound, encode, validate
 
@@ -1148,6 +1215,10 @@ def main():
     except Exception as e:
         details["rpc_overhead"] = {"error": f"{type(e).__name__}: {e}"}
     try:
+        details["decision_overhead"] = bench_decision_overhead()
+    except Exception as e:
+        details["decision_overhead"] = {"error": f"{type(e).__name__}: {e}"}
+    try:
         from karpenter_tpu.solver.solver import TPUSolver as _S
 
         rtt = _S.device_rtt()
@@ -1176,6 +1247,7 @@ def main():
     # last line of stdout is always this short, self-contained record.
     delta = details.get("delta_reconcile", {})
     sweep = details.get("consolidation_sweep", {})
+    decisions = details.get("decision_overhead", {})
     summary = {
         "metric": line["metric"],
         "value": line["value"],
@@ -1190,6 +1262,8 @@ def main():
         "sweep_speedup_total": sweep.get("speedup_total"),
         "sweep_speedup_parallel": sweep.get("speedup_parallel"),
         "sweep_actions_equal": sweep.get("actions_equal"),
+        "decision_overhead_pct": decisions.get("decision_overhead_pct"),
+        "decision_within_budget": decisions.get("within_budget"),
         "summary": True,
     }
     print(json.dumps(summary))

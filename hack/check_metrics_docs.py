@@ -1,0 +1,86 @@
+"""Metrics/doc drift gate — ``hack/docs`` verification for the metric catalog.
+
+Checks, in BOTH directions, that the metric catalog in
+``karpenter_tpu/utils/metrics.py`` and the generated reference
+``docs/metrics.md`` agree:
+
+* every cataloged metric has a non-empty HELP string (a bare name on
+  ``/metrics`` is useless to an operator reading the exposition);
+* every cataloged metric has a row in ``docs/metrics.md``;
+* every row in ``docs/metrics.md`` names a metric that still exists (a
+  deleted metric must take its doc row with it).
+
+Wired as a tier-1 test (``tests/test_metrics_docs.py``) so drift fails CI,
+and runnable standalone::
+
+    python hack/check_metrics_docs.py   # exits 1 and prints the drift
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+from typing import Dict, List
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+DOC = os.path.join(ROOT, "docs", "metrics.md")
+
+_ROW = re.compile(r"^\|\s*`([a-zA-Z0-9_:]+)`\s*\|")
+
+
+def cataloged_metrics() -> Dict[str, str]:
+    """{metric name: help} for every Counter/Gauge/Histogram in the catalog
+    module (the same scan hack/gen_docs.py renders the reference from)."""
+    from karpenter_tpu.utils import metrics as m
+
+    out: Dict[str, str] = {}
+    for attr in dir(m):
+        obj = getattr(m, attr)
+        if type(obj).__name__ in ("Counter", "Gauge", "Histogram"):
+            out[obj.name] = getattr(obj, "help", "") or ""
+    return out
+
+
+def documented_metrics(path: str = DOC) -> List[str]:
+    if not os.path.exists(path):
+        return []
+    with open(path) as f:
+        return [m.group(1) for line in f if (m := _ROW.match(line.strip()))]
+
+
+def check() -> List[str]:
+    """Every drift problem as a human-readable line; empty means clean."""
+    catalog = cataloged_metrics()
+    documented = documented_metrics()
+    problems = []
+    for name, help_text in sorted(catalog.items()):
+        if not help_text.strip():
+            problems.append(f"metric {name} has no HELP string")
+        if name not in documented:
+            problems.append(
+                f"metric {name} missing from docs/metrics.md "
+                "(run python hack/gen_docs.py)"
+            )
+    for name in documented:
+        if name not in catalog:
+            problems.append(
+                f"docs/metrics.md documents {name} which no longer exists "
+                "(run python hack/gen_docs.py)"
+            )
+    return problems
+
+
+def main() -> int:
+    problems = check()
+    for p in problems:
+        print(f"DRIFT: {p}", file=sys.stderr)
+    if problems:
+        return 1
+    print(f"metrics docs current: {len(cataloged_metrics())} metrics checked")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -51,6 +51,9 @@ class Settings:
     # out across this many threads (the LP/numpy host solves release the
     # GIL). 0 sizes from the host's CPU count; 1 forces the serial sweep.
     consolidation_sweep_workers: int = 0
+    # scheduling-decision audit ring (utils/decisions.py, /debug/decisions):
+    # most-recent records retained; 0 disables decision recording entirely
+    decision_log_capacity: int = 2048
 
     def validate(self) -> None:
         if not self.cluster_name:
@@ -76,6 +79,10 @@ class Settings:
         if self.consolidation_sweep_workers < 0:
             raise ValueError(
                 "consolidationSweepWorkers must be >= 0 (0 = auto-size from CPU count)"
+            )
+        if self.decision_log_capacity < 0:
+            raise ValueError(
+                "decisionLogCapacity must be >= 0 (0 disables decision recording)"
             )
 
     # -- config system (reference: karpenter-global-settings ConfigMap,

@@ -39,8 +39,10 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 from ..api import labels as wk
 from ..api.objects import Machine, MachineStatus, ObjectMeta, Provisioner
+from ..utils import tracing
 from ..utils.cache import UnavailableOfferings
 from ..utils.faults import FaultPlan
+from ..utils.logging import context_fields
 from ..utils.resilience import (
     BreakerSet,
     CircuitOpenError,
@@ -456,7 +458,22 @@ class CloudHTTPService:
 
         class Handler(BaseHTTPRequestHandler):
             def _respond(self, body: Optional[Dict]) -> None:
-                status, out = service.handle(self.path.split("?", 1)[0], body)
+                path = self.path.split("?", 1)[0]
+                # server span adopting the caller's trace context: the cloud
+                # side of a launch joins the reconcile's trace by trace id,
+                # carrying the originating reconcile_id
+                attrs = {}
+                reconcile_id = self.headers.get("x-karpenter-reconcile-id")
+                if reconcile_id:
+                    attrs["reconcile_id"] = reconcile_id
+                with tracing.TRACER.server_span(
+                    f"cloud.{self.command} {path}",
+                    traceparent=self.headers.get("traceparent"),
+                    **attrs,
+                ) as span:
+                    status, out = service.handle(path, body)
+                    if span is not None:
+                        span.attrs["status"] = status
                 payload = json.dumps(out).encode()
                 self.send_response(status)
                 self.send_header("Content-Type", "application/json")
@@ -546,6 +563,14 @@ class HTTPCloudProvider(WindowedBatchers, CloudProvider):
                 data=json.dumps(body).encode(),
                 headers={"Content-Type": "application/json"},
             )
+        # trace propagation: the cloud service opens a server span in the
+        # SAME trace (traceparent), stamped with the originating reconcile id
+        traceparent = tracing.current_traceparent()
+        if traceparent:
+            req.add_header("traceparent", traceparent)
+        reconcile_id = context_fields().get("reconcile_id")
+        if reconcile_id:
+            req.add_header("x-karpenter-reconcile-id", str(reconcile_id))
         timeout = self.retry_policy.attempt_timeout_s or self.timeout_s
         with urllib.request.urlopen(req, timeout=timeout) as r:
             return json.loads(r.read())
@@ -556,13 +581,17 @@ class HTTPCloudProvider(WindowedBatchers, CloudProvider):
         Terminal failures and exhausted retries surface as CloudProviderError
         so callers keep one exception seam."""
         try:
-            return resilient_call(
-                lambda: self._transport(path, body),
-                policy=self.retry_policy,
-                breaker=self.breakers.get(path),
-                service="cloud",
-                endpoint=path,
-            )
+            # client span per call (the cloud API paths are a bounded set):
+            # the resilience layer's retries/breaker trips land on it as
+            # events, and its traceparent crosses the wire
+            with tracing.TRACER.span(f"cloud.client.{path}"):
+                return resilient_call(
+                    lambda: self._transport(path, body),
+                    policy=self.retry_policy,
+                    breaker=self.breakers.get(path),
+                    service="cloud",
+                    endpoint=path,
+                )
         except CircuitOpenError as e:
             raise CloudProviderError(f"cloud API circuit open: {e}") from e
         except urllib.error.URLError as e:

@@ -40,6 +40,7 @@ from ..solver.solver import GreedySolver, Solver, TPUSolver
 from ..state.cluster import Cluster
 from ..utils import metrics
 from ..utils.cache import Clock
+from ..utils.decisions import DECISIONS
 from ..utils.events import Recorder
 from .provisioning import launch_from_spec
 from .termination import TerminationController
@@ -153,6 +154,12 @@ class DeprovisioningController:
                         "DeprovisioningPlanned", f"{action.reason}: {action.nodes}",
                         object_kind="Deprovisioner",
                     )
+                    DECISIONS.record(
+                        "consolidation", "planned", reason=action.reason,
+                        node=action.nodes[0] if action.nodes else "",
+                        details={"nodes": list(action.nodes),
+                                 "savings": round(action.savings, 5)},
+                    )
                     return None
                 self._execute(action)
                 return action
@@ -167,6 +174,12 @@ class DeprovisioningController:
             self.recorder.publish(
                 "DeprovisioningAborted", f"{action.reason} invalidated during validation window",
                 object_kind="Deprovisioner", type="Warning",
+            )
+            DECISIONS.record(
+                "consolidation", "aborted", reason=action.reason,
+                node=action.nodes[0] if action.nodes else "",
+                details={"nodes": list(action.nodes),
+                         "blocked_by": "cluster moved during validation window"},
             )
             return None
         self._execute(action)
@@ -260,12 +273,22 @@ class DeprovisioningController:
     # -- consolidation ---------------------------------------------------
     def _consolidation(self) -> Optional[PlannedAction]:
         if self.cluster.pending_pods():
-            return None  # cluster still provisioning; wait for stability
+            # cluster still provisioning; wait for stability. Coalesced: this
+            # verdict repeats every pass and must not flood the ring.
+            DECISIONS.record_coalesced(
+                "consolidation", "deferred", reason="pending-pods",
+            )
+            return None
         if (
             self.settings.stabilization_window > 0
             and self.clock.now() - self._last_node_change < self.settings.stabilization_window
         ):
-            return None  # node population still settling (consolidation.md:59-67)
+            # node population still settling (consolidation.md:59-67)
+            DECISIONS.record_coalesced(
+                "consolidation", "deferred", reason="stabilization-window",
+                details={"window_s": self.settings.stabilization_window},
+            )
+            return None
         candidates = self._consolidatable()
         if not candidates:
             return None
@@ -284,7 +307,16 @@ class DeprovisioningController:
             multi = self._try_multi_node(candidates)
             if multi is not None:
                 return multi
-            return self._single_node_sweep(candidates)
+            action = self._single_node_sweep(candidates)
+            if action is None:
+                # the whole sweep declined: the "why didn't consolidation
+                # fire" answer is "every candidate's pods need pricier-or-
+                # equal capacity elsewhere" (coalesced — repeats per pass)
+                DECISIONS.record_coalesced(
+                    "consolidation", "no-action", reason="no-cheaper-fit",
+                    details={"candidates": len(candidates)},
+                )
+            return action
         finally:
             self._sweep_capacity = None
             self._sweep_pods = None
@@ -369,19 +401,26 @@ class DeprovisioningController:
             if node.meta.annotations.get(wk.DO_NOT_CONSOLIDATE_ANNOTATION) == "true":
                 continue
             pods = [p for p in self.cluster.pods_on_node(node.name) if not p.is_daemonset]
-            blocked = False
+            blocker = None  # (blocking pod, reason) — the audit log's answer
             for pod in pods:
                 if pod.meta.annotations.get(wk.DO_NOT_EVICT_ANNOTATION) == "true":
-                    blocked = True
+                    blocker = (pod.name, "do-not-evict annotation")
                     break
                 if not pod.owned():
-                    blocked = True  # controllerless pods can't be recreated
+                    blocker = (pod.name, "controllerless pod cannot be recreated")
                     break
                 if self.termination._pdb_blocks(pod):
-                    blocked = True
+                    blocker = (pod.name, "pod disruption budget violated")
                     break
-            if not blocked:
+            if blocker is None:
                 out.append(node)
+            else:
+                # coalesced: the same blocker repeats every pass until the
+                # pod moves — one ring entry with a bumped count
+                DECISIONS.record_coalesced(
+                    "consolidation", "blocked", node=node.name,
+                    pod=blocker[0], reason=blocker[1],
+                )
         return out
 
     def _disruption_cost(self, node: Node) -> float:
@@ -453,6 +492,12 @@ class DeprovisioningController:
         for k in range(min(len(candidates), cap), 1, -1):
             if time.monotonic() >= deadline:
                 metrics.CONSOLIDATION_SWEEP_TRUNCATED.inc()
+                DECISIONS.record_coalesced(
+                    "consolidation", "truncated",
+                    reason="consolidation-timeout budget exhausted",
+                    details={"budget_s": self.settings.consolidation_timeout,
+                             "remaining_prefixes": k - 1},
+                )
                 break
             action = self._evaluate_subset(candidates[:k])
             if action is None:
@@ -552,7 +597,8 @@ class DeprovisioningController:
             else self.cluster.daemonsets()
         )
         result = solver.solve_pods(
-            pods, provisioners, existing=existing, daemonsets=daemonsets
+            pods, provisioners, existing=existing, daemonsets=daemonsets,
+            phase_mode="sim",
         )
         backend = {0.0: "greedy", 1.0: "kernel", 2.0: "host-lp", 3.0: "host-ffd"}.get(
             result.stats.get("backend"), "oracle"
@@ -594,6 +640,7 @@ class DeprovisioningController:
             if dropped:
                 result = solver.solve_pods(
                     pods, filtered, existing=existing, daemonsets=daemonsets,
+                    phase_mode="sim",
                 )
                 over_ceiling = False
         if result.unschedulable:
@@ -643,6 +690,17 @@ class DeprovisioningController:
         metrics.DEPROVISIONING_ACTIONS.inc({"reason": action.reason})
         self.recorder.publish(
             "Deprovisioned", f"{action.reason}: {action.nodes}", object_kind="Deprovisioner"
+        )
+        DECISIONS.record(
+            "consolidation", "acted", reason=action.reason,
+            node=action.nodes[0] if action.nodes else "",
+            details={
+                "nodes": list(action.nodes),
+                "replacements": [
+                    r.option.instance_type.name for r in action.replacements
+                ],
+                "savings": round(action.savings, 5),
+            },
         )
 
     # -- helpers ---------------------------------------------------------

@@ -25,13 +25,16 @@ from ..api.objects import Machine, Node, ObjectMeta, Pod, Provisioner
 from ..api.requirements import Requirement, Requirements
 from ..api.resources import Resources, merge
 from ..api.settings import Settings
+from ..api.taints import tolerates_all
 from ..cloudprovider.interface import CloudProvider, CloudProviderError, InsufficientCapacityError
+from ..cloudprovider.types import InstanceType
 from ..solver.encode import ExistingNode
 from ..solver.result import NewNodeSpec, SolveResult
 from ..solver.session import EncodeSession
 from ..solver.solver import Solver, TPUSolver
 from ..state.cluster import Cluster
 from ..utils import metrics
+from ..utils.decisions import DECISIONS
 from ..utils.events import Recorder
 from ..utils.resilience import RetryPolicy, retry_policy_from_settings
 
@@ -166,6 +169,15 @@ class ProvisioningController:
         )
         if not provisioners:
             result.unschedulable = [p.name for p in pods]
+            # the most basic "why is nothing scheduling" answer must reach
+            # the audit log too — this early return skips the end-of-pass
+            # verdict loop
+            for i, name in enumerate(result.unschedulable):
+                DECISIONS.record(
+                    "placement", "unschedulable", pod=name,
+                    reason="no provisioners configured",
+                    value=float(len(result.unschedulable)) if i == 0 else 0.0,
+                )
             metrics.PODS_UNSCHEDULABLE.set(len(result.unschedulable))
             self.batcher.reset(upto_generation=batch_gen)
             return result
@@ -183,6 +195,10 @@ class ProvisioningController:
         batch = list(pods)
         exhausted: set = set()
         ice_retries = 0
+        # why each pod ended the pass unschedulable (the audit-log reason):
+        # limits exhaustion and catalog infeasibility are DIFFERENT root
+        # causes and must not be conflated in /debug/decisions
+        unsched_reason: Dict[str, str] = {}
         for round_no in range(max(len(provisioners), 1) + 1 + self._ICE_RETRIES):
             # instance-type lists refresh each round: an ICE mark from the
             # previous round's launches must mask the offering NOW, not next
@@ -195,6 +211,9 @@ class ProvisioningController:
             if not round_provs or not batch:
                 for p in batch:
                     result.unschedulable.append(p.name)
+                    unsched_reason[p.name] = (
+                        "every eligible provisioner is at its resource limits"
+                    )
                     self.recorder.publish(
                         "FailedScheduling",
                         "every eligible provisioner is at its resource limits",
@@ -211,7 +230,7 @@ class ProvisioningController:
             if result.solve is None:
                 result.solve = solve
             metrics.SOLVE_DURATION.observe(solve.stats.get("total_s", 0.0))
-            limit_hit, ice_failed = self._apply_solve(solve, result)
+            limit_hit, ice_failed = self._apply_solve(solve, result, round_provs)
             retry_ice = bool(ice_failed) and ice_retries < self._ICE_RETRIES
             if retry_ice:
                 ice_retries += 1
@@ -241,6 +260,14 @@ class ProvisioningController:
                     object_kind="Pod", type="Warning",
                 )
             break
+        # final per-pod unschedulable verdicts for the audit log (the pods
+        # that survived every cascade round unplaced); metric inc'd once
+        for i, name in enumerate(result.unschedulable):
+            DECISIONS.record(
+                "placement", "unschedulable", pod=name,
+                reason=unsched_reason.get(name, "no feasible instance offering"),
+                value=float(len(result.unschedulable)) if i == 0 else 0.0,
+            )
         metrics.PODS_UNSCHEDULABLE.set(float(len(result.unschedulable)))
         metrics.PROVISIONING_DURATION.observe(time.perf_counter() - t0)
         self.batcher.reset(upto_generation=batch_gen)
@@ -251,16 +278,27 @@ class ProvisioningController:
     #: next-cheapest offering; a storm falls back to the next reconcile
     _ICE_RETRIES = 2
 
-    def _apply_solve(self, solve: SolveResult, result: ProvisioningResult) -> Tuple[set, set]:
+    def _apply_solve(
+        self,
+        solve: SolveResult,
+        result: ProvisioningResult,
+        round_provs: Sequence[Tuple[Provisioner, Sequence[InstanceType]]] = (),
+    ) -> Tuple[set, set]:
         """Bind existing-node assignments and launch new nodes for one solve,
         honoring provisioner limits. Returns (provisioners whose limits
         blocked specs, pods whose launch failed with insufficient capacity) —
-        the caller cascades to other pools / re-solves with the ICE mask."""
+        the caller cascades to other pools / re-solves with the ICE mask.
+        Every verdict lands in the decision audit log (utils/decisions.py)."""
         for node_name, pod_names in solve.existing_assignments.items():
-            for pod_name in pod_names:
+            names = list(pod_names)
+            for i, pod_name in enumerate(names):
                 self.cluster.bind_pod(pod_name, node_name)
                 result.bound[pod_name] = node_name
                 metrics.PODS_SCHEDULED.inc()
+                DECISIONS.record(
+                    "placement", "existing-node", pod=pod_name, node=node_name,
+                    value=float(len(names)) if i == 0 else 0.0,
+                )
 
         # limits phase is serial: accounting is order-dependent
         usage: Dict[str, Resources] = {}
@@ -283,6 +321,15 @@ class ProvisioningController:
                     )
                     limit_hit.add(prov.name)
                     result.unschedulable.extend(spec.pod_names)
+                    DECISIONS.record(
+                        "nomination", "limit-blocked",
+                        reason=f"provisioner {prov.name} resource limits reached",
+                        details={
+                            "provisioner": prov.name,
+                            "instance_type": spec.instance_type_name,
+                            "pods": len(list(spec.pod_names)),
+                        },
+                    )
                     continue
                 usage[prov.name] = projected
             launchable.append(spec)
@@ -302,6 +349,16 @@ class ProvisioningController:
                 # mask applied next cycle
                 ice_failed.update(spec.pod_names)
                 result.unschedulable.extend(spec.pod_names)
+                DECISIONS.record(
+                    "nomination", "ice-failed", reason=str(outcome),
+                    details={
+                        "provisioner": prov.name,
+                        "instance_type": spec.instance_type_name,
+                        "zone": spec.option.zone,
+                        "capacity_type": spec.option.capacity_type,
+                        "pods": len(list(spec.pod_names)),
+                    },
+                )
                 continue
             if isinstance(outcome, BaseException):
                 # Any launch failure (cloud API outage, throttling, SDK error) is
@@ -311,15 +368,50 @@ class ProvisioningController:
                     "LaunchFailed", str(outcome), object_name=machineless_name(spec), type="Warning"
                 )
                 result.unschedulable.extend(spec.pod_names)
+                DECISIONS.record(
+                    "nomination", "launch-failed", reason=str(outcome),
+                    details={
+                        "provisioner": prov.name,
+                        "instance_type": spec.instance_type_name,
+                        "pods": len(list(spec.pod_names)),
+                    },
+                )
                 continue
             machine, node = outcome
             result.machines.append(machine)
             result.nodes.append(node)
             metrics.NODES_CREATED.inc({"provisioner": prov.name})
-            for pod_name in spec.pod_names:
+            pods = list(spec.pod_names)
+            # one placement explanation per SPEC, shared by its pods: the
+            # chosen offering plus the top-k rejected cheaper alternatives
+            # with reject reasons — the "/debug/decisions?pod=" answer to
+            # "why THIS instance type"
+            details = {
+                "instance_type": spec.option.instance_type.name,
+                "zone": spec.option.zone,
+                "capacity_type": spec.option.capacity_type,
+                "price": round(spec.option.price, 5),
+                "provisioner": prov.name,
+                "machine": machine.name,
+            }
+            representative = self.cluster.pods.get(pods[0]) if pods else None
+            if representative is not None and round_provs:
+                details["rejected_alternatives"] = rejected_alternatives(
+                    representative, spec.option, round_provs
+                )
+            DECISIONS.record(
+                "nomination", "launched", node=node.name,
+                details={**details, "pods": len(pods)},
+            )
+            for i, pod_name in enumerate(pods):
                 self.cluster.bind_pod(pod_name, node.name)
                 result.bound[pod_name] = node.name
                 metrics.PODS_SCHEDULED.inc()
+                DECISIONS.record(
+                    "placement", "new-node", pod=pod_name, node=node.name,
+                    details=details,
+                    value=float(len(pods)) if i == 0 else 0.0,
+                )
         return limit_hit, ice_failed
 
     def _launch(self, spec: NewNodeSpec, create_fn=None) -> Tuple[Machine, Node]:
@@ -359,6 +451,109 @@ class ProvisioningController:
 
 def machineless_name(spec: NewNodeSpec) -> str:
     return f"{spec.option.provisioner.name}/{spec.instance_type_name}"
+
+
+def rejected_alternatives(
+    pod: Pod,
+    chosen,
+    round_provs: Sequence[Tuple[Provisioner, Sequence[InstanceType]]],
+    k: int = 3,
+) -> List[Dict[str, object]]:
+    """The audit log's "why not something cheaper" answer: the top-``k``
+    offerings CHEAPER than the chosen one, each classified by reject reason —
+    ``provisioner`` (the provisioner's own spec excludes the offering — it
+    was never a launch candidate), ``requirements`` (pod scheduling terms
+    can't land on that node surface), ``taints`` (untolerated provisioner
+    taint), ``ice`` (masked by the insufficient-capacity cache), ``capacity``
+    (the pod alone doesn't fit its allocatable), or ``packing`` (individually
+    compatible AND cheaper, but the joint cost-minimizing solve still
+    preferred the chosen mix). When
+    nothing cheaper exists (the chosen offering was the floor) the next
+    pricier offering is reported with reason ``price`` so a placement record
+    always carries at least one alternative on any multi-offering catalog.
+
+    Classification is a per-pod approximation of the encoder's compat row —
+    deliberately cheap (one representative pod per node spec, label-surface
+    checks only), because it runs on the provisioning hot path."""
+    terms = pod.scheduling_requirement_terms()
+    tolerations = list(pod.tolerations)
+    chosen_key = (chosen.instance_type.name, chosen.zone, chosen.capacity_type)
+    cheaper: List[Tuple[float, Dict[str, object]]] = []
+    # only the single cheapest pricier offering is ever reported (the
+    # no-cheaper-exists fallback), so track a scalar min instead of
+    # accumulating the whole catalog tail
+    best_pricier: Optional[Tuple[float, Dict[str, object]]] = None
+    for prov, types in round_provs:
+        # the surface the pod's terms are matched against must include the
+        # provisioner's own SPEC requirements, not just its labels — an
+        # offering the spec excludes was never a launch candidate at all
+        # (build_options would not have minted it) and must not be reported
+        # as a solver choice
+        prov_reqs = Requirements.from_labels(prov.labels).intersect(
+            prov.requirements
+        )
+        # exclusion must mirror build_options, which intersects the
+        # provisioner's REQUIREMENTS AND LABELS into every option — a zone
+        # pinned via labels excludes other-zone offerings just as a spec
+        # requirement does
+        prov_zone = prov_reqs.get(wk.ZONE)
+        prov_ct = prov_reqs.get(wk.CAPACITY_TYPE)
+        taints_ok = tolerates_all(tolerations, tuple(prov.taints))
+        for it in types:
+            prov_compatible = it.requirements.compatible(prov_reqs)
+            fits = pod.requests.fits(it.allocatable())
+            for o in it.offerings:
+                if (it.name, o.zone, o.capacity_type) == chosen_key:
+                    continue
+                excluded = (
+                    not prov_compatible
+                    or not prov_zone.has(o.zone)
+                    or not prov_ct.has(o.capacity_type)
+                )
+                if excluded:
+                    if o.price < chosen.price:
+                        cheaper.append((o.price, {
+                            "instance_type": it.name, "zone": o.zone,
+                            "capacity_type": o.capacity_type,
+                            "price": round(o.price, 5),
+                            "reason": "provisioner",
+                        }))
+                    continue
+                if o.price >= chosen.price:
+                    # pricier offerings need no compat analysis — "price" is
+                    # the reject reason by definition
+                    if best_pricier is None or o.price < best_pricier[0]:
+                        best_pricier = (o.price, {
+                            "instance_type": it.name, "zone": o.zone,
+                            "capacity_type": o.capacity_type,
+                            "price": round(o.price, 5), "reason": "price",
+                        })
+                    continue
+                if not o.available:
+                    reason = "ice"
+                elif not fits:
+                    reason = "capacity"
+                elif not taints_ok:
+                    reason = "taints"
+                else:
+                    surface = it.requirements.add(
+                        Requirement.in_values(wk.ZONE, [o.zone]),
+                        Requirement.in_values(wk.CAPACITY_TYPE, [o.capacity_type]),
+                    ).intersect(prov_reqs)
+                    if not any(surface.compatible(term) for term in terms):
+                        reason = "requirements"
+                    else:
+                        reason = "packing"
+                cheaper.append((o.price, {
+                    "instance_type": it.name, "zone": o.zone,
+                    "capacity_type": o.capacity_type,
+                    "price": round(o.price, 5), "reason": reason,
+                }))
+    cheaper.sort(key=lambda t: t[0])
+    out = [entry for _, entry in cheaper[:k]]
+    if not out and best_pricier is not None:
+        out = [best_pricier[1]]
+    return out
 
 
 def launch_from_spec(

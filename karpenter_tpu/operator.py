@@ -81,6 +81,10 @@ class Operator:
             # settings own the ICE TTL (reference: 3m, cache.go:20-36)
             provider.unavailable_offerings.set_ttl(settings.insufficient_capacity_ttl)
         recorder = Recorder()
+        # decision audit ring sized from settings (0 disables recording)
+        from .utils.decisions import DECISIONS
+
+        DECISIONS.configure(settings.decision_log_capacity)
         solver = solver or TPUSolver()
         provisioning = ProvisioningController(
             cluster, provider, solver=solver, settings=settings, recorder=recorder

@@ -36,6 +36,7 @@ from __future__ import annotations
 
 import dataclasses
 import threading
+import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -239,6 +240,7 @@ class EncodeSession:
         daemonsets: Sequence[Pod] = (),
         weight_degate: frozenset = frozenset(),
     ) -> EncodedProblem:
+        t0 = time.perf_counter()
         with self._lock, ENCODE_LOCK:
             _maybe_compact_vocab()
             problem = None
@@ -262,6 +264,15 @@ class EncodeSession:
                 self.stats["delta"] += 1
                 self._deltas_since_full += 1
                 metrics.ENCODE_MODE.inc({"mode": "delta"})
+            # phase histogram + mode stamp: downstream solver phases
+            # (presolve/solve/decode) label their samples with this round's
+            # encode mode, keeping the delta-encode win continuously visible
+            # on /metrics rather than only in bench runs
+            problem.__dict__["_encode_mode"] = self.last_mode
+            metrics.SOLVE_PHASE.observe(
+                time.perf_counter() - t0,
+                {"phase": "encode", "mode": self.last_mode},
+            )
             return problem
 
     def ordered_pods(self) -> List[Pod]:

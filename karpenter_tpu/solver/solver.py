@@ -25,6 +25,7 @@ import numpy as np
 
 from ..api.objects import Pod, Provisioner
 from ..cloudprovider.types import InstanceType
+from ..utils import metrics
 from .encode import EncodedProblem, ExistingNode, LaunchOption, encode
 from .greedy import GreedyPacker
 from .jax_solver import (
@@ -39,6 +40,16 @@ from .validate import validate, validate_counts
 
 def _next_pow2(n: int, floor: int = 8) -> int:
     return max(floor, 1 << (max(n, 1) - 1).bit_length())
+
+
+def _observe_phase(problem: EncodedProblem, phase: str, seconds: float) -> None:
+    """Solver phase histogram sample, labeled with the round's encode mode
+    (stamped by EncodeSession / solve_pods; plain full encodes default) —
+    karpenter_tpu_solve_phase_seconds{phase,mode}."""
+    metrics.SOLVE_PHASE.observe(
+        seconds,
+        {"phase": phase, "mode": problem.__dict__.get("_encode_mode", "full")},
+    )
 
 
 _IBIG = 1 << 30
@@ -406,12 +417,19 @@ class Solver(abc.ABC):
         existing: Sequence[ExistingNode] = (),
         daemonsets: Sequence[Pod] = (),
         session=None,
+        phase_mode: str = "full",
     ) -> SolveResult:
         """``session`` (an EncodeSession) makes the INITIAL encode delta-
         aware: the session patches the previous round's arrays instead of
         re-walking the cluster. The relaxation/degate re-encodes below stay
         on the full path — they solve transient CLONES whose identities must
-        never enter the session's incremental state."""
+        never enter the session's incremental state.
+
+        ``phase_mode`` labels this round's karpenter_tpu_solve_phase_seconds
+        samples when no session owns the mode: real sessionless rounds are
+        "full"; consolidation what-if simulations pass "sim" so hundreds of
+        microsecond sweep solves per pass cannot swamp the delta-vs-full
+        comparison the histogram exists for."""
         from ..utils.tracing import span
 
         t0 = time.perf_counter()
@@ -422,7 +440,14 @@ class Solver(abc.ABC):
                     fresh = session.encode(pods, provisioners, existing, daemonsets)
                 else:
                     fresh = encode(pods, provisioners, existing, daemonsets)
+                    fresh.__dict__["_encode_mode"] = phase_mode
+                    _observe_phase(fresh, "encode", time.perf_counter() - t0)
                 problem = self._intern_problem(fresh)
+                # an intern hit returns the CACHED object: carry this round's
+                # encode mode over so its phase samples are labeled correctly
+                problem.__dict__["_encode_mode"] = fresh.__dict__.get(
+                    "_encode_mode", "full"
+                )
             encode_s += time.perf_counter() - t0
             # anchor the latency budget at ENTRY (before encode): the budget
             # is an end-to-end contract, so a fresh batch's encode time comes
@@ -430,7 +455,13 @@ class Solver(abc.ABC):
             # item 1: cold_solve was structurally encode + full budget)
             problem.__dict__["_entry_t"] = t0
             with span("solve.backend"):
+                # the round's ONE {phase="solve"} sample: backend internals
+                # (host race members, kernel, fallback) must not each emit
+                # their own, or solve counts outrun encode counts and the
+                # delta-vs-full comparison this histogram exists for skews
+                t_backend = time.perf_counter()
                 result = self.solve(problem)
+                _observe_phase(problem, "solve", time.perf_counter() - t_backend)
             # Preference relaxation (the reference scheduler's relaxation
             # pass): preferred node affinity is honored as a hard constraint
             # first; a pod that cannot schedule sheds its weakest still-active
@@ -1133,6 +1164,7 @@ class TPUSolver(Solver):
 
     # -- encoding to device-ready padded arrays -----------------------------
     def _prepare(self, problem: EncodedProblem):
+        t_presolve = time.perf_counter()
         G, O, E, R = problem.G, problem.O, problem.E, len(problem.resource_axes)
         Gp = _next_pow2(G)
         Op = _next_pow2(O)
@@ -1253,6 +1285,7 @@ class TPUSolver(Solver):
         )
 
         s_new = self._estimate_slots(problem)
+        _observe_phase(problem, "presolve", time.perf_counter() - t_presolve)
         return inputs, orders, alphas, looks, rsvs, swaps, s_new, n_zones
 
     def _estimate_slots(self, problem: EncodedProblem) -> int:
@@ -1298,6 +1331,7 @@ class TPUSolver(Solver):
         new_active: np.ndarray,
         ys: np.ndarray,
     ) -> SolveResult:
+        t_decode = time.perf_counter()
         E = problem.E
         s_new = new_opt.shape[0]
         # slot columns are [existing (padded) | new]; derive the pad from the
@@ -1357,6 +1391,7 @@ class TPUSolver(Solver):
                 NewNodeSpec(option=option, pod_names=NameSlice(new_segs[s]), option_index=j)
             )
             cost += option.price
+        _observe_phase(problem, "decode", time.perf_counter() - t_decode)
         return SolveResult(
             new_nodes=new_nodes,
             existing_assignments=existing_assignments,

@@ -27,6 +27,7 @@ test drives the full operator through this surface with latency on.
 
 from __future__ import annotations
 
+import contextlib
 import json
 import threading
 import time
@@ -35,6 +36,7 @@ from typing import Dict, List, Optional, Tuple
 
 from ..api.admission import AdmissionError, admit_node_template, admit_provisioner
 from ..api.codec import KIND_OF_TYPE, KINDS, to_wire
+from ..utils.tracing import TRACER
 from .cluster import Cluster
 
 _COLLECTIONS = {
@@ -50,6 +52,27 @@ _ADMIT = {
     "provisioners": admit_provisioner,
     "nodetemplates": admit_node_template,
 }
+
+
+def route_template(path: str) -> str:
+    """Canonical route-template normalization for the apiserver's API
+    surface: per-object paths collapse to /api/{kind}/{name}[/verb]. ONE
+    definition shared by both sides of the wire — server span names here,
+    client breaker/metric keys and client span names in
+    ``HTTPCluster._route`` — so client and server observability always key
+    the same route the same way."""
+    parts = [p for p in path.split("?", 1)[0].split("/") if p]
+    if len(parts) >= 2 and parts[0] == "api":
+        route = f"/api/{parts[1]}"
+        if len(parts) >= 3:
+            route += "/{name}"
+        if len(parts) >= 4:
+            route += "/" + parts[3]
+        return route
+    return "/" + parts[0] if parts else "/"
+
+
+_route_template = route_template  # local alias used by the handler below
 
 
 class ClusterAPIServer:
@@ -280,7 +303,31 @@ class ClusterAPIServer:
                 length = int(self.headers.get("Content-Length") or 0)
                 if length:
                     body = json.loads(self.rfile.read(length))
-                status, payload = outer.handle(self.command, raw_path, query, body)
+                # server span in the CALLER'S trace (traceparent header),
+                # stamped with the originating reconcile id: one reconcile's
+                # apiserver round-trips join its client span tree by trace
+                # id. The watch long-poll is NOT traced (mirroring the
+                # client side): a permanent background poll would churn real
+                # traces out of the tracer's bounded per-trace index.
+                route = _route_template(raw_path)
+                if route == "/watch":
+                    span_ctx = contextlib.nullcontext()
+                else:
+                    attrs = {}
+                    reconcile_id = self.headers.get("x-karpenter-reconcile-id")
+                    if reconcile_id:
+                        attrs["reconcile_id"] = reconcile_id
+                    span_ctx = TRACER.server_span(
+                        f"apiserver.{self.command} {route}",
+                        traceparent=self.headers.get("traceparent"),
+                        **attrs,
+                    )
+                with span_ctx as span:
+                    status, payload = outer.handle(
+                        self.command, raw_path, query, body
+                    )
+                    if span is not None:
+                        span.attrs["status"] = status
                 data = json.dumps(payload).encode()
                 self.send_response(status)
                 self.send_header("Content-Type", "application/json")

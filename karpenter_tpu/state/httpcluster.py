@@ -21,6 +21,7 @@ that stack). ``HTTPCluster`` is the same shape against
 
 from __future__ import annotations
 
+import contextlib
 import json
 import logging
 import threading
@@ -38,7 +39,8 @@ from ..api.objects import (
     PodDisruptionBudget,
     Provisioner,
 )
-from ..utils.logging import get_logger, kv
+from ..utils import tracing
+from ..utils.logging import context_fields, get_logger, kv
 from ..utils.resilience import (
     BreakerSet,
     CircuitOpenError,
@@ -109,6 +111,16 @@ class HTTPCluster(Cluster):
         )
         if data is not None:
             req.add_header("Content-Type", "application/json")
+        # trace propagation (W3C traceparent): the server opens a span in the
+        # SAME trace, so one reconcile's client, apiserver and cloud spans
+        # join on /debug/traces. The reconcile correlation id rides along so
+        # server-side spans carry the originating reconcile.
+        traceparent = tracing.current_traceparent()
+        if traceparent:
+            req.add_header("traceparent", traceparent)
+        reconcile_id = context_fields().get("reconcile_id")
+        if reconcile_id:
+            req.add_header("x-karpenter-reconcile-id", str(reconcile_id))
         timeout = self.retry_policy.attempt_timeout_s or self.timeout_s
         with urllib.request.urlopen(req, timeout=timeout) as resp:
             return json.loads(resp.read() or b"{}")
@@ -119,16 +131,12 @@ class HTTPCluster(Cluster):
         metric keying: raw per-object paths (/api/pods/<name>, .../bind)
         would mint one breaker + one metric series per object — unbounded
         growth, and per-object breakers see ~1 call each so they could
-        never accumulate enough consecutive failures to open."""
-        parts = path.split("?", 1)[0].strip("/").split("/")
-        if len(parts) >= 2 and parts[0] == "api":
-            route = f"/api/{parts[1]}"
-            if len(parts) >= 3:
-                route += "/{name}"
-            if len(parts) >= 4:
-                route += "/" + parts[3]  # the verb, e.g. bind
-            return route
-        return "/" + parts[0] if parts and parts[0] else "/"
+        never accumulate enough consecutive failures to open. Delegates to
+        the apiserver's canonical ``route_template`` so client-side keys and
+        server-side span names can never drift apart."""
+        from .apiserver import route_template
+
+        return route_template(path)
 
     def _call(self, method: str, path: str, body: Optional[Dict] = None) -> Dict:
         """Transport with retries + per-endpoint breaker. 5xx/connection
@@ -144,13 +152,27 @@ class HTTPCluster(Cluster):
         # by the whole recovery window for no protective benefit
         breaker = None if endpoint == "/watch" else self.breakers.get(endpoint)
         try:
-            return resilient_call(
-                lambda: self._transport(method, path, body),
-                policy=self.retry_policy,
-                breaker=breaker,
-                service="apiserver",
-                endpoint=endpoint,
-            )
+            # client span per call: retries/breaker trips from the resilience
+            # layer land on it as events, and its traceparent is what the
+            # transport injects — the span that crosses the wire. The watch
+            # long-poll is exempt (like it is from the breaker): it fires
+            # every few seconds forever, and each poll would mint a fresh
+            # single-span trace that churns real reconcile traces out of the
+            # tracer's bounded per-trace index.
+            if endpoint == "/watch":
+                span_ctx = contextlib.nullcontext()
+            else:
+                span_ctx = tracing.TRACER.span(
+                    f"apiserver.client.{method} {endpoint}"
+                )
+            with span_ctx:
+                return resilient_call(
+                    lambda: self._transport(method, path, body),
+                    policy=self.retry_policy,
+                    breaker=breaker,
+                    service="apiserver",
+                    endpoint=endpoint,
+                )
         except CircuitOpenError as e:
             raise RuntimeError(f"{method} {path}: {e}") from e
         except urllib.error.HTTPError as e:

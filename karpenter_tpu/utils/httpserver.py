@@ -11,9 +11,15 @@ Debug surface (the pprof-flag analogue, always on and cheap):
 * ``/debug/traces`` — JSON dump of the tracer's retained root span trees
   (most recent first), e.g. the full encode -> solve -> decode -> validate
   breakdown the solver records, with the controller kit's ``reconcile_id``
-  correlation attrs so a trace joins to its log lines;
+  correlation attrs so a trace joins to its log lines; ``?trace_id=`` narrows
+  to one distributed trace (client + apiserver + cloud roots sharing the
+  propagated W3C trace id);
 * ``/debug/events`` — the Recorder's recent-events ring (newest first,
-  ``?limit=N`` caps the window, default 256).
+  ``?limit=N`` caps the window, default 256);
+* ``/debug/decisions`` — the scheduling-decision audit log
+  (utils/decisions.py): placement / nomination / consolidation verdicts,
+  newest first, filterable by ``?pod=``, ``?node=``, ``?reconcile_id=``,
+  ``?trace_id=``, ``?kind=`` and capped by ``?limit=``.
 """
 
 from __future__ import annotations
@@ -24,6 +30,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Optional
 from urllib.parse import parse_qs
 
+from .decisions import DECISIONS, DecisionLog
 from .metrics import REGISTRY, Registry
 from .tracing import TRACER, Tracer
 
@@ -38,6 +45,7 @@ class OperatorHTTPServer:
         leader_check: Optional[Callable[[], bool]] = None,
         tracer: Optional[Tracer] = None,
         recorder: Optional[object] = None,
+        decisions: Optional[DecisionLog] = None,
         host: str = "127.0.0.1",
     ):
         self.registry = registry or REGISTRY
@@ -53,6 +61,7 @@ class OperatorHTTPServer:
         # server started before it existed (the entrypoint boots the HTTP
         # surface before leader election) — the handler reads it per request
         self.recorder = recorder
+        self.decisions = decisions or DECISIONS
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -78,8 +87,33 @@ class OperatorHTTPServer:
                     self.send_response(200 if ok else 503)
                     self.send_header("Content-Type", "text/plain")
                 elif path == "/debug/traces":
+                    q = parse_qs(query)
+                    trace_id = q.get("trace_id", [None])[0]
                     body = json.dumps(
-                        {"traces": outer.tracer.export()}, default=str
+                        {"traces": outer.tracer.export(trace_id=trace_id)},
+                        default=str,
+                    ).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                elif path == "/debug/decisions":
+                    q = parse_qs(query)
+
+                    def arg(name):
+                        return q.get(name, [None])[0]
+
+                    try:
+                        limit = max(0, int(arg("limit") or 256))
+                    except ValueError:
+                        limit = 256
+                    records = outer.decisions.query(
+                        pod=arg("pod"), node=arg("node"),
+                        reconcile_id=arg("reconcile_id"),
+                        trace_id=arg("trace_id"), kind=arg("kind"),
+                        limit=limit,
+                    )
+                    body = json.dumps(
+                        {"decisions": [r.to_dict() for r in records]},
+                        default=str,
                     ).encode()
                     self.send_response(200)
                     self.send_header("Content-Type", "application/json")

@@ -129,6 +129,17 @@ class Counter:
         with self._lock:
             self._values = dict(values)
 
+    def prune_series(self, keep) -> int:
+        """Drop every series whose label dict fails ``keep`` (the registry's
+        pre-scrape staleness hooks use this so gauges fed between scraper
+        passes never expose series for objects that no longer exist).
+        Returns the number of series dropped."""
+        with self._lock:
+            dead = [k for k in self._values if not keep(dict(k))]
+            for k in dead:
+                del self._values[k]
+        return len(dead)
+
     def _header(self) -> List[str]:
         lines = []
         if self.help:
@@ -297,6 +308,13 @@ SOLVE_DURATION = Histogram(
     help="End-to-end solver latency (encode, backend race, decode, validate).",
     registry=REGISTRY,
 )
+SOLVE_PHASE = Histogram(
+    "karpenter_tpu_solve_phase_seconds",
+    help="Solver phase latency (encode/presolve/solve/decode), labeled by "
+         "phase and by the round's encode mode (delta/full) — the continuous "
+         "view of the incremental-encode win.",
+    registry=REGISTRY,
+)
 RECONCILE_DURATION = Histogram(
     "karpenter_tpu_controller_reconcile_duration_seconds",
     help="Reconcile wall time per controller loop.",
@@ -450,6 +468,14 @@ RPC_OFFERING_UNAVAILABLE = Gauge(
     "karpenter_tpu_rpc_offering_unavailable",
     help="Offerings currently masked by the insufficient-capacity (ICE) cache, "
          "labeled by instance type, zone and capacity type (1 while masked).",
+    registry=REGISTRY,
+)
+
+# -- decision audit log (utils/decisions.py) ---------------------------------
+DECISIONS_TOTAL = Counter(
+    "karpenter_tpu_decisions_total",
+    help="Scheduling decisions recorded in the audit log, labeled by kind "
+         "(placement/nomination/consolidation) and outcome.",
     registry=REGISTRY,
 )
 

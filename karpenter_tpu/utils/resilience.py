@@ -41,7 +41,7 @@ import urllib.error
 from dataclasses import dataclass
 from typing import Callable, Dict, Optional
 
-from . import metrics
+from . import metrics, tracing
 
 # -- error classification ----------------------------------------------------
 
@@ -139,6 +139,12 @@ class RetryPolicy:
                     metrics.RPC_REQUESTS.inc({**labels, "outcome": "deadline"})
                     raise
                 metrics.RPC_RETRIES.inc(labels)
+                # stamp the retry on the active trace span (no-op outside a
+                # span): a slow round's trace shows WHICH call retried and why
+                tracing.add_event(
+                    "rpc.retry", service=service, endpoint=endpoint,
+                    attempt=attempt, error=f"{type(e).__name__}: {e}",
+                )
                 if on_retry is not None:
                     on_retry(e, attempt)
                 if delay > 0:
@@ -204,6 +210,12 @@ class CircuitBreaker:
             return
         self._state = to
         metrics.RPC_BREAKER_TRANSITIONS.inc({**self._labels(), "to": to})
+        # breaker trips ride the active trace span too (no-op outside one):
+        # an attributable "circuit opened mid-reconcile" beats a bare metric
+        tracing.add_event(
+            "breaker.transition", service=self.service, endpoint=self.endpoint,
+            to=to, failures=self._failures,
+        )
         self._publish_locked()
 
     def _maybe_half_open_locked(self) -> None:

@@ -1,0 +1,805 @@
+"""PR 4 decision-observability suite: cross-boundary trace propagation,
+the scheduling-decision audit log, solver phase histograms, and the
+metrics-scraper staleness pruner.
+
+The e2e class is the acceptance criterion: one reconcile over real HTTP
+(embedded apiserver + cloud service) produces ONE trace spanning all three
+processes' spans, and /debug/decisions?pod=<name> returns that pod's
+placement record with >=1 rejected alternative and a matching trace id —
+including across a retried (faulted) call.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.request
+
+import pytest
+
+from karpenter_tpu.api import ObjectMeta, Resources
+from karpenter_tpu.api import labels as wk
+from karpenter_tpu.api.settings import Settings
+from karpenter_tpu.cloudprovider import FakeCloudProvider, generate_catalog
+from karpenter_tpu.cloudprovider.httpcloud import CloudHTTPService, HTTPCloudProvider
+from karpenter_tpu.controllers.deprovisioning import DeprovisioningController
+from karpenter_tpu.controllers.kit import SingletonController
+from karpenter_tpu.controllers.metricsscraper import (
+    NodeScraper,
+    ProvisionerScraper,
+    build_scrapers,
+)
+from karpenter_tpu.controllers.provisioning import (
+    ProvisioningController,
+    rejected_alternatives,
+)
+from karpenter_tpu.controllers.termination import TerminationController
+from karpenter_tpu.solver.session import EncodeSession
+from karpenter_tpu.solver.solver import GreedySolver, TPUSolver
+from karpenter_tpu.state import Cluster, ClusterAPIServer, HTTPCluster
+from karpenter_tpu.utils import metrics, tracing
+from karpenter_tpu.utils.cache import FakeClock
+from karpenter_tpu.utils.decisions import DECISIONS, DecisionLog
+from karpenter_tpu.utils.faults import FaultPlan
+from karpenter_tpu.utils.httpserver import OperatorHTTPServer
+from karpenter_tpu.utils.resilience import CircuitBreaker, RetryPolicy
+from karpenter_tpu.utils.tracing import (
+    TRACER,
+    format_traceparent,
+    parse_traceparent,
+)
+
+from helpers import make_pod, make_pods, make_provisioner
+
+
+@pytest.fixture(autouse=True)
+def _fresh_decision_log():
+    DECISIONS.configure(2048)
+    DECISIONS.clear()
+    yield
+    DECISIONS.clear()
+
+
+def no_sleep_policy(**kw) -> RetryPolicy:
+    kw.setdefault("sleep", lambda s: None)
+    return RetryPolicy(**kw)
+
+
+# ---------------------------------------------------------------------------
+# W3C trace context
+# ---------------------------------------------------------------------------
+
+
+class TestTraceContext:
+    def test_traceparent_round_trip(self):
+        tid, sid = "ab" * 16, "cd" * 8
+        parsed = parse_traceparent(format_traceparent(tid, sid))
+        assert parsed == (tid, sid)
+
+    @pytest.mark.parametrize("bad", [
+        None, "", "garbage", "00-short-cd" * 2,
+        "00-" + "0" * 32 + "-" + "cd" * 8 + "-01",   # all-zero trace id
+        "00-" + "zz" * 16 + "-" + "cd" * 8 + "-01",  # non-hex
+    ])
+    def test_malformed_traceparent_degrades_to_none(self, bad):
+        assert parse_traceparent(bad) is None
+
+    def test_nested_spans_share_trace_and_chain_parents(self):
+        with TRACER.span("outer") as outer:
+            with TRACER.span("inner") as inner:
+                assert inner.trace_id == outer.trace_id
+                assert inner.parent_span_id == outer.span_id
+                assert inner.span_id != outer.span_id
+        assert len(outer.trace_id) == 32 and len(outer.span_id) == 16
+
+    def test_server_span_adopts_remote_context(self):
+        tid, sid = "12" * 16, "34" * 8
+        with TRACER.server_span("srv", traceparent=format_traceparent(tid, sid)) as s:
+            assert s.trace_id == tid
+            assert s.parent_span_id == sid
+
+    def test_server_span_with_bad_header_mints_fresh_trace(self):
+        with TRACER.server_span("srv", traceparent="not-a-header") as s:
+            assert len(s.trace_id) == 32
+
+    def test_current_traceparent_binds_to_active_span(self):
+        assert tracing.current_traceparent() is None
+        with TRACER.span("op") as s:
+            header = tracing.current_traceparent()
+            assert header == format_traceparent(s.trace_id, s.span_id)
+            assert tracing.current_trace_id() == s.trace_id
+        assert tracing.current_trace_id() == ""
+
+    def test_export_filters_by_trace_id(self):
+        with TRACER.span("filter-me") as s:
+            tid = s.trace_id
+        exported = TRACER.export(trace_id=tid)
+        assert [e["name"] for e in exported] == ["filter-me"]
+        assert exported[0]["trace_id"] == tid
+
+    def test_trace_index_keeps_every_same_name_root(self):
+        """Per-name LRU retention keeps only the LAST root per route; the
+        per-trace index must keep EVERY root of a trace, so a reconcile's N
+        same-route server round-trips all survive in ?trace_id= output."""
+        tid, sid = "ef" * 16, "ab" * 8
+        header = format_traceparent(tid, sid)
+        for _ in range(5):
+            with TRACER.server_span("apiserver.POST /api/pods/{name}/bind",
+                                    traceparent=header):
+                pass
+        exported = TRACER.export(trace_id=tid)
+        assert len(exported) == 5
+        assert all(e["trace_id"] == tid for e in exported)
+        # the per-NAME view still holds just the most recent one
+        assert TRACER.last_trace(
+            "apiserver.POST /api/pods/{name}/bind"
+        ).trace_id == tid
+
+
+class TestSpanEvents:
+    def test_add_event_records_and_caps(self):
+        with TRACER.span("ev") as s:
+            for i in range(tracing._MAX_EVENTS + 5):
+                s.add_event("tick", i=i)
+        assert len(s.events) == tracing._MAX_EVENTS
+        assert s.events_dropped == 5
+        assert s.to_dict()["events"][0]["name"] == "tick"
+
+    def test_retry_policy_stamps_events_on_active_span(self):
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise ConnectionError("boom")
+            return "ok"
+
+        with TRACER.span("call") as s:
+            no_sleep_policy().call(flaky, service="svc", endpoint="/ep")
+        retries = [e for e in s.events if e["name"] == "rpc.retry"]
+        assert len(retries) == 2
+        assert retries[0]["endpoint"] == "/ep"
+        assert "ConnectionError" in retries[0]["error"]
+
+    def test_breaker_transition_stamps_event(self):
+        breaker = CircuitBreaker("svc", "/ep", failure_threshold=1)
+        with TRACER.span("call") as s:
+            with pytest.raises(ConnectionError):
+                breaker.call(lambda: (_ for _ in ()).throw(ConnectionError()))
+        assert any(
+            e["name"] == "breaker.transition" and e["to"] == "open"
+            for e in s.events
+        )
+
+
+# ---------------------------------------------------------------------------
+# Decision audit log
+# ---------------------------------------------------------------------------
+
+
+class TestDecisionLog:
+    def test_ring_bounds_and_query_filters(self):
+        log = DecisionLog(capacity=4)
+        for i in range(8):
+            log.record("placement", "new-node", pod=f"p-{i}", node="n-1")
+        assert len(log.query(limit=100)) == 4  # ring evicted the oldest
+        assert log.query(pod="p-7")[0].pod == "p-7"
+        assert log.query(pod="p-0") == []  # evicted
+        assert log.query(node="n-1", kind="placement", limit=2)
+        assert log.query(kind="consolidation") == []
+
+    def test_records_capture_correlation_ids(self):
+        from karpenter_tpu.utils.logging import log_context
+
+        log = DecisionLog()
+        with log_context(reconcile_id="prov.42"), TRACER.span("reconcile") as s:
+            rec = log.record("placement", "new-node", pod="p")
+        assert rec.reconcile_id == "prov.42"
+        assert rec.trace_id == s.trace_id
+
+    def test_metric_counts_with_batched_value(self):
+        log = DecisionLog()
+        before = metrics.DECISIONS_TOTAL.value(
+            {"kind": "placement", "outcome": "batched"}
+        )
+        log.record("placement", "batched", pod="a", value=3.0)
+        log.record("placement", "batched", pod="b", value=0.0)
+        assert metrics.DECISIONS_TOTAL.value(
+            {"kind": "placement", "outcome": "batched"}
+        ) == before + 3.0
+
+    def test_coalesce_bumps_count_instead_of_flooding(self):
+        log = DecisionLog(capacity=16)
+        for _ in range(10):
+            log.record_coalesced(
+                "consolidation", "deferred", reason="stabilization-window"
+            )
+        records = log.query(kind="consolidation", limit=100)
+        assert len(records) == 1
+        assert records[0].count == 10
+
+    def test_coalesce_map_evicts_lru_not_wholesale(self):
+        """Past the coalesce-key cap the LEAST RECENTLY bumped key must be
+        evicted — a wholesale reset would collapse coalescing for clusters
+        with more repeating verdicts than the cap and flood the ring."""
+        log = DecisionLog(capacity=4096)
+        for i in range(DecisionLog._COALESCE_MAX + 10):
+            log.record_coalesced("consolidation", "blocked", node=f"n-{i}")
+        # the most recent key still coalesces (it survived the eviction)
+        last = f"n-{DecisionLog._COALESCE_MAX + 9}"
+        rec = log.record_coalesced("consolidation", "blocked", node=last)
+        assert rec.count == 2
+        assert len(log._coalesce) <= DecisionLog._COALESCE_MAX
+
+    def test_coalesced_record_reappears_after_ring_eviction(self):
+        """A coalesced verdict pushed out of the ring by other traffic must
+        re-enter on the next repeat, not keep absorbing bumps invisibly."""
+        log = DecisionLog(capacity=4)
+        log.record_coalesced("consolidation", "deferred", reason="window")
+        for i in range(6):  # flood the ring: the coalesced record evicts
+            log.record("placement", "new-node", pod=f"flood-{i}")
+        assert log.query(kind="consolidation", limit=10) == []
+        log.record_coalesced("consolidation", "deferred", reason="window")
+        records = log.query(kind="consolidation", limit=10)
+        assert len(records) == 1, "the repeat verdict must re-enter the ring"
+        assert records[0].count == 1  # fresh record, not the stale bump
+
+    def test_disabled_log_records_nothing(self):
+        log = DecisionLog()
+        log.configure(0)
+        assert log.record("placement", "x", pod="p") is None
+        assert log.query(limit=10) == []
+
+
+class TestControllerDecisions:
+    def _env(self, provisioner=None, n_types=20):
+        cluster = Cluster()
+        provider = FakeCloudProvider(catalog=generate_catalog(n_types=n_types))
+        controller = ProvisioningController(
+            cluster, provider,
+            settings=Settings(batch_idle_duration=0, batch_max_duration=0),
+        )
+        cluster.add_provisioner(provisioner or make_provisioner())
+        return cluster, provider, controller
+
+    def test_placement_records_carry_chosen_and_alternatives(self):
+        cluster, provider, controller = self._env()
+        for p in make_pods(6, prefix="place", cpu="500m", memory="1Gi"):
+            cluster.add_pod(p)
+        controller.reconcile()
+        records = DECISIONS.query(pod="place-0", kind="placement")
+        assert records, "every scheduled pod gets a placement record"
+        rec = records[0]
+        assert rec.outcome == "new-node"
+        assert rec.node
+        details = rec.details
+        assert details["instance_type"] and details["zone"]
+        alts = details["rejected_alternatives"]
+        assert len(alts) >= 1
+        assert all(
+            a["reason"] in (
+                "provisioner", "requirements", "taints", "ice", "capacity",
+                "packing", "price",
+            )
+            for a in alts
+        )
+        # nomination record for the launched node too
+        noms = DECISIONS.query(node=rec.node, kind="nomination")
+        assert noms and noms[0].outcome == "launched"
+        assert noms[0].details["pods"] >= 1
+
+    def test_ice_masked_offering_reported_as_alternative(self):
+        from karpenter_tpu.cloudprovider.catalog import make_instance_type
+
+        cheap = make_instance_type(
+            "cheap.large", "c", "1", "large", 4, 8.0, 0.10, ["zone-a"], spot=False
+        )
+        pricier = make_instance_type(
+            "pricier.large", "m", "1", "large", 4, 8.0, 0.30, ["zone-a"], spot=False
+        )
+        provider = FakeCloudProvider(catalog=[cheap, pricier])
+        cluster = Cluster()
+        controller = ProvisioningController(
+            cluster, provider,
+            settings=Settings(batch_idle_duration=0, batch_max_duration=0),
+        )
+        cluster.add_provisioner(make_provisioner())
+        provider.set_insufficient_capacity(
+            "cheap.large", "zone-a", wk.CAPACITY_TYPE_ON_DEMAND
+        )
+        cluster.add_pod(make_pod(name="ice-pod", cpu="500m", memory="1Gi"))
+        controller.reconcile()
+        rec = DECISIONS.query(pod="ice-pod", kind="placement")[0]
+        assert rec.details["instance_type"] == "pricier.large"
+        alts = rec.details["rejected_alternatives"]
+        ice = [a for a in alts if a["instance_type"] == "cheap.large"]
+        assert ice and ice[0]["reason"] == "ice"
+
+    def test_unschedulable_pod_gets_a_verdict(self):
+        cluster, provider, controller = self._env()
+        cluster.add_pod(
+            make_pod(name="giant", cpu="4000", memory="1Gi")  # fits nothing
+        )
+        controller.reconcile()
+        rec = DECISIONS.query(pod="giant", kind="placement")[0]
+        assert rec.outcome == "unschedulable"
+        assert rec.reason == "no feasible instance offering"
+
+    def test_no_provisioners_still_yields_a_verdict(self):
+        cluster = Cluster()
+        provider = FakeCloudProvider(catalog=generate_catalog(n_types=5))
+        controller = ProvisioningController(
+            cluster, provider,
+            settings=Settings(batch_idle_duration=0, batch_max_duration=0),
+        )
+        cluster.add_pod(make_pod(name="orphan", cpu="100m"))
+        controller.reconcile()
+        rec = DECISIONS.query(pod="orphan", kind="placement")[0]
+        assert rec.outcome == "unschedulable"
+        assert rec.reason == "no provisioners configured"
+
+    def test_provisioner_excluded_offering_classified_as_provisioner(self):
+        """A cheaper offering the provisioner spec excludes was never a
+        candidate and must not be blamed on the solver as 'packing'."""
+        from karpenter_tpu.api import Requirement
+
+        cluster = Cluster()
+        provider = FakeCloudProvider(catalog=generate_catalog(n_types=10))
+        controller = ProvisioningController(
+            cluster, provider,
+            settings=Settings(batch_idle_duration=0, batch_max_duration=0),
+        )
+        # provisioner pinned to on-demand: every spot offering (cheaper by
+        # construction) is spec-excluded
+        cluster.add_provisioner(make_provisioner(
+            requirements=[Requirement.in_values(
+                wk.CAPACITY_TYPE, [wk.CAPACITY_TYPE_ON_DEMAND]
+            )],
+        ))
+        cluster.add_pod(make_pod(name="od-pod", cpu="500m", memory="1Gi"))
+        controller.reconcile()
+        rec = DECISIONS.query(pod="od-pod", kind="placement")[0]
+        assert rec.details["capacity_type"] == wk.CAPACITY_TYPE_ON_DEMAND
+        spot_alts = [
+            a for a in rec.details["rejected_alternatives"]
+            if a["capacity_type"] == wk.CAPACITY_TYPE_SPOT
+        ]
+        assert spot_alts and all(
+            a["reason"] == "provisioner" for a in spot_alts
+        )
+
+    def test_limit_exhaustion_labeled_as_limits_not_infeasibility(self):
+        """Quota exhaustion and catalog infeasibility are different root
+        causes: the audit record must say which one stranded the pod."""
+        from karpenter_tpu.api import Resources
+
+        cluster, provider, controller = self._env(
+            make_provisioner(limits=Resources(cpu="0.001"))
+        )
+        cluster.add_pod(make_pod(name="quota-pod", cpu="500m", memory="1Gi"))
+        controller.reconcile()
+        rec = DECISIONS.query(pod="quota-pod", kind="placement")[0]
+        assert rec.outcome == "unschedulable"
+        assert "resource limits" in rec.reason
+
+    def test_consolidation_blocked_names_blocking_pod(self):
+        cluster, provider, controller = self._env(
+            make_provisioner(consolidation_enabled=True)
+        )
+        for p in make_pods(3, prefix="c", cpu="500m"):
+            cluster.add_pod(p)
+        controller.reconcile()
+        clock = FakeClock(start=10_000.0)
+        term = TerminationController(cluster, provider, clock=clock)
+        deprov = DeprovisioningController(
+            cluster, provider, term,
+            settings=Settings(
+                batch_idle_duration=0, batch_max_duration=0,
+                consolidation_validation_ttl=0, stabilization_window=0.0,
+            ),
+            clock=clock,
+        )
+        pod = next(iter(cluster.pods.values()))
+        pod.meta.annotations[wk.DO_NOT_EVICT_ANNOTATION] = "true"
+        deprov.reconcile()
+        blocked = DECISIONS.query(kind="consolidation")
+        assert any(
+            r.outcome == "blocked" and r.pod == pod.name
+            and "do-not-evict" in r.reason
+            for r in blocked
+        )
+
+    def test_deprovisioning_action_recorded_as_acted(self):
+        cluster, provider, controller = self._env(
+            make_provisioner(ttl_seconds_after_empty=30)
+        )
+        for p in make_pods(3, prefix="e", cpu="500m"):
+            cluster.add_pod(p)
+        controller.reconcile()
+        node_name = next(iter(cluster.nodes))
+        for p in list(cluster.pods.values()):
+            cluster.delete_pod(p.name)
+        clock = FakeClock(start=10_000.0)
+        term = TerminationController(cluster, provider, clock=clock)
+        deprov = DeprovisioningController(
+            cluster, provider, term,
+            settings=Settings(
+                batch_idle_duration=0, batch_max_duration=0,
+                consolidation_validation_ttl=0, stabilization_window=0.0,
+            ),
+            clock=clock,
+        )
+        deprov.reconcile()  # stamps emptiness
+        clock.step(31)
+        action = deprov.reconcile()
+        assert action is not None
+        acted = [
+            r for r in DECISIONS.query(kind="consolidation")
+            if r.outcome == "acted"
+        ]
+        assert acted and acted[0].reason == "emptiness"
+        assert node_name in acted[0].details["nodes"]
+
+
+class TestRejectedAlternatives:
+    def test_cheapest_chosen_still_reports_price_alternative(self):
+        provider = FakeCloudProvider(catalog=generate_catalog(n_types=5))
+        prov = make_provisioner()
+        types = provider.get_instance_types(prov)
+        pod = make_pod(cpu="100m", memory="128Mi")
+        # chose the globally cheapest offering
+        cheapest = min(
+            ((it, o) for it in types for o in it.offerings if o.available),
+            key=lambda t: t[1].price,
+        )
+
+        class Chosen:
+            instance_type = cheapest[0]
+            zone = cheapest[1].zone
+            capacity_type = cheapest[1].capacity_type
+            price = cheapest[1].price
+
+        alts = rejected_alternatives(pod, Chosen, [(prov, types)])
+        assert len(alts) == 1 and alts[0]["reason"] == "price"
+
+    def test_requirements_mismatch_classified(self):
+        provider = FakeCloudProvider(catalog=generate_catalog(n_types=5))
+        prov = make_provisioner()
+        types = provider.get_instance_types(prov)
+        # pod pinned to one zone: other-zone offerings reject on requirements
+        pod = make_pod(node_selector={wk.ZONE: "zone-a"})
+        priciest = max(
+            ((it, o) for it in types for o in it.offerings if o.available),
+            key=lambda t: t[1].price,
+        )
+
+        class Chosen:
+            instance_type = priciest[0]
+            zone = "zone-a"
+            capacity_type = priciest[1].capacity_type
+            price = priciest[1].price + 1.0
+
+        alts = rejected_alternatives(pod, Chosen, [(prov, types)], k=50)
+        reasons = {a["reason"] for a in alts if a["zone"] != "zone-a"}
+        assert reasons == {"requirements"}
+
+
+# ---------------------------------------------------------------------------
+# /debug/decisions endpoint
+# ---------------------------------------------------------------------------
+
+
+class TestDecisionsEndpoint:
+    def _get(self, port, path):
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}") as r:
+            return json.loads(r.read())
+
+    def test_endpoint_filters(self):
+        DECISIONS.record("placement", "new-node", pod="ep-pod", node="ep-node")
+        DECISIONS.record("consolidation", "acted", node="ep-node")
+        server = OperatorHTTPServer(port=0).start()
+        try:
+            out = self._get(server.port, "/debug/decisions?pod=ep-pod")
+            assert len(out["decisions"]) == 1
+            assert out["decisions"][0]["pod"] == "ep-pod"
+            out = self._get(server.port, "/debug/decisions?node=ep-node")
+            assert len(out["decisions"]) == 2
+            out = self._get(
+                server.port, "/debug/decisions?node=ep-node&kind=consolidation"
+            )
+            assert [d["kind"] for d in out["decisions"]] == ["consolidation"]
+            out = self._get(server.port, "/debug/decisions?limit=1")
+            assert len(out["decisions"]) == 1
+        finally:
+            server.stop()
+
+    def test_traces_endpoint_filters_by_trace_id(self):
+        with TRACER.span("endpoint-trace") as s:
+            tid = s.trace_id
+        server = OperatorHTTPServer(port=0).start()
+        try:
+            out = self._get(server.port, f"/debug/traces?trace_id={tid}")
+            assert [t["name"] for t in out["traces"]] == ["endpoint-trace"]
+        finally:
+            server.stop()
+
+
+# ---------------------------------------------------------------------------
+# Solver phase histograms
+# ---------------------------------------------------------------------------
+
+
+class TestSolverPhaseMetrics:
+    def test_encode_phase_labeled_by_session_mode(self):
+        full_before = metrics.SOLVE_PHASE.count({"phase": "encode", "mode": "full"})
+        delta_before = metrics.SOLVE_PHASE.count({"phase": "encode", "mode": "delta"})
+        solve_before = metrics.SOLVE_PHASE.count({"phase": "solve", "mode": "delta"})
+        provider = FakeCloudProvider(catalog=generate_catalog(n_types=10))
+        prov = make_provisioner()
+        provs = [(prov, provider.get_instance_types(prov))]
+        pods = make_pods(5, prefix="phase", cpu="200m")
+        session = EncodeSession()
+        for p in pods:
+            session.pod_event("ADDED", p)
+        solver = GreedySolver()
+        solver.solve_pods(pods, provs, session=session)  # first: full
+        assert session.last_mode == "full"
+        solver.solve_pods(pods, provs, session=session)  # steady state: delta
+        assert session.last_mode == "delta"
+        assert metrics.SOLVE_PHASE.count(
+            {"phase": "encode", "mode": "full"}
+        ) > full_before
+        assert metrics.SOLVE_PHASE.count(
+            {"phase": "encode", "mode": "delta"}
+        ) > delta_before
+        # the backend solve samples carry the round's encode mode, and ONE
+        # sample per round (backend internals must not each emit their own —
+        # solve counts outrunning encode counts would skew the delta-vs-full
+        # comparison)
+        assert metrics.SOLVE_PHASE.count(
+            {"phase": "solve", "mode": "delta"}
+        ) == solve_before + 1
+
+    def test_simulation_solves_labeled_sim_not_full(self):
+        """Consolidation what-if solves must not pollute the delta-vs-full
+        comparison: their samples carry mode="sim"."""
+        sim_before = metrics.SOLVE_PHASE.count({"phase": "encode", "mode": "sim"})
+        full_before = metrics.SOLVE_PHASE.count({"phase": "encode", "mode": "full"})
+        provider = FakeCloudProvider(catalog=generate_catalog(n_types=5))
+        prov = make_provisioner()
+        provs = [(prov, provider.get_instance_types(prov))]
+        GreedySolver().solve_pods(
+            make_pods(3, prefix="sim", cpu="100m"), provs, phase_mode="sim"
+        )
+        assert metrics.SOLVE_PHASE.count(
+            {"phase": "encode", "mode": "sim"}
+        ) == sim_before + 1
+        assert metrics.SOLVE_PHASE.count(
+            {"phase": "encode", "mode": "full"}
+        ) == full_before
+
+    def test_presolve_and_decode_phases_observed(self):
+        from karpenter_tpu.api import TopologySpreadConstraint
+
+        presolve_before = metrics.SOLVE_PHASE.count(
+            {"phase": "presolve", "mode": "full"}
+        )
+        decode_before = metrics.SOLVE_PHASE.count(
+            {"phase": "decode", "mode": "full"}
+        )
+        provider = FakeCloudProvider(catalog=generate_catalog(n_types=10))
+        prov = make_provisioner()
+        provs = [(prov, provider.get_instance_types(prov))]
+        # zone spread makes the shape non-LP-safe: the host FFD competitor
+        # runs _prepare (presolve) + _decode without any device involvement
+        pods = make_pods(
+            8, prefix="topo", cpu="200m", labels={"app": "a"},
+            spread=[TopologySpreadConstraint(
+                max_skew=1, topology_key=wk.ZONE, label_selector={"app": "a"},
+            )],
+        )
+        TPUSolver(latency_budget_s=0.1).solve_pods(pods, provs)
+        assert metrics.SOLVE_PHASE.count(
+            {"phase": "presolve", "mode": "full"}
+        ) > presolve_before
+        assert metrics.SOLVE_PHASE.count(
+            {"phase": "decode", "mode": "full"}
+        ) > decode_before
+
+
+# ---------------------------------------------------------------------------
+# Scraper staleness pruning
+# ---------------------------------------------------------------------------
+
+
+class TestStalenessPruning:
+    def test_deleted_node_series_pruned_pre_scrape(self):
+        cluster = Cluster()
+        build_scrapers(cluster)  # enrolls the cluster in the pruning hook
+        prov = make_provisioner(name="ghost-prov")
+        cluster.add_provisioner(prov)
+        provider = FakeCloudProvider(catalog=generate_catalog(n_types=5))
+        controller = ProvisioningController(
+            cluster, provider,
+            settings=Settings(batch_idle_duration=0, batch_max_duration=0),
+        )
+        cluster.add_pod(make_pod(name="ghost-pod", cpu="500m"))
+        controller.reconcile()
+        node_name = next(iter(cluster.nodes))
+        NodeScraper(cluster).scrape()
+        ProvisionerScraper(cluster).scrape()
+
+        def state_series(exposition):
+            """STATE-gauge lines only: action counters (nodes_created_total
+            etc.) legitimately keep deleted objects' labels forever."""
+            return [
+                line for line in exposition.splitlines()
+                if line.startswith((
+                    "karpenter_tpu_nodes_allocatable",
+                    "karpenter_tpu_nodes_total_pod_requests",
+                    "karpenter_tpu_nodes_utilization",
+                    "karpenter_tpu_provisioner_usage",
+                    "karpenter_tpu_provisioner_limit",
+                ))
+            ]
+
+        lines = state_series(metrics.REGISTRY.exposition())
+        assert any(f'node_name="{node_name}"' in l for l in lines)
+        assert any('provisioner="ghost-prov"' in l for l in lines)
+
+        # shrink the cluster WITHOUT re-scraping: the pre-scrape hook alone
+        # must drop the ghosts from the next exposition
+        cluster.delete_pod("ghost-pod")
+        cluster.delete_node(node_name)
+        cluster.delete_provisioner("ghost-prov")
+        lines = state_series(metrics.REGISTRY.exposition())
+        assert not any(f'node_name="{node_name}"' in l for l in lines)
+        assert not any('provisioner="ghost-prov"' in l for l in lines)
+
+
+# ---------------------------------------------------------------------------
+# E2E: trace propagation + decisions over real HTTP (acceptance criterion)
+# ---------------------------------------------------------------------------
+
+
+class TestTracePropagationE2E:
+    def _env(self, fault_plan=None):
+        store = Cluster()
+        api = ClusterAPIServer(backing=store).start()
+        svc = CloudHTTPService(
+            generate_catalog(n_types=20), fault_plan=fault_plan
+        ).start()
+        cluster = HTTPCluster(
+            api.endpoint, watch=False, retry_policy=no_sleep_policy()
+        )
+        provider = HTTPCloudProvider(
+            svc.endpoint, retry_policy=no_sleep_policy()
+        )
+        controller = ProvisioningController(
+            cluster, provider,
+            settings=Settings(batch_idle_duration=0, batch_max_duration=0),
+        )
+        cluster.add_provisioner(make_provisioner())
+        return store, api, svc, cluster, provider, controller
+
+    def _reconcile_trace(self, controller):
+        """Run one kit-wrapped reconcile; returns (trace_id, reconcile_id)."""
+        kit = SingletonController("provisioning", controller.reconcile)
+        assert kit.run_if_due()
+        assert kit.consecutive_errors == 0
+        root = TRACER.last_trace("reconcile.provisioning")
+        assert root is not None
+        return root, root.trace_id, root.attrs["reconcile_id"]
+
+    def test_single_trace_spans_client_apiserver_and_cloud(self):
+        store, api, svc, cluster, provider, controller = self._env()
+        try:
+            for p in make_pods(4, prefix="e2e", cpu="500m", memory="1Gi"):
+                cluster.add_pod(p)
+            root, trace_id, reconcile_id = self._reconcile_trace(controller)
+
+            # ONE distributed trace: the client root plus apiserver and cloud
+            # server roots all share the propagated trace id
+            joined = TRACER.export(trace_id=trace_id)
+            names = [t["name"] for t in joined]
+            assert "reconcile.provisioning" in names
+            api_spans = [t for t in joined if t["name"].startswith("apiserver.")]
+            cloud_spans = [t for t in joined if t["name"].startswith("cloud.")]
+            assert api_spans, f"no apiserver spans joined the trace: {names}"
+            assert cloud_spans, f"no cloud spans joined the trace: {names}"
+            # server-side spans carry the ORIGINATING reconcile id
+            for t in api_spans + cloud_spans:
+                assert t["attrs"]["reconcile_id"] == reconcile_id
+            # and the client spans live INSIDE the reconcile root
+            flat = root.flat()
+            assert any("cloud.client./v1/run-instances" in k for k in flat)
+            assert any("apiserver.client" in k for k in flat)
+
+            # /debug/decisions?pod= returns the placement with >=1 rejected
+            # alternative and the trace id of this reconcile
+            server = OperatorHTTPServer(port=0).start()
+            try:
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{server.port}/debug/decisions?pod=e2e-0"
+                ) as r:
+                    out = json.loads(r.read())
+            finally:
+                server.stop()
+            placements = [
+                d for d in out["decisions"] if d["kind"] == "placement"
+            ]
+            assert placements
+            rec = placements[0]
+            assert rec["outcome"] == "new-node"
+            assert rec["trace_id"] == trace_id
+            assert rec["reconcile_id"] == reconcile_id
+            assert len(rec["details"]["rejected_alternatives"]) >= 1
+        finally:
+            cluster.close()
+            api.stop()
+            svc.stop()
+
+    def test_trace_survives_retried_faulted_call(self):
+        plan = FaultPlan().fail("/v1/run-instances", 2, status=503)
+        store, api, svc, cluster, provider, controller = self._env(
+            fault_plan=plan
+        )
+        try:
+            for p in make_pods(3, prefix="flt", cpu="500m", memory="1Gi"):
+                cluster.add_pod(p)
+            root, trace_id, reconcile_id = self._reconcile_trace(controller)
+            assert plan.pending() == 0, "both scripted 503s were served"
+
+            # the client span for the faulted call carries rpc.retry events
+            def find_spans(span, name):
+                hits = [span] if span.name == name else []
+                for c in span.children:
+                    hits.extend(find_spans(c, name))
+                return hits
+
+            launch_spans = find_spans(root, "cloud.client./v1/run-instances")
+            assert launch_spans
+            retries = [
+                e for s in launch_spans for e in s.events
+                if e["name"] == "rpc.retry"
+            ]
+            assert len(retries) == 2
+            # the retried call's SERVER span still joined the same trace
+            cloud_spans = [
+                t for t in TRACER.export(trace_id=trace_id)
+                if t["name"].startswith("cloud.")
+            ]
+            assert any(
+                t["name"] == "cloud.POST /v1/run-instances" for t in cloud_spans
+            )
+            for t in cloud_spans:
+                assert t["attrs"]["reconcile_id"] == reconcile_id
+            # and the round still landed every pod
+            bound = [p for p in cluster.pods.values() if p.node_name]
+            assert len(bound) == 3
+        finally:
+            cluster.close()
+            api.stop()
+            svc.stop()
+
+
+# ---------------------------------------------------------------------------
+# graft entry satellite: device provisioning under any installed jax
+# ---------------------------------------------------------------------------
+
+
+class TestGraftEntryDeviceProvisioning:
+    def test_provision_cpu_devices_does_not_raise(self):
+        import importlib.util
+        import os
+        import sys
+
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        spec = importlib.util.spec_from_file_location(
+            "_graft_entry_test", os.path.join(root, "__graft_entry__.py")
+        )
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules["_graft_entry_test"] = mod
+        spec.loader.exec_module(mod)
+        # backends are already up in the test process: this must fall through
+        # the AttributeError/RuntimeError paths without raising
+        mod._provision_cpu_devices(1)

@@ -1,0 +1,28 @@
+"""Tier-1 doc-drift gate: the metric catalog and docs/metrics.md must agree
+in both directions (hack/check_metrics_docs.py)."""
+
+from __future__ import annotations
+
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(ROOT, "hack"))
+
+import check_metrics_docs  # noqa: E402
+
+
+def test_metrics_docs_current():
+    problems = check_metrics_docs.check()
+    assert problems == [], "\n".join(problems)
+
+
+def test_gate_catches_both_drift_directions(tmp_path):
+    # a doc missing a metric AND documenting a ghost metric both fail
+    doc = tmp_path / "metrics.md"
+    doc.write_text("| `karpenter_tpu_no_such_metric` | Counter | ghost |\n")
+    documented = check_metrics_docs.documented_metrics(str(doc))
+    assert documented == ["karpenter_tpu_no_such_metric"]
+    catalog = check_metrics_docs.cataloged_metrics()
+    assert "karpenter_tpu_decisions_total" in catalog
+    assert all(help_text.strip() for help_text in catalog.values())
