@@ -1,0 +1,84 @@
+"""Debug-endpoint/doc drift gate — every ``/debug/*`` route must be documented.
+
+The operator's HTTP surface (``karpenter_tpu/utils/httpserver.py``) is the
+operator's primary debugging interface; a route that exists but is absent
+from ``docs/observability.md`` is a feature nobody will find. This gate
+checks, in BOTH directions, that the routes registered on the HTTP handler
+and the endpoints documented in the runbook agree:
+
+* every ``/debug/*`` route string in the handler appears in
+  ``docs/observability.md``;
+* every ``/debug/*`` path mentioned in the doc still exists in the handler
+  (a removed route must take its doc with it).
+
+Wired as a tier-1 test (``tests/test_debug_endpoints_docs.py``) like the
+metrics gate, and runnable standalone::
+
+    python hack/check_debug_endpoints.py   # exits 1 and prints the drift
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+from typing import List, Set
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+SERVER = os.path.join(ROOT, "karpenter_tpu", "utils", "httpserver.py")
+DOC = os.path.join(ROOT, "docs", "observability.md")
+
+#: a /debug route literal in the handler (string compares / startswith
+#: prefixes both match; the trailing slash of a prefix route is stripped)
+_ROUTE = re.compile(r'"(/debug/[a-z_]+)/?"')
+
+
+def registered_routes(path: str = SERVER) -> Set[str]:
+    with open(path) as f:
+        source = f.read()
+    return set(_ROUTE.findall(source))
+
+
+def documented_routes(path: str = DOC) -> Set[str]:
+    if not os.path.exists(path):
+        return set()
+    with open(path) as f:
+        text = f.read()
+    return set(_ROUTE.findall(text)) | set(
+        re.findall(r"`(/debug/[a-z_]+)", text)
+    )
+
+
+def check() -> List[str]:
+    """Every drift problem as a human-readable line; empty means clean."""
+    registered = registered_routes()
+    documented = documented_routes()
+    problems = []
+    for route in sorted(registered - documented):
+        problems.append(
+            f"route {route} is served by utils/httpserver.py but not "
+            "documented in docs/observability.md"
+        )
+    for route in sorted(documented - registered):
+        problems.append(
+            f"docs/observability.md documents {route} which is not "
+            "registered on the HTTP surface"
+        )
+    return problems
+
+
+def main() -> int:
+    problems = check()
+    for p in problems:
+        print(f"DRIFT: {p}", file=sys.stderr)
+    if problems:
+        return 1
+    print(
+        f"debug endpoint docs current: {len(registered_routes())} routes checked"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

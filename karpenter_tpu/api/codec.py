@@ -36,20 +36,36 @@ _POS_INF = float("inf")
 
 
 # -- leaves -----------------------------------------------------------------
+#
+# Encoders are SPARSE where the decoder's default equals the omitted value:
+# empty label maps, zero priorities, default phases etc. stay off the wire.
+# Every decoder already tolerates absence (``d.get(key, default)``), so the
+# round trip is unchanged — what changes is cost: pods dominate both the
+# apiserver payloads and the flight recorder's per-reconcile input capture,
+# and a minimal pod's wire shrinks from ~27 entries to ~6. Fields whose
+# empty value is semantically DISTINCT from absent (e.g. a provisioner's
+# ``limits={}`` vs ``limits=None`` — the solver's provisioner signature
+# tells them apart) are never pruned.
 
 def _meta_to(m: ObjectMeta) -> Dict:
-    return {
-        "name": m.name,
-        "namespace": m.namespace,
-        "uid": m.uid,
-        "labels": dict(m.labels),
-        "annotations": dict(m.annotations),
-        "finalizers": list(m.finalizers),
-        "creationTimestamp": m.creation_timestamp,
-        "deletionTimestamp": m.deletion_timestamp,
-        "ownerKind": m.owner_kind,
-        "resourceVersion": m.resource_version,
-    }
+    out = {"name": m.name, "resourceVersion": m.resource_version}
+    if m.namespace != "default":
+        out["namespace"] = m.namespace
+    if m.uid:
+        out["uid"] = m.uid
+    if m.labels:
+        out["labels"] = dict(m.labels)
+    if m.annotations:
+        out["annotations"] = dict(m.annotations)
+    if m.finalizers:
+        out["finalizers"] = list(m.finalizers)
+    if m.creation_timestamp:
+        out["creationTimestamp"] = m.creation_timestamp
+    if m.deletion_timestamp is not None:
+        out["deletionTimestamp"] = m.deletion_timestamp
+    if m.owner_kind is not None:
+        out["ownerKind"] = m.owner_kind
+    return out
 
 
 def _meta_from(d: Dict) -> ObjectMeta:
@@ -153,17 +169,21 @@ def _kubelet_from(d: Optional[Dict]) -> KubeletConfiguration:
 # -- kinds ------------------------------------------------------------------
 
 def pod_to_wire(p: Pod) -> Dict:
-    return {
-        "meta": _meta_to(p.meta),
-        "requests": _resources_to(p.requests),
-        "nodeSelector": dict(p.node_selector),
-        "requiredAffinityTerms": [_reqs_to(t) for t in p.required_affinity_terms],
-        "preferredAffinityTerms": [
+    out = {"meta": _meta_to(p.meta), "requests": _resources_to(p.requests)}
+    if p.node_selector:
+        out["nodeSelector"] = dict(p.node_selector)
+    if p.required_affinity_terms:
+        out["requiredAffinityTerms"] = [_reqs_to(t) for t in p.required_affinity_terms]
+    if p.preferred_affinity_terms:
+        out["preferredAffinityTerms"] = [
             [w, _reqs_to(t)] for w, t in p.preferred_affinity_terms
-        ],
-        "volumeZones": list(p.volume_zones),
-        "tolerations": [_tol_to(t) for t in p.tolerations],
-        "topologySpread": [
+        ]
+    if p.volume_zones:
+        out["volumeZones"] = list(p.volume_zones)
+    if p.tolerations:
+        out["tolerations"] = [_tol_to(t) for t in p.tolerations]
+    if p.topology_spread:
+        out["topologySpread"] = [
             {
                 "maxSkew": c.max_skew,
                 "topologyKey": c.topology_key,
@@ -171,20 +191,25 @@ def pod_to_wire(p: Pod) -> Dict:
                 "labelSelector": dict(c.label_selector),
             }
             for c in p.topology_spread
-        ],
-        "affinityTerms": [
+        ]
+    if p.affinity_terms:
+        out["affinityTerms"] = [
             {
                 "labelSelector": dict(t.label_selector),
                 "topologyKey": t.topology_key,
                 "anti": t.anti,
             }
             for t in p.affinity_terms
-        ],
-        "priority": p.priority,
-        "nodeName": p.node_name,
-        "phase": p.phase,
-        "isDaemonset": p.is_daemonset,
-    }
+        ]
+    if p.priority:
+        out["priority"] = p.priority
+    if p.node_name is not None:
+        out["nodeName"] = p.node_name
+    if p.phase != "Pending":
+        out["phase"] = p.phase
+    if p.is_daemonset:
+        out["isDaemonset"] = p.is_daemonset
+    return out
 
 
 def pod_from_wire(d: Dict) -> Pod:
@@ -223,16 +248,20 @@ def pod_from_wire(d: Dict) -> Pod:
 
 
 def node_to_wire(n: Node) -> Dict:
-    return {
+    out = {
         "meta": _meta_to(n.meta),
         "providerId": n.provider_id,
         "capacity": _resources_to(n.capacity),
         "allocatable": _resources_to(n.allocatable),
-        "taints": [_taint_to(t) for t in n.taints],
-        "unschedulable": n.unschedulable,
         "ready": n.ready,
-        "machineName": n.machine_name,
     }
+    if n.taints:
+        out["taints"] = [_taint_to(t) for t in n.taints]
+    if n.unschedulable:
+        out["unschedulable"] = n.unschedulable
+    if n.machine_name is not None:
+        out["machineName"] = n.machine_name
+    return out
 
 
 def node_from_wire(d: Dict) -> Node:

@@ -54,6 +54,23 @@ class Settings:
     # scheduling-decision audit ring (utils/decisions.py, /debug/decisions):
     # most-recent records retained; 0 disables decision recording entirely
     decision_log_capacity: int = 2048
+    # reconcile flight recorder (utils/flightrecorder.py,
+    # /debug/flightrecorder): bounded ring of per-reconcile capsules — the
+    # complete round input (cluster snapshot, instance-type/offering lists
+    # with ICE state, settings) plus recorded outputs (problem digests,
+    # actions, decisions) for offline replay via `python -m
+    # karpenter_tpu.replay`. 0 disables recording entirely.
+    flight_recorder_capacity: int = 32
+    # directory capsules are dumped to (gzip JSON) on anomaly triggers —
+    # reconcile error, unschedulable pods, full-encode fallback, breaker
+    # open — and on-demand via /debug/flightrecorder/<id>?dump=1. Empty
+    # disables automatic dumping (capsules stay fetchable over HTTP).
+    flight_recorder_dump_dir: str = ""
+    # runtime-health memory profiling (utils/runtimehealth.py): turns
+    # tracemalloc on and exports the top allocation sites as
+    # karpenter_tpu_tracemalloc_top_bytes — measurable overhead, off by
+    # default; karpenter_tpu_process_memory_bytes is always exported.
+    memory_profiling_enabled: bool = False
 
     def validate(self) -> None:
         if not self.cluster_name:
@@ -83,6 +100,10 @@ class Settings:
         if self.decision_log_capacity < 0:
             raise ValueError(
                 "decisionLogCapacity must be >= 0 (0 disables decision recording)"
+            )
+        if self.flight_recorder_capacity < 0:
+            raise ValueError(
+                "flightRecorderCapacity must be >= 0 (0 disables the flight recorder)"
             )
 
     # -- config system (reference: karpenter-global-settings ConfigMap,

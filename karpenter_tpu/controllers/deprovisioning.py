@@ -120,6 +120,11 @@ class DeprovisioningController:
         self.sweep_workers = default_workers(self.settings.consolidation_sweep_workers)
         self._worker_solvers: Optional[List[tuple]] = None  # lazy clones
         self.pending_action: Optional[PlannedAction] = None
+        # machine-name sequence override (replay harness; None = global)
+        self.machine_ids = None
+        # flight-recorder round state (set per reconcile pass)
+        self._capsule = None
+        self._planned_this_round: Optional[PlannedAction] = None
         # sweep-scoped existing-capacity snapshot (see _consolidation)
         self._sweep_capacity = None
         # sweep-scoped bound-pod and daemonset views from the same snapshot:
@@ -137,19 +142,78 @@ class DeprovisioningController:
 
     # ------------------------------------------------------------------
     def reconcile(self) -> Optional[PlannedAction]:
-        """One orchestrator pass. Returns the action executed this pass (if any)."""
+        """One orchestrator pass. Returns the action executed this pass (if
+        any). Noteworthy passes — an action executed, a plan parked for the
+        validation TTL, or a matured plan aborted — commit a flight-recorder
+        capsule whose inputs were captured BEFORE execution mutated the
+        cluster, so the pass replays offline (karpenter_tpu/replay.py)."""
+        from ..utils.flightrecorder import FLIGHT
+
+        cap = FLIGHT.begin("deprovisioning")
+        self._capsule = cap
+        self._planned_this_round = None
+        try:
+            action = self._reconcile()
+            if cap is not None and cap.captured:
+                cap.set_outputs_action(action, planned=self._planned_this_round)
+        except BaseException as e:
+            # finish() must ALWAYS run (it releases the builder's thread-
+            # local decision tee), whatever escapes the pass
+            if cap is not None:
+                cap.finish(error=e)
+            raise
+        finally:
+            self._capsule = None
+        if cap is not None:
+            cap.finish()
+        return action
+
+    def _capture_round_input(self, had_pending: Optional[PlannedAction] = None) -> None:
+        """Capture the capsule input at the decision point (idle sweeps never
+        pay for a snapshot): the cluster as the planner saw it, per-
+        provisioner instance types, the pinned clock, and the stabilization
+        state replay needs to reproduce the window check."""
+        cap = self._capsule
+        if cap is None or cap.captured:
+            return
+        from ..utils.flightrecorder import action_to_wire
+
+        now = self.clock.now()
+        window = self.settings.stabilization_window
+        remaining = (
+            max(0.0, window - (now - self._last_node_change)) if window > 0 else 0.0
+        )
+        cap.capture_inputs(
+            cluster=self.cluster,
+            provisioner_types=[
+                (p, self.provider.get_instance_types(p))
+                for p in self.cluster.provisioners.values()
+            ],
+            settings=self.settings,
+            provider=self.provider,
+            solver=self.solver,
+            clock_now=now,
+            extra={
+                "stabilization_remaining": remaining,
+                "had_pending_action": action_to_wire(had_pending),
+            },
+        )
+
+    def _reconcile(self) -> Optional[PlannedAction]:
         if self.pending_action is not None:
             return self._maybe_execute_pending()
 
         for method in (self._expiration, self._drift, self._emptiness, self._consolidation):
             action = method()
             if action is not None:
+                self._capture_round_input()
                 action.created = self.clock.now()
                 if self.settings.consolidation_validation_ttl > 0 and action.reason.startswith(
                     "consolidation"
                 ):
                     # plan now, validate after the TTL window (15s semantics)
                     self.pending_action = action
+                    self._planned_this_round = action
                     self.recorder.publish(
                         "DeprovisioningPlanned", f"{action.reason}: {action.nodes}",
                         object_kind="Deprovisioner",
@@ -170,6 +234,9 @@ class DeprovisioningController:
         if self.clock.now() - action.created < self.settings.consolidation_validation_ttl:
             return None  # still inside the validation window
         self.pending_action = None
+        # matured plan: capture the pre-validation cluster — both the abort
+        # and the execute verdict are worth replaying
+        self._capture_round_input(had_pending=action)
         if not self._still_valid(action):
             self.recorder.publish(
                 "DeprovisioningAborted", f"{action.reason} invalidated during validation window",
@@ -682,7 +749,7 @@ class DeprovisioningController:
             )
             launch_from_spec(
                 self.cluster, self.provider, replacement, requests,
-                retry_policy=self.retry_policy,
+                retry_policy=self.retry_policy, machine_ids=self.machine_ids,
             )
         for name in action.nodes:
             self.termination.delete_node(name)

@@ -62,6 +62,14 @@ class SingletonController:
         now = self._clock() if now is None else now
         if now < self._next:
             return False
+        if self.interval > 0 and self._next > 0:
+            # scheduled-vs-actual start delta: how late the loop got to a due
+            # controller. Interval-0 controllers are skipped — they are due
+            # every tick by design, so their delta would just re-report the
+            # run loop's sleep as a permanent false "lag" floor.
+            metrics.RECONCILE_LOOP_LAG.set(
+                max(0.0, now - self._next), {"controller": self.name}
+            )
         reconcile_id = f"{self.name}.{next(_reconcile_seq)}"
         try:
             with log_context(reconcile_id=reconcile_id), \

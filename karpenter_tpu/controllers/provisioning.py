@@ -15,7 +15,6 @@ failed offerings.
 
 from __future__ import annotations
 
-import itertools
 import time
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -38,7 +37,30 @@ from ..utils.decisions import DECISIONS
 from ..utils.events import Recorder
 from ..utils.resilience import RetryPolicy, retry_policy_from_settings
 
-_machine_ids = itertools.count(1)
+class MachineNameSeq:
+    """Monotonic machine-name counter. Not a bare ``itertools.count``: the
+    flight recorder snapshots the upcoming value per capsule (``peek``) and
+    the replay harness launches from a PRIVATE sequence pinned to it — a
+    node launched mid-round enters later solve rounds' problem digests by
+    NAME, so replayed names must reproduce the recorded ones exactly."""
+
+    def __init__(self, start: int = 1):
+        import threading
+
+        self._n = start
+        self._lock = threading.Lock()
+
+    def next(self) -> int:
+        with self._lock:
+            n = self._n
+            self._n += 1
+            return n
+
+    def peek(self) -> int:
+        return self._n
+
+
+_machine_ids = MachineNameSeq()
 
 
 class PodBatcher:
@@ -106,6 +128,9 @@ class ProvisioningController:
         # retry in-round with jittered backoff instead of failing the whole
         # reconcile and stalling on the kit's loop-level backoff
         self.retry_policy = retry_policy_from_settings(self.settings)
+        # machine-name sequence; the replay harness pins a private one to
+        # the recorded capsule's snapshot so launched-node names reproduce
+        self.machine_ids: Optional[MachineNameSeq] = None
         self._pending_seen: set = set()
         # delta-aware encoder state: watch events below feed its dirty sets,
         # so steady-state reconciles patch the previous round's encoding
@@ -150,12 +175,30 @@ class ProvisioningController:
 
     # -- the reconcile loop body -------------------------------------------
     def reconcile(self) -> ProvisioningResult:
+        from ..utils.flightrecorder import FLIGHT
         from ..utils.tracing import span
 
         with span("provisioning.reconcile"):
-            return self._reconcile()
+            # flight-recorder capsule: inputs captured inside _reconcile
+            # (before the first solve), outputs + anomaly triggers stamped
+            # here; an idle round that captured nothing is dropped silently
+            cap = FLIGHT.begin("provisioning")
+            if cap is None:
+                return self._reconcile(None)
+            try:
+                result = self._reconcile(cap)
+                if cap.captured:
+                    cap.set_outputs_provisioning(result, self.cluster)
+            except BaseException as e:
+                # finish() must ALWAYS run (it releases the builder's
+                # thread-local decision tee) — including for BaseExceptions
+                # like KeyboardInterrupt that the operator loop survives
+                cap.finish(error=e)
+                raise
+            cap.finish()
+            return result
 
-    def _reconcile(self) -> ProvisioningResult:
+    def _reconcile(self, cap=None) -> ProvisioningResult:
         t0 = time.perf_counter()
         batch_gen = self.batcher.generation
         pods = self.cluster.pending_pods()
@@ -208,6 +251,15 @@ class ProvisioningController:
                 (p, self.provider.get_instance_types(p))
                 for p in provisioners if p.name not in exhausted
             ]
+            if cap is not None and round_no == 0:
+                # complete round input, captured BEFORE anything mutates:
+                # the instance-type lists carry the ICE mask as offering
+                # availability, so replay solves against the same catalog
+                cap.capture_inputs(
+                    cluster=self.cluster, provisioner_types=round_provs,
+                    settings=self.settings, provider=self.provider,
+                    solver=self.solver,
+                )
             if not round_provs or not batch:
                 for p in batch:
                     result.unschedulable.append(p.name)
@@ -229,6 +281,19 @@ class ProvisioningController:
             )
             if result.solve is None:
                 result.solve = solve
+                if cap is not None:
+                    # the canonical pod order the session actually encoded —
+                    # a replay's from-scratch encode of exactly this order is
+                    # digest-identical to this round's (delta) encode
+                    cap.set_batch_order(
+                        [p.meta.name for p in self.encode_session.ordered_pods()]
+                    )
+                    cap.note_encode_mode(
+                        self.encode_session.last_mode,
+                        self.encode_session.last_full_reason,
+                    )
+            if cap is not None:
+                cap.add_digest(solve.problem_digest)
             metrics.SOLVE_DURATION.observe(solve.stats.get("total_s", 0.0))
             limit_hit, ice_failed = self._apply_solve(solve, result, round_provs)
             retry_ice = bool(ice_failed) and ice_retries < self._ICE_RETRIES
@@ -418,7 +483,7 @@ class ProvisioningController:
         requests = merge([self._pod_requests(n) for n in spec.pod_names])
         return launch_from_spec(
             self.cluster, self.provider, spec, requests, create_fn=create_fn,
-            retry_policy=self.retry_policy,
+            retry_policy=self.retry_policy, machine_ids=self.machine_ids,
         )
 
     def _launch_all(self, specs: List[NewNodeSpec]) -> List[object]:
@@ -563,6 +628,7 @@ def launch_from_spec(
     requests: Resources,
     create_fn=None,
     retry_policy: Optional[RetryPolicy] = None,
+    machine_ids: Optional[MachineNameSeq] = None,
 ) -> Tuple[Machine, Node]:
     """Launch one machine for a solver node spec and register its node. Shared by
     the provisioning loop and consolidation replacements (which the reference also
@@ -573,7 +639,7 @@ def launch_from_spec(
     the ICE cache plus the in-provider fallback walk own that path."""
     option = spec.option
     prov = option.provisioner
-    name = f"{prov.name}-{next(_machine_ids)}"
+    name = f"{prov.name}-{(machine_ids or _machine_ids).next()}"
     machine = Machine(
         meta=ObjectMeta(name=name, labels=dict(prov.labels)),
         provisioner_name=prov.name,

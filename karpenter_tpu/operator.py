@@ -85,6 +85,19 @@ class Operator:
         from .utils.decisions import DECISIONS
 
         DECISIONS.configure(settings.decision_log_capacity)
+        # reconcile flight recorder: capsule ring capacity + anomaly dump
+        # target from settings (0 disables capture entirely)
+        from .utils.flightrecorder import FLIGHT
+
+        FLIGHT.configure(
+            settings.flight_recorder_capacity,
+            dump_dir=settings.flight_recorder_dump_dir or None,
+        )
+        # runtime-health gauges: process RSS always; tracemalloc top
+        # allocators only when the (costly) profiling setting asks for it
+        from .utils import runtimehealth
+
+        runtimehealth.install(memory_profiling=settings.memory_profiling_enabled)
         solver = solver or TPUSolver()
         provisioning = ProvisioningController(
             cluster, provider, solver=solver, settings=settings, recorder=recorder

@@ -1,0 +1,759 @@
+"""Offline deterministic replay of flight-recorder capsules.
+
+    python -m karpenter_tpu.replay capsule-provisioning.17.json.gz
+    python -m karpenter_tpu.replay <capsule> --explain pod=web-3
+    python -m karpenter_tpu.replay <capsule> --override settings.batch_max_duration=0 \
+        --override 'offerings=m5.large/us-east-1a/spot=unavailable'
+    python -m karpenter_tpu.replay <capsule> --override provisioner.default.limits.cpu=500
+
+Reconstructs the cluster exactly as the recorded reconcile saw it (objects at
+their captured resourceVersions, pods in the encode-canonical order, the
+instance-type/offering lists with the ICE mask baked in, the recorded
+settings), re-runs provisioning or consolidation through the **real solver
+with no network** — replay denies socket connects outright, the whole round
+runs against in-process state — and diffs the replayed problem digests,
+placements, and decision verdicts against the recorded ones. PR 3's
+delta-vs-full equivalence contract is what makes this sound: a round's
+(possibly delta) encode is digest-identical to a from-scratch encode of its
+canonical inputs, so byte-equal digests mean the replay solved the *same
+problem*, not a similar one.
+
+``--override`` turns the replay into a counterfactual ("would this pod have
+scheduled with a higher limit / without that ICE mask?"): the report then
+describes what WOULD have happened instead of asserting equality.
+
+Exit codes: 0 — replay matches the record (or ran as a counterfactual);
+2 — the replay diverged from the record; 1 — bad capsule / usage.
+"""
+
+from __future__ import annotations
+
+import argparse
+import gzip
+import itertools
+import json
+import socket
+import sys
+import threading
+from dataclasses import fields
+from typing import Dict, List, Optional, Sequence, Tuple
+
+_replay_seq = itertools.count(1)
+
+
+# ---------------------------------------------------------------------------
+# Capsule IO + overrides
+# ---------------------------------------------------------------------------
+
+def load_capsule(path: str) -> Dict:
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rt") as f:
+        return json.load(f)
+
+
+class OverrideError(ValueError):
+    pass
+
+
+def _coerce_like(current, raw: str):
+    if isinstance(current, bool):
+        if raw.lower() in ("1", "true", "yes", "on"):
+            return True
+        if raw.lower() in ("0", "false", "no", "off"):
+            return False
+        raise OverrideError(f"invalid boolean {raw!r}")
+    try:
+        if isinstance(current, float):
+            return float(raw)
+        if isinstance(current, int):
+            return int(raw)
+    except ValueError as e:
+        raise OverrideError(str(e)) from None
+    return raw
+
+
+def apply_overrides(capsule: Dict, overrides: Sequence[str]) -> Dict:
+    """Apply ``--override`` directives to a (deep-copied) capsule:
+
+    * ``settings.<field>=<value>`` — replay under different settings;
+    * ``offerings=<type>/<zone>/<ct>=available|unavailable|price:<x>`` —
+      flip an offering's availability (undo an ICE mask, simulate one) or
+      reprice it; ``*`` wildcards any path segment;
+    * ``provisioner.<name>.limits.<resource>=<quantity>`` — raise/lower a
+      provisioner's resource ceiling (``none`` removes all limits);
+    * ``provisioner.<name>.weight=<int>`` — re-rank the pool cascade.
+    """
+    import copy
+
+    capsule = copy.deepcopy(capsule)
+    inputs = capsule.setdefault("inputs", {})
+    for directive in overrides:
+        if "=" not in directive:
+            raise OverrideError(f"override {directive!r} is not key=value")
+        key, _, value = directive.partition("=")
+        if key.startswith("settings."):
+            field = key[len("settings."):]
+            settings = inputs.setdefault("settings", {})
+            if field not in settings:
+                raise OverrideError(f"unknown settings field {field!r}")
+            settings[field] = _coerce_like(settings[field], value)
+        elif key == "offerings":
+            _apply_offering_override(inputs, value)
+        elif key.startswith("provisioner."):
+            _apply_provisioner_override(inputs, key[len("provisioner."):], value)
+        else:
+            raise OverrideError(
+                f"unknown override {key!r} (use settings.*, offerings=..., "
+                "provisioner.<name>.*)"
+            )
+    return capsule
+
+
+def _apply_offering_override(inputs: Dict, spec: str) -> None:
+    sel, _, action = spec.rpartition("=")
+    parts = sel.split("/")
+    if len(parts) != 3 or not action:
+        raise OverrideError(
+            f"offerings override {spec!r} is not <type>/<zone>/<ct>=<action>"
+        )
+    it_name, zone, ct = parts
+    hit = 0
+    for types in inputs.get("instance_types", {}).values():
+        for it in types:
+            if it_name not in ("*", it["name"]):
+                continue
+            for o in it.get("offerings", []):
+                if zone not in ("*", o["zone"]):
+                    continue
+                if ct not in ("*", o["capacityType"]):
+                    continue
+                hit += 1
+                if action == "available":
+                    o["available"] = True
+                elif action == "unavailable":
+                    o["available"] = False
+                elif action.startswith("price:"):
+                    try:
+                        o["price"] = float(action[len("price:"):])
+                    except ValueError as e:
+                        raise OverrideError(str(e)) from None
+                else:
+                    raise OverrideError(f"unknown offering action {action!r}")
+    if hit == 0:
+        raise OverrideError(f"offerings override {spec!r} matched nothing")
+
+
+def _apply_provisioner_override(inputs: Dict, path: str, value: str) -> None:
+    from .api.resources import parse_quantity
+
+    parts = path.split(".")
+    name = parts[0]
+    target = None
+    for wire in inputs.get("objects", {}).get("provisioners", []):
+        if wire["meta"]["name"] == name:
+            target = wire
+            break
+    if target is None:
+        raise OverrideError(f"no provisioner {name!r} in the capsule")
+    if len(parts) == 3 and parts[1] == "limits":
+        if value.lower() == "none":
+            # remove ONLY the named resource's ceiling; the others stand
+            limits = dict(target.get("limits") or {})
+            limits.pop(parts[2], None)
+            target["limits"] = limits or None
+        else:
+            limits = dict(target.get("limits") or {})
+            try:
+                limits[parts[2]] = float(parse_quantity(value))
+            except (ValueError, TypeError) as e:
+                raise OverrideError(str(e)) from None
+            target["limits"] = limits
+    elif len(parts) == 2 and parts[1] == "limits" and value.lower() == "none":
+        target["limits"] = None
+    elif len(parts) == 2 and parts[1] == "weight":
+        try:
+            target["weight"] = int(value)
+        except ValueError as e:
+            raise OverrideError(str(e)) from None
+    else:
+        raise OverrideError(
+            f"unsupported provisioner override {path!r} "
+            "(limits.<resource>=<qty>|none, weight=<int>)"
+        )
+
+
+# ---------------------------------------------------------------------------
+# Reconstruction
+# ---------------------------------------------------------------------------
+
+def settings_from_wire(d: Dict):
+    from .api.settings import Settings
+
+    known = {f.name for f in fields(Settings)}
+    s = Settings(**{k: v for k, v in (d or {}).items() if k in known})
+    s.validate()
+    return s
+
+
+def build_cluster(capsule: Dict):
+    """In-process cluster, byte-faithful to the capsule: every kind in its
+    captured order, except the batch pods, which append LAST in the recorded
+    encode-canonical order — ``pending_pods()`` then yields exactly the
+    sequence the session encoded, so the replay's from-scratch full encode
+    is digest-identical to the recorded round's."""
+    from .api import codec
+    from .state.cluster import Cluster
+
+    objs = capsule.get("inputs", {}).get("objects", {})
+    cluster = Cluster()
+    adders = {
+        "nodetemplates": cluster.add_node_template,
+        "provisioners": cluster.add_provisioner,
+        "poddisruptionbudgets": cluster.add_pdb,
+        "nodes": cluster.add_node,
+        "machines": cluster.add_machine,
+    }
+    for kind, add in adders.items():
+        for wire in objs.get(kind, []):
+            add(codec.from_wire(kind, wire))
+    batch_order = capsule.get("inputs", {}).get("batch_order") or []
+    batch = set(batch_order)
+    pod_wires = {w["meta"]["name"]: w for w in objs.get("pods", [])}
+    for name, wire in pod_wires.items():
+        if name not in batch:
+            cluster.add_pod(codec.from_wire("pods", wire))
+    for name in batch_order:
+        wire = pod_wires.get(name)
+        if wire is not None:
+            cluster.add_pod(codec.from_wire("pods", wire))
+    return cluster
+
+
+class CapsuleCloudProvider:
+    """A CloudProvider serving exactly the capsule's instance-type lists —
+    the capture-time ICE mask included as offering availability — and
+    launching machines in-process (FakeCloudProvider mechanics, zero
+    network).
+
+    Mid-round ICE churn replays too: the offerings whose launches failed
+    with insufficient capacity in the RECORDED round (``nomination`` /
+    ``ice-failed`` decisions) are pre-seeded into the fake's ICE pools, so
+    the same launch fails, the same in-round re-solve runs, and the
+    refreshed round-N catalog is the recorded round-0 catalog plus exactly
+    those masks — the same delta the live provider served."""
+
+    def __new__(cls, capsule: Dict):
+        from .cloudprovider.fake import FakeCloudProvider
+        from .cloudprovider.types import instance_type_from_wire
+
+        per_prov: Dict[str, list] = {}
+        union: Dict[str, object] = {}
+        for pname, wires in capsule.get("inputs", {}).get("instance_types", {}).items():
+            types = [instance_type_from_wire(w) for w in wires]
+            per_prov[pname] = types
+            for it in types:
+                union.setdefault(it.name, it)
+
+        class _Provider(FakeCloudProvider):
+            def get_instance_types(self, provisioner=None):
+                key = provisioner.name if provisioner is not None else None
+                base = per_prov.get(key) if key is not None else list(union.values())
+                if base is None:
+                    return super().get_instance_types(provisioner)
+                seq = self.unavailable_offerings.seqnum
+                if seq == 0:
+                    return base  # round 0: the recorded lists, verbatim
+                cached = self._replay_it_cache.get(key)
+                if cached is not None and cached[0] == seq:
+                    return cached[1]
+                # in-round ICE marks re-mask the recorded catalog exactly as
+                # the live provider's seqnum-keyed cache did
+                from .cloudprovider.types import Offering
+
+                out = [
+                    it.with_offerings([
+                        Offering(
+                            zone=o.zone, capacity_type=o.capacity_type,
+                            price=o.price,
+                            available=o.available
+                            and not self.unavailable_offerings.is_unavailable(
+                                it.name, o.zone, o.capacity_type
+                            ),
+                        )
+                        for o in it.offerings
+                    ])
+                    for it in base
+                ]
+                self._replay_it_cache[key] = (seq, out)
+                return out
+
+        provider = _Provider(catalog=list(union.values()))
+        provider._replay_it_cache = {}
+        for d in capsule.get("outputs", {}).get("decisions", []):
+            if d.get("kind") == "nomination" and d.get("outcome") == "ice-failed":
+                det = d.get("details", {})
+                if det.get("instance_type") and det.get("zone"):
+                    provider.set_insufficient_capacity(
+                        det["instance_type"], det["zone"],
+                        det.get("capacity_type", ""),
+                    )
+        return provider
+
+
+class _DigestTapSolver:
+    """Solver proxy collecting the per-round problem digests the recorded
+    controller captured, so the two sequences compare 1:1."""
+
+    def __init__(self, inner):
+        self._inner = inner
+        self.digests: List[str] = []
+
+    def solve_pods(self, *args, **kwargs):
+        result = self._inner.solve_pods(*args, **kwargs)
+        self.digests.append(result.problem_digest)
+        return result
+
+    def solve(self, problem):
+        return self._inner.solve(problem)
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+def _make_solver(capsule: Dict, name: Optional[str] = None):
+    from .solver.solver import GreedySolver, TPUSolver
+
+    name = name or capsule.get("solver", "TPUSolver")
+    by_name = {
+        "TPUSolver": TPUSolver, "tpu": TPUSolver,
+        "GreedySolver": GreedySolver, "greedy": GreedySolver,
+    }
+    return by_name.get(name, TPUSolver)()
+
+
+class _NoNetwork:
+    """Replay runs fully offline: any socket connect ON THE REPLAY THREAD is
+    a bug, denied loudly. (The reconstruction path never imports the HTTP
+    clients, but a guard beats a convention.)
+
+    The deny is per-thread, not process-wide: replaying inside a live
+    operator must not break the watch thread's reconnects or any concurrent
+    reconcile's HTTP calls. The connect stub is installed once (refcounted
+    under a lock, so concurrent replays cannot race the save/restore) and
+    passes every non-guarded thread straight through."""
+
+    _lock = threading.Lock()
+    _guarded: set = set()
+    _orig = None
+
+    def __enter__(self):
+        cls = _NoNetwork
+        with cls._lock:
+            if not cls._guarded:
+                cls._orig = orig = socket.socket.connect
+
+                # orig is a CLOSURE local, not read off the class at call
+                # time: an in-flight stub call on another thread must keep
+                # working even while __exit__ restores the real connect
+                def connect(sock, *a, **k):
+                    if threading.get_ident() in cls._guarded:
+                        raise RuntimeError(
+                            "network call during offline replay — capsules "
+                            "must replay with zero network I/O"
+                        )
+                    return orig(sock, *a, **k)
+
+                socket.socket.connect = connect
+            cls._guarded.add(threading.get_ident())
+        return self
+
+    def __exit__(self, *exc):
+        cls = _NoNetwork
+        with cls._lock:
+            cls._guarded.discard(threading.get_ident())
+            if not cls._guarded and cls._orig is not None:
+                socket.socket.connect = cls._orig
+        return False
+
+
+# ---------------------------------------------------------------------------
+# Replay + diff
+# ---------------------------------------------------------------------------
+
+def _decision_keys(decisions: List[Dict]) -> List[Tuple]:
+    """Replay-comparable decision identity: kind/outcome/pod (+reason for
+    unschedulable verdicts). Node and machine names are process-local."""
+    out = []
+    for d in decisions:
+        key = [d.get("kind", ""), d.get("outcome", ""), d.get("pod", "")]
+        if d.get("outcome") == "unschedulable":
+            key.append(d.get("reason", ""))
+        out.append(tuple(key))
+    return sorted(out)
+
+
+def _placement_key(entry: Dict) -> Tuple:
+    if entry.get("existing"):
+        return ("existing", entry.get("node", ""))
+    return (
+        "new",
+        entry.get("instance_type", ""),
+        entry.get("zone", ""),
+        entry.get("capacity_type", ""),
+    )
+
+
+def replay_capsule(
+    capsule: Dict,
+    overrides: Sequence[str] = (),
+    forbid_network: bool = True,
+    solver: Optional[str] = None,
+) -> Dict:
+    """Re-run the capsule's reconcile offline and diff against the record.
+    Returns the report dict (see module docstring for the CLI rendering)."""
+    from .utils import flightrecorder
+    from .utils.decisions import DecisionLog, redirect_decisions, tee_decisions
+    from .utils.logging import log_context
+
+    counterfactual = bool(overrides)
+    if overrides:
+        capsule = apply_overrides(capsule, overrides)
+    controller_kind = capsule.get("controller", "provisioning")
+    settings = settings_from_wire(capsule.get("inputs", {}).get("settings", {}))
+    rid = f"replay.{next(_replay_seq)}"
+    from contextlib import nullcontext
+
+    guard = _NoNetwork() if forbid_network else nullcontext()
+    # capture isolation: the replayed controllers must not record capsules OF
+    # the replay, and their DECISIONS writes land in a replay-private ring —
+    # a live operator's audit log sees no phantom "replay.N" verdicts, and
+    # concurrently-admitted live records cannot leak into this report.
+    # (Process-local metrics ARE still touched by a replayed round; run the
+    # CLI out-of-process when pristine gauges matter.)
+    replay_log = DecisionLog()
+    with guard, flightrecorder.suppressed(), redirect_decisions(replay_log), \
+            tee_decisions() as decision_tee, log_context(reconcile_id=rid):
+        cluster = build_cluster(capsule)
+        provider = CapsuleCloudProvider(capsule)
+        base_solver = _make_solver(capsule, solver)
+        tap = _DigestTapSolver(base_solver)
+        if controller_kind == "provisioning":
+            replayed = _replay_provisioning(capsule, cluster, provider, tap, settings)
+        else:
+            # the deprovisioner inspects its solver's concrete type (quality-
+            # budget race construction, per-worker clones): hand it the REAL
+            # solver, not the digest tap — deprov diffs compare actions, not
+            # digest sequences
+            replayed = _replay_deprovisioning(
+                capsule, cluster, provider, base_solver, settings
+            )
+        # the tee sees every admission in round order, immune to ring bounds
+        replayed["decisions"] = [r.to_dict() for r in decision_tee.records]
+        replayed["problem_digests"] = list(tap.digests)
+
+    recorded = capsule.get("outputs", {})
+    report: Dict = {
+        "capsule_id": capsule.get("id", ""),
+        "controller": controller_kind,
+        "counterfactual": counterfactual,
+        "replayed": replayed,
+        "recorded": {
+            k: recorded.get(k)
+            for k in ("problem_digests", "placements", "unschedulable",
+                      "action", "planned", "decisions")
+            if k in recorded
+        },
+    }
+    diffs: Dict = {}
+    if controller_kind == "provisioning":
+        rec_digests = recorded.get("problem_digests", [])
+        diffs["digests_match"] = rec_digests == replayed["problem_digests"]
+        rec_place = {
+            pod: _placement_key(e)
+            for pod, e in (recorded.get("placements") or {}).items()
+        }
+        rep_place = {
+            pod: _placement_key(e)
+            for pod, e in (replayed.get("placements") or {}).items()
+        }
+        diffs["placements_match"] = rec_place == rep_place
+        diffs["placement_diffs"] = {
+            pod: {"recorded": rec_place.get(pod), "replayed": rep_place.get(pod)}
+            for pod in set(rec_place) | set(rep_place)
+            if rec_place.get(pod) != rep_place.get(pod)
+        }
+        diffs["unschedulable_match"] = (
+            sorted(recorded.get("unschedulable", []))
+            == sorted(replayed.get("unschedulable", []))
+        )
+        rec_keys = _decision_keys(recorded.get("decisions", []))
+        rep_keys = _decision_keys(replayed.get("decisions", []))
+        diffs["decisions_match"] = rec_keys == rep_keys
+        report["match"] = (
+            diffs["digests_match"]
+            and diffs["placements_match"]
+            and diffs["unschedulable_match"]
+        )
+    else:
+        rec_action = recorded.get("action") or recorded.get("planned")
+        rep_action = replayed.get("action") or replayed.get("planned")
+        diffs["action_match"] = _actions_equal(rec_action, rep_action)
+        report["match"] = diffs["action_match"]
+    report["diffs"] = diffs
+    return report
+
+
+def _actions_equal(a: Optional[Dict], b: Optional[Dict]) -> bool:
+    if a is None or b is None:
+        return a is None and b is None
+    return (
+        a.get("reason") == b.get("reason")
+        and sorted(a.get("nodes", [])) == sorted(b.get("nodes", []))
+        and sorted(
+            (r["instance_type"], r["zone"], r["capacity_type"])
+            for r in a.get("replacements", [])
+        )
+        == sorted(
+            (r["instance_type"], r["zone"], r["capacity_type"])
+            for r in b.get("replacements", [])
+        )
+    )
+
+
+def _replay_provisioning(capsule, cluster, provider, solver, settings) -> Dict:
+    from .controllers.provisioning import MachineNameSeq, ProvisioningController
+    from .utils.flightrecorder import provisioning_outputs
+
+    controller = ProvisioningController(
+        cluster, provider, solver=solver, settings=settings
+    )
+    # launched-node names reproduce the recorded sequence (they feed later
+    # solve rounds' digests and the placement records)
+    controller.machine_ids = MachineNameSeq(capsule.get("machine_seq", 1))
+    result = controller.reconcile()
+    return provisioning_outputs(result, cluster)
+
+
+def _pending_action_from_wire(wire: Dict, cluster, provider, clock, settings):
+    """Rebuild the matured PlannedAction the recorded pass was validating —
+    replacements included (offering + provisioner + pod names are all in the
+    wire) — stamped old enough that the validation window has elapsed."""
+    from .api.resources import Resources
+    from .controllers.deprovisioning import PlannedAction
+    from .solver.encode import LaunchOption
+    from .solver.result import NewNodeSpec
+
+    replacements = []
+    for r in wire.get("replacements", []):
+        prov = cluster.provisioners.get(r.get("provisioner", ""))
+        it = next(
+            (t for t in provider.get_instance_types(prov)
+             if t.name == r["instance_type"]),
+            None,
+        )
+        if prov is None or it is None:
+            return None  # catalog/provisioner drifted out from under the plan
+        option = LaunchOption(
+            provisioner=prov, instance_type=it, zone=r["zone"],
+            capacity_type=r["capacity_type"], price=r.get("price", 0.0),
+            node_requirements=it.requirements, taints=tuple(prov.taints),
+            allocatable=it.allocatable(),
+        )
+        replacements.append(
+            NewNodeSpec(option=option, pod_names=list(r.get("pod_names", [])))
+        )
+    return PlannedAction(
+        reason=wire["reason"], nodes=list(wire.get("nodes", [])),
+        replacements=replacements,
+        created=clock.now() - settings.consolidation_validation_ttl - 1.0,
+        savings=wire.get("savings", 0.0),
+    )
+
+
+def _replay_deprovisioning(capsule, cluster, provider, solver, settings) -> Dict:
+    from .controllers.deprovisioning import DeprovisioningController
+    from .controllers.termination import TerminationController
+    from .utils.cache import FakeClock
+    from .utils.events import Recorder
+    from .utils.flightrecorder import action_to_wire
+
+    inputs = capsule.get("inputs", {})
+    clock = FakeClock(capsule.get("clock_now", 0.0))
+    recorder = Recorder()
+    termination = TerminationController(cluster, provider, recorder=recorder, clock=clock)
+    controller = DeprovisioningController(
+        cluster, provider, termination, solver=solver,
+        settings=settings, recorder=recorder, clock=clock,
+    )
+    from .controllers.provisioning import MachineNameSeq
+
+    controller.machine_ids = MachineNameSeq(capsule.get("machine_seq", 1))
+    notes: List[str] = []
+    had_pending = inputs.get("had_pending_action")
+    if had_pending:
+        # the recorded pass validated (then executed or aborted) a MATURED
+        # plan: reconstruct that exact plan and replay the SAME path —
+        # deriving a fresh plan from the (moved) cluster would compare
+        # apples to oranges whenever the cluster drifted during the TTL
+        controller.pending_action = _pending_action_from_wire(
+            had_pending, cluster, provider, clock, settings
+        )
+        if controller.pending_action is None:
+            # the captured catalog/provisioners no longer carry the plan's
+            # replacement: the replay falls back to fresh derivation — say
+            # so loudly, or an action_match=False here reads as solver
+            # non-determinism instead of what it is
+            notes.append(
+                "recorded pending plan not reconstructible from the capsule "
+                "catalog; replayed a FRESH derivation instead of the "
+                "matured-plan validation path"
+            )
+    remaining = float(inputs.get("stabilization_remaining", 0.0) or 0.0)
+    if remaining > 0:
+        controller._last_node_change = clock.now() - (
+            settings.stabilization_window - remaining
+        )
+    else:
+        controller._last_node_change = float("-inf")
+    action = controller.reconcile()
+    out = {
+        "action": action_to_wire(action),
+        "planned": action_to_wire(controller.pending_action),
+    }
+    if notes:
+        out["notes"] = notes
+    return out
+
+
+# ---------------------------------------------------------------------------
+# --explain rendering
+# ---------------------------------------------------------------------------
+
+def explain_pod(report: Dict, pod: str) -> str:
+    """Render the placement verdict + rejected-alternatives table for one pod
+    from the replayed decisions (fall back to the recorded ones)."""
+    for source, decisions in (
+        ("replayed", report.get("replayed", {}).get("decisions", [])),
+        ("recorded", report.get("recorded", {}).get("decisions", []) or []),
+    ):
+        records = [
+            d for d in decisions
+            if d.get("kind") == "placement" and d.get("pod") == pod
+        ]
+        if records:
+            return _render_placement(records[-1], source)
+    return f"no placement record for pod {pod!r} in this capsule"
+
+
+def _render_placement(rec: Dict, source: str) -> str:
+    details = rec.get("details", {})
+    lines = [f"pod {rec.get('pod')}: {rec.get('outcome')} ({source})"]
+    if rec.get("outcome") == "unschedulable":
+        lines.append(f"  reason: {rec.get('reason', '')}")
+        return "\n".join(lines)
+    if rec.get("node"):
+        lines.append(f"  node: {rec['node']}")
+    if details.get("instance_type"):
+        lines.append(
+            "  chosen: {it} / {zone} / {ct} @ ${price}/h".format(
+                it=details.get("instance_type"), zone=details.get("zone"),
+                ct=details.get("capacity_type"), price=details.get("price"),
+            )
+        )
+    alts = details.get("rejected_alternatives", [])
+    if alts:
+        lines.append("  rejected alternatives:")
+        header = f"    {'instance_type':<20} {'zone':<14} {'capacity_type':<14} {'price':>9}  reason"
+        lines.append(header)
+        for a in alts:
+            lines.append(
+                f"    {a.get('instance_type', ''):<20} {a.get('zone', ''):<14} "
+                f"{a.get('capacity_type', ''):<14} {a.get('price', 0):>9}  "
+                f"{a.get('reason', '')}"
+            )
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m karpenter_tpu.replay",
+        description="Replay a flight-recorder capsule offline and diff "
+                    "against the recorded round.",
+    )
+    ap.add_argument("capsule", help="path to a capsule (.json or .json.gz)")
+    ap.add_argument("--explain", default=None, metavar="pod=<name>",
+                    help="render the placement verdict + rejected-"
+                         "alternatives table for one pod")
+    ap.add_argument("--override", action="append", default=[],
+                    help="counterfactual knob (repeatable): settings.<f>=<v>, "
+                         "offerings=<type>/<zone>/<ct>=available|unavailable|"
+                         "price:<x>, provisioner.<name>.limits.<res>=<qty>, "
+                         "provisioner.<name>.weight=<n>")
+    ap.add_argument("--solver", default=None, choices=("tpu", "greedy"),
+                    help="override the recorded solver")
+    ap.add_argument("--json", action="store_true", help="emit the full report as JSON")
+    ap.add_argument("--allow-network", action="store_true",
+                    help="drop the zero-network guard (debugging only)")
+    args = ap.parse_args(argv)
+
+    try:
+        capsule = load_capsule(args.capsule)
+    except (OSError, ValueError) as e:
+        print(f"cannot load capsule: {e}", file=sys.stderr)
+        return 1
+    try:
+        report = replay_capsule(
+            capsule, overrides=args.override,
+            forbid_network=not args.allow_network, solver=args.solver,
+        )
+    except OverrideError as e:
+        print(f"bad override: {e}", file=sys.stderr)
+        return 1
+
+    if args.json:
+        print(json.dumps(report, indent=2, default=str))
+    else:
+        _print_summary(report)
+    if args.explain:
+        pod = args.explain.partition("=")[2] or args.explain
+        print()
+        print(explain_pod(report, pod))
+    if report.get("counterfactual"):
+        return 0
+    return 0 if report.get("match") else 2
+
+
+def _print_summary(report: Dict) -> None:
+    mode = "counterfactual" if report["counterfactual"] else "replay"
+    verdict = (
+        "MATCH" if report.get("match")
+        else ("DIVERGED" if not report["counterfactual"] else "—")
+    )
+    print(f"{mode} of capsule {report['capsule_id']} ({report['controller']}): {verdict}")
+    diffs = report.get("diffs", {})
+    if report["controller"] == "provisioning":
+        rec = report.get("recorded", {})
+        rep = report.get("replayed", {})
+        print(f"  digests: recorded={len(rec.get('problem_digests') or [])} "
+              f"replayed={len(rep.get('problem_digests') or [])} "
+              f"byte_equal={diffs.get('digests_match')}")
+        print(f"  placements: {len(rep.get('placements') or {})} pods, "
+              f"equal={diffs.get('placements_match')}")
+        for pod, d in sorted(diffs.get("placement_diffs", {}).items()):
+            print(f"    {pod}: recorded={d['recorded']} replayed={d['replayed']}")
+        print(f"  unschedulable: recorded={len(rec.get('unschedulable') or [])} "
+              f"replayed={len(rep.get('unschedulable') or [])} "
+              f"equal={diffs.get('unschedulable_match')}")
+        print(f"  decisions: equal={diffs.get('decisions_match')}")
+    else:
+        rep = report.get("replayed", {})
+        print(f"  action: {rep.get('action') or rep.get('planned')}")
+        print(f"  action_match={diffs.get('action_match')}")
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -113,6 +113,13 @@ class SolveResult:
     unschedulable: List[str] = field(default_factory=list)
     cost: float = 0.0  # total hourly price of new nodes
     stats: Dict[str, float] = field(default_factory=dict)
+    # hex sha256 of the (final) encoded problem this result decodes —
+    # ``solver.problem_digest`` of the problem actually solved, stamped by
+    # ``solve_pods``. The flight recorder captures it per round and the
+    # offline replay harness (karpenter_tpu/replay.py) asserts byte equality
+    # against the re-encoded capsule. Already computed for interning, so the
+    # stamp is free.
+    problem_digest: str = ""
 
     @property
     def scheduled_count(self) -> int:

@@ -525,6 +525,10 @@ class Solver(abc.ABC):
         result.stats["encode_s"] = encode_s
         result.stats["total_s"] = time.perf_counter() - t0
         result.stats["lower_bound"] = lower_bound(problem)
+        # digest of the problem the returned result actually decodes (the
+        # relax/degate paths may have replaced the initial encode): cached by
+        # interning on the common path, so the stamp costs a dict lookup
+        result.problem_digest = problem_digest(problem).hex()
         return result
 
 

@@ -55,6 +55,11 @@ class StateSnapshot:
     machines: Tuple[Machine, ...]
     provisioners: Tuple[Provisioner, ...]
     resource_version: int = 0
+    # the config kinds ride the same locked read: the flight recorder's
+    # capsule capture must see ONE store version across every kind, not a
+    # snapshot torn by a concurrent watch-thread write
+    node_templates: Tuple[NodeTemplate, ...] = ()
+    pdbs: Tuple[PodDisruptionBudget, ...] = ()
 
     def pods_by_node(self) -> Dict[str, List[Pod]]:
         out: Dict[str, List[Pod]] = {}
@@ -178,6 +183,8 @@ class Cluster:
                 machines=tuple(self.machines.values()),
                 provisioners=tuple(self.provisioners.values()),
                 resource_version=self._version,
+                node_templates=tuple(self.node_templates.values()),
+                pdbs=tuple(self.pdbs.values()),
             )
 
     def pending_pods(self) -> List[Pod]:

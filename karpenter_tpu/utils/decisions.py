@@ -48,6 +48,62 @@ from typing import Deque, Dict, List, Optional
 from . import metrics, tracing
 from .logging import context_fields
 
+#: thread-local write redirect: the replay harness re-runs real controllers,
+#: whose module-level ``DECISIONS.record(...)`` calls would otherwise write
+#: phantom verdicts into the LIVE audit ring (and concurrently-admitted live
+#: records would leak into the replay's capture window)
+_redirect = threading.local()
+
+#: thread-local tee: callers that need EVERY record a round admits — the
+#: flight recorder's capsule assembly — collect into side buffers, immune to
+#: ring eviction (a 5k-pod round overflows a 2048 ring before the round
+#: ends) and to records admitted concurrently from other threads
+_tee = threading.local()
+
+
+class tee_decisions:
+    """Collect every record THIS thread admits (through any DecisionLog)
+    into a list for the duration. Stacks; coalesced bumps of pre-existing
+    records are not re-collected (they are not new admissions)."""
+
+    def __init__(self):
+        self.records: List[DecisionRecord] = []
+
+    def __enter__(self) -> "tee_decisions":
+        bufs = getattr(_tee, "bufs", None)
+        if bufs is None:
+            bufs = _tee.bufs = []
+        bufs.append(self.records)
+        return self
+
+    def __exit__(self, *exc):
+        # remove by IDENTITY, not list.remove()'s == matching: two stacked
+        # empty buffers are value-equal, and popping the wrong one would
+        # silently detach the outer tee
+        bufs = getattr(_tee, "bufs", None)
+        if bufs is not None:
+            for i, buf in enumerate(bufs):
+                if buf is self.records:
+                    del bufs[i]
+                    break
+        return False
+
+
+class redirect_decisions:
+    """Route this thread's DECISIONS writes into ``log`` for the duration."""
+
+    def __init__(self, log: "DecisionLog"):
+        self._log = log
+
+    def __enter__(self) -> "DecisionLog":
+        self._prev = getattr(_redirect, "log", None)
+        _redirect.log = self._log
+        return self._log
+
+    def __exit__(self, *exc):
+        _redirect.log = self._prev
+        return False
+
 
 @dataclass
 class DecisionRecord:
@@ -124,7 +180,14 @@ class DecisionLog:
         metric increment: a per-pod loop over one node spec incs the counter
         once with the pod count (value=N on the first record, 0 after), so a
         50k-pod round pays one labeled inc per spec, not per pod."""
-        if not self.enabled:
+        target = getattr(_redirect, "log", None)
+        if target is not None and target is not self:
+            return target.record(
+                kind, outcome, pod=pod, node=node, reason=reason,
+                details=details, value=value,
+            )
+        bufs = getattr(_tee, "bufs", ())
+        if not self.enabled and not bufs:
             return None
         rec = DecisionRecord(
             kind=kind, outcome=outcome, pod=pod, node=node, reason=reason,
@@ -132,6 +195,14 @@ class DecisionLog:
             trace_id=tracing.current_trace_id(),
             details=details if details is not None else {},
         )
+        # the tee observes admissions INDEPENDENT of the audit ring's
+        # enabled state: a disabled ring (capacity 0) must not silently
+        # empty flight-recorder capsules — replay's ICE pre-seed reads
+        # ice-failed nominations from the capsule's decision list
+        for buf in bufs:
+            buf.append(rec)
+        if not self.enabled:
+            return rec
         with self._lock:
             rec.seq = self._next_seq
             self._next_seq += 1
@@ -155,8 +226,18 @@ class DecisionLog:
         instead of appending — the per-tick "consolidation deferred:
         stabilization window" stream must not push real placements out of
         the ring. The metric still counts every occurrence."""
+        target = getattr(_redirect, "log", None)
+        if target is not None and target is not self:
+            return target.record_coalesced(
+                kind, outcome, pod=pod, node=node, reason=reason, details=details,
+            )
         if not self.enabled:
-            return None
+            # a disabled ring has no coalesce state; active tees still see
+            # each occurrence as a plain record
+            return self.record(
+                kind, outcome, pod=pod, node=node, reason=reason,
+                details=details, value=0.0,
+            )
         key = (kind, outcome, pod, node, reason)
         with self._lock:
             prior = self._coalesce.get(key)

@@ -20,6 +20,12 @@ Debug surface (the pprof-flag analogue, always on and cheap):
   (utils/decisions.py): placement / nomination / consolidation verdicts,
   newest first, filterable by ``?pod=``, ``?node=``, ``?reconcile_id=``,
   ``?trace_id=``, ``?kind=`` and capped by ``?limit=``.
+* ``/debug/flightrecorder`` — the reconcile flight recorder
+  (utils/flightrecorder.py): newest-first capsule summaries;
+  ``/debug/flightrecorder/<id>`` fetches one complete capsule as gzip'd
+  JSON (``Content-Encoding: gzip``) for offline replay via
+  ``python -m karpenter_tpu.replay``; ``?dump=1`` additionally writes it
+  to the configured ``flight_recorder_dump_dir`` and returns the path.
 """
 
 from __future__ import annotations
@@ -31,6 +37,7 @@ from typing import Callable, Optional
 from urllib.parse import parse_qs
 
 from .decisions import DECISIONS, DecisionLog
+from .flightrecorder import FLIGHT, FlightRecorder
 from .metrics import REGISTRY, Registry
 from .tracing import TRACER, Tracer
 
@@ -46,6 +53,7 @@ class OperatorHTTPServer:
         tracer: Optional[Tracer] = None,
         recorder: Optional[object] = None,
         decisions: Optional[DecisionLog] = None,
+        flightrecorder: Optional[FlightRecorder] = None,
         host: str = "127.0.0.1",
     ):
         self.registry = registry or REGISTRY
@@ -62,6 +70,7 @@ class OperatorHTTPServer:
         # surface before leader election) — the handler reads it per request
         self.recorder = recorder
         self.decisions = decisions or DECISIONS
+        self.flightrecorder = flightrecorder or FLIGHT
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -117,6 +126,41 @@ class OperatorHTTPServer:
                     ).encode()
                     self.send_response(200)
                     self.send_header("Content-Type", "application/json")
+                elif path == "/debug/flightrecorder":
+                    body = json.dumps(
+                        {"capsules": outer.flightrecorder.list()}, default=str
+                    ).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                elif path.startswith("/debug/flightrecorder/"):
+                    capsule_id = path[len("/debug/flightrecorder/"):]
+                    q = parse_qs(query)
+                    if q.get("dump", ["0"])[0] in ("1", "true"):
+                        try:
+                            dumped = outer.flightrecorder.dump(capsule_id)
+                        except OSError as e:
+                            body = json.dumps({"error": str(e)}).encode()
+                            self.send_response(400)
+                            self.send_header("Content-Type", "application/json")
+                        else:
+                            if dumped is None:
+                                body = b'{"error": "unknown capsule"}\n'
+                                self.send_response(404)
+                            else:
+                                body = json.dumps({"path": dumped}).encode()
+                                self.send_response(200)
+                            self.send_header("Content-Type", "application/json")
+                    else:
+                        payload = outer.flightrecorder.get_gzip(capsule_id)
+                        if payload is None:
+                            body = b'{"error": "unknown capsule"}\n'
+                            self.send_response(404)
+                            self.send_header("Content-Type", "application/json")
+                        else:
+                            body = payload
+                            self.send_response(200)
+                            self.send_header("Content-Type", "application/json")
+                            self.send_header("Content-Encoding", "gzip")
                 elif path == "/debug/events":
                     try:
                         limit = max(0, int(parse_qs(query).get("limit", ["256"])[0]))

@@ -479,6 +479,56 @@ DECISIONS_TOTAL = Counter(
     registry=REGISTRY,
 )
 
+# -- flight recorder (utils/flightrecorder.py, /debug/flightrecorder) --------
+FLIGHTRECORDER_CAPSULES = Counter(
+    "karpenter_tpu_flightrecorder_capsules_total",
+    help="Reconcile capsules committed to the flight-recorder ring, labeled "
+         "by controller.",
+    registry=REGISTRY,
+)
+FLIGHTRECORDER_ANOMALIES = Counter(
+    "karpenter_tpu_flightrecorder_anomalies_total",
+    help="Anomaly triggers stamped on flight-recorder capsules "
+         "(reconcile-error, unschedulable-pods, full-encode fallback, "
+         "breaker-open), labeled by trigger.",
+    registry=REGISTRY,
+)
+FLIGHTRECORDER_CAPTURE = Histogram(
+    "karpenter_tpu_flightrecorder_capture_seconds",
+    help="Wall time spent capturing one capsule's inputs (snapshot "
+         "serialization rides the reconcile hot path; the bench guard holds "
+         "it under 5% of the round p50).",
+    registry=REGISTRY,
+)
+FLIGHTRECORDER_DUMPS = Counter(
+    "karpenter_tpu_flightrecorder_dumps_total",
+    help="Capsules written to disk, labeled by trigger (anomaly/manual).",
+    registry=REGISTRY,
+)
+
+# -- runtime health (utils/runtimehealth.py) ---------------------------------
+RECONCILE_LOOP_LAG = Gauge(
+    "karpenter_tpu_reconcile_loop_lag_seconds",
+    help="Scheduled-vs-actual start delta of the last reconcile, per "
+         "INTERVAL-scheduled controller loop (scrapers, drift, GC, ...): "
+         "how late the kit ran a due controller — loop contention shows up "
+         "here before latency histograms. Every-tick controllers emit no "
+         "lag series (they have no schedule to be late against).",
+    registry=REGISTRY,
+)
+PROCESS_MEMORY = Gauge(
+    "karpenter_tpu_process_memory_bytes",
+    help="Operator process resident set size, refreshed pre-scrape "
+         "(utils/runtimehealth.py).",
+    registry=REGISTRY,
+)
+TRACEMALLOC_TOP = Gauge(
+    "karpenter_tpu_tracemalloc_top_bytes",
+    help="Top allocation sites by live bytes (file:lineno), exported only "
+         "when settings.memory_profiling_enabled turns tracemalloc on.",
+    registry=REGISTRY,
+)
+
 # -- event stream ------------------------------------------------------------
 EVENTS_TOTAL = Counter(
     "karpenter_tpu_events_total",

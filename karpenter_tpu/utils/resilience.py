@@ -159,6 +159,16 @@ class RetryPolicy:
 #: gauge encoding of breaker state (karpenter_tpu_rpc_breaker_state)
 _STATE_VALUE = {"closed": 0.0, "open": 1.0, "half-open": 2.0}
 
+#: process-wide count of closed/half-open -> open transitions, across every
+#: breaker instance. The flight recorder snapshots it around a reconcile: a
+#: delta means a circuit opened mid-round — one of its anomaly dump triggers.
+_open_events = 0
+_open_events_lock = threading.Lock()
+
+
+def breaker_open_count() -> int:
+    return _open_events
+
 
 class CircuitBreaker:
     """closed → open → half-open breaker with a half-open probe budget.
@@ -209,6 +219,10 @@ class CircuitBreaker:
         if to == self._state:
             return
         self._state = to
+        if to == "open":
+            global _open_events
+            with _open_events_lock:
+                _open_events += 1
         metrics.RPC_BREAKER_TRANSITIONS.inc({**self._labels(), "to": to})
         # breaker trips ride the active trace span too (no-op outside one):
         # an attributable "circuit opened mid-reconcile" beats a bare metric

@@ -1,0 +1,40 @@
+"""The bench summary-line contract (ISSUE 5 satellite): ``bench.py`` must end
+its stdout with ONE short machine-parseable JSON summary line — the harness
+tails process output, and a tens-of-KB detail line in final position was
+leaving parsers with a mid-JSON fragment (BENCH_r03-r05 ``"parsed": null``).
+
+Runs the real script as a subprocess in ``--dry-run`` (tiny) mode so the
+whole emission path — detail line, flush, summary line, flush — executes
+exactly as a harness run would see it."""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_dry_run_last_stdout_line_is_json_summary():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "bench.py"), "--dry-run"],
+        capture_output=True, text=True, timeout=300, cwd=ROOT,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+    assert lines, "bench --dry-run produced no stdout"
+    # the FINAL line parses as strict JSON and is the self-described summary
+    summary = json.loads(lines[-1])
+    assert summary["summary"] is True
+    assert "metric" in summary
+    # the flight-recorder overhead guard rides the summary like the PR 2/4
+    # guards (acceptance criterion: emitted in the summary)
+    assert "flightrecorder_overhead_pct" in summary
+    assert "flightrecorder_within_budget" in summary
+    assert "decision_overhead_pct" in summary
+    # every stdout line is valid JSON on its own (no partial fragments)
+    for ln in lines:
+        json.loads(ln)

@@ -1,0 +1,41 @@
+"""Tier-1 doc-drift gate: every /debug/* route registered on the operator
+HTTP surface must be documented in docs/observability.md, and vice versa
+(hack/check_debug_endpoints.py — the endpoint analogue of the metrics gate)."""
+
+from __future__ import annotations
+
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(ROOT, "hack"))
+
+import check_debug_endpoints  # noqa: E402
+
+
+def test_debug_endpoints_documented():
+    problems = check_debug_endpoints.check()
+    assert problems == [], "\n".join(problems)
+
+
+def test_gate_sees_every_registered_route():
+    routes = check_debug_endpoints.registered_routes()
+    # the known debug surface; a new route must extend BOTH this list and
+    # the runbook (that is the point of the gate)
+    for expected in (
+        "/debug/traces",
+        "/debug/events",
+        "/debug/decisions",
+        "/debug/flightrecorder",
+    ):
+        assert expected in routes
+
+
+def test_gate_catches_both_drift_directions(tmp_path):
+    ghost_doc = tmp_path / "observability.md"
+    ghost_doc.write_text("see `/debug/no_such_route` for details\n")
+    documented = check_debug_endpoints.documented_routes(str(ghost_doc))
+    assert documented == {"/debug/no_such_route"}
+    # a doc that names a ghost route and misses a real one drifts both ways
+    registered = check_debug_endpoints.registered_routes()
+    assert "/debug/no_such_route" not in registered

@@ -1,0 +1,734 @@
+"""ISSUE 5 suite: the reconcile flight recorder and the offline replay
+harness.
+
+The e2e class is the acceptance criterion: a reconcile recorded over REAL
+HTTP (embedded apiserver + cloud service) is fetched as a gzip capsule from
+``/debug/flightrecorder/<id>`` and replayed fully offline — identical
+problem digests (byte-for-byte), identical placement decisions, and zero
+network calls (replay denies socket connects outright).
+"""
+
+from __future__ import annotations
+
+import gzip
+import json
+import os
+import urllib.request
+
+import pytest
+
+from karpenter_tpu.api import ObjectMeta, Pod, Provisioner, Resources
+from karpenter_tpu.api.settings import Settings
+from karpenter_tpu.cloudprovider import FakeCloudProvider, generate_catalog
+from karpenter_tpu.cloudprovider.httpcloud import CloudHTTPService, HTTPCloudProvider
+from karpenter_tpu.cloudprovider.types import (
+    instance_type_from_wire,
+    instance_type_to_wire,
+)
+from karpenter_tpu.controllers.deprovisioning import DeprovisioningController
+from karpenter_tpu.controllers.kit import SingletonController
+from karpenter_tpu.controllers.provisioning import ProvisioningController
+from karpenter_tpu.controllers.termination import TerminationController
+from karpenter_tpu.replay import (
+    OverrideError,
+    apply_overrides,
+    build_cluster,
+    load_capsule,
+    replay_capsule,
+)
+from karpenter_tpu.replay import main as replay_main
+from karpenter_tpu.solver.solver import GreedySolver
+from karpenter_tpu.state import Cluster, ClusterAPIServer, HTTPCluster
+from karpenter_tpu.utils import metrics
+from karpenter_tpu.utils.cache import FakeClock
+from karpenter_tpu.utils.decisions import DECISIONS
+from karpenter_tpu.utils.flightrecorder import FLIGHT, FlightRecorder
+from karpenter_tpu.utils.httpserver import OperatorHTTPServer
+from karpenter_tpu.utils.resilience import RetryPolicy
+
+from helpers import make_pod, make_pods, make_provisioner
+
+
+@pytest.fixture(autouse=True)
+def _fresh_rings():
+    DECISIONS.configure(2048)
+    DECISIONS.clear()
+    FLIGHT.configure(32)
+    FLIGHT.clear()
+    yield
+    FLIGHT.configure(32)
+    FLIGHT.clear()
+    DECISIONS.clear()
+
+
+def no_sleep_policy(**kw) -> RetryPolicy:
+    kw.setdefault("sleep", lambda s: None)
+    return RetryPolicy(**kw)
+
+
+def _env(n_pods=6, n_types=20, provisioner=None, solver=None):
+    cluster = Cluster()
+    provider = FakeCloudProvider(catalog=generate_catalog(n_types=n_types))
+    controller = ProvisioningController(
+        cluster, provider, solver=solver or GreedySolver(),
+        settings=Settings(batch_idle_duration=0, batch_max_duration=0),
+    )
+    cluster.add_provisioner(provisioner or make_provisioner())
+    for p in make_pods(n_pods, prefix="fr", cpu="500m", memory="1Gi"):
+        cluster.add_pod(p)
+    return cluster, provider, controller
+
+
+def _roundtrip(capsule):
+    """Capsule through JSON — exactly what disk/HTTP transport does."""
+    return json.loads(json.dumps(capsule, default=str))
+
+
+# ---------------------------------------------------------------------------
+# Wire codec
+# ---------------------------------------------------------------------------
+
+
+class TestInstanceTypeCodec:
+    def test_lossless_round_trip_including_ice_state(self):
+        provider = FakeCloudProvider(catalog=generate_catalog(n_types=10))
+        prov = make_provisioner()
+        provider.unavailable_offerings.mark_unavailable(
+            provider.catalog[0].name,
+            provider.catalog[0].offerings[0].zone,
+            provider.catalog[0].offerings[0].capacity_type,
+        )
+        types = provider.get_instance_types(prov)
+        rebuilt = [
+            instance_type_from_wire(json.loads(json.dumps(instance_type_to_wire(it))))
+            for it in types
+        ]
+        for a, b in zip(types, rebuilt):
+            assert a.name == b.name
+            assert a.capacity.to_dict() == b.capacity.to_dict()
+            assert a.overhead.total().to_dict() == b.overhead.total().to_dict()
+            assert [
+                (o.zone, o.capacity_type, o.price, o.available) for o in a.offerings
+            ] == [
+                (o.zone, o.capacity_type, o.price, o.available) for o in b.offerings
+            ]
+            assert sorted(
+                (r.key, r.complement, tuple(sorted(r.values)))
+                for r in a.requirements
+            ) == sorted(
+                (r.key, r.complement, tuple(sorted(r.values)))
+                for r in b.requirements
+            )
+        # the masked offering's availability survived the round trip
+        masked = [o for it in rebuilt for o in it.offerings if not o.available]
+        assert masked
+
+    def test_encode_digest_survives_codec_round_trip(self):
+        """The contract everything rests on: a from-scratch encode of
+        codec-round-tripped inputs is byte-identical to the original."""
+        from karpenter_tpu.api import codec
+        from karpenter_tpu.solver.encode import encode
+        from karpenter_tpu.solver.solver import problem_digest
+
+        pods = make_pods(5, prefix="dig", cpu="250m", memory="512Mi")
+        prov = make_provisioner()
+        types = FakeCloudProvider(
+            catalog=generate_catalog(n_types=10)
+        ).get_instance_types(prov)
+        original = problem_digest(encode(pods, [(prov, types)]))
+        pods2 = [
+            codec.pod_from_wire(json.loads(json.dumps(codec.pod_to_wire(p))))
+            for p in pods
+        ]
+        prov2 = codec.provisioner_from_wire(
+            json.loads(json.dumps(codec.provisioner_to_wire(prov)))
+        )
+        types2 = [
+            instance_type_from_wire(json.loads(json.dumps(instance_type_to_wire(t))))
+            for t in types
+        ]
+        assert problem_digest(encode(pods2, [(prov2, types2)])) == original
+
+
+# ---------------------------------------------------------------------------
+# Recorder mechanics
+# ---------------------------------------------------------------------------
+
+
+class TestRecorder:
+    def test_ring_bounds_and_eviction(self):
+        rec = FlightRecorder(capacity=2)
+        for i in range(4):
+            cap = rec.begin("t")
+            cap._inputs = {"objects": {}}  # minimal committed capsule
+            cap.finish()
+        assert len(rec.list()) == 2
+        # evicted capsules are unfetchable
+        all_ids = [c["id"] for c in rec.list()]
+        for cid in all_ids:
+            assert rec.get(cid) is not None
+
+    def test_capacity_zero_disables(self):
+        rec = FlightRecorder(capacity=0)
+        assert rec.begin("t") is None
+        cluster, provider, controller = _env()
+        FLIGHT.configure(0)
+        controller.reconcile()
+        assert FLIGHT.list() == []
+
+    def test_suppression_blocks_recording(self):
+        from karpenter_tpu.utils import flightrecorder
+
+        with flightrecorder.suppressed():
+            assert FLIGHT.begin("t") is None
+        cap = FLIGHT.begin("t")
+        assert cap is not None
+        cap.finish()  # every begin() pairs with finish() (tee release)
+
+    def test_idle_rounds_commit_nothing(self):
+        cluster = Cluster()
+        provider = FakeCloudProvider(catalog=generate_catalog(n_types=5))
+        controller = ProvisioningController(
+            cluster, provider, solver=GreedySolver(),
+            settings=Settings(batch_idle_duration=0, batch_max_duration=0),
+        )
+        cluster.add_provisioner(make_provisioner())
+        controller.reconcile()  # no pending pods
+        assert FLIGHT.list() == []
+
+    def test_reconcile_error_commits_capsule_with_trigger(self):
+        cluster, provider, controller = _env(n_pods=2)
+
+        def boom(*a, **k):
+            raise RuntimeError("injected solve failure")
+
+        controller.solver.solve_pods = boom
+        with pytest.raises(RuntimeError):
+            controller.reconcile()
+        caps = FLIGHT.list()
+        assert caps and "reconcile-error" in caps[0]["anomalies"]
+        capsule = FLIGHT.get(caps[0]["id"])
+        assert "injected solve failure" in capsule["outputs"]["error"]
+
+    def test_wire_cache_reuses_unchanged_objects(self):
+        cluster, provider, controller = _env(n_pods=4)
+        controller.reconcile()
+        first = FLIGHT.latest("provisioning")
+        # second round: pods are bound now; a fresh pending pod arrives
+        cluster.add_pod(make_pod(name="fr-new", cpu="100m", memory="128Mi"))
+        controller.reconcile()
+        second = FLIGHT.latest("provisioning")
+        assert second["id"] != first["id"]
+        # the unchanged provisioner's wire dict is the SAME object (ref share)
+        assert (
+            second["inputs"]["objects"]["provisioners"][0]
+            is first["inputs"]["objects"]["provisioners"][0]
+        )
+
+    def test_capsule_decisions_survive_ring_overflow(self):
+        """A round emitting more records than the DECISIONS ring holds must
+        still capsule every one — capsule assembly tees admissions instead
+        of reading the (bounded) ring back."""
+        DECISIONS.configure(8)  # tiny ring: the round overflows it
+        cluster, provider, controller = _env(n_pods=30, n_types=10)
+        controller.reconcile()
+        capsule = FLIGHT.latest("provisioning")
+        placements = [
+            d for d in capsule["outputs"]["decisions"]
+            if d["kind"] == "placement"
+        ]
+        assert len(placements) >= 30  # nothing evicted out of the capsule
+        assert len(DECISIONS.query(limit=100)) <= 8  # the ring stayed bounded
+
+    def test_capsule_decisions_captured_with_audit_ring_disabled(self):
+        """decision_log_capacity=0 disables the AUDIT ring, not capsule
+        capture: replay's ICE pre-seed depends on the capsule's decision
+        list, so the tee must observe records the ring refuses."""
+        DECISIONS.configure(0)
+        cluster, provider, controller = _env(n_pods=3)
+        controller.reconcile()
+        capsule = FLIGHT.latest("provisioning")
+        assert [
+            d for d in capsule["outputs"]["decisions"]
+            if d["kind"] == "placement"
+        ]
+        assert DECISIONS.query(limit=100) == []  # the ring stayed disabled
+        report = replay_capsule(_roundtrip(capsule), solver="greedy")
+        assert report["match"] is True
+
+    def test_network_guard_is_per_thread(self):
+        """The replay deny-guard must not break OTHER threads' sockets — a
+        live operator's watch/API calls keep working during an in-process
+        replay."""
+        import socket
+        import threading as _threading
+
+        from karpenter_tpu.replay import _NoNetwork
+
+        server = socket.socket()
+        server.bind(("127.0.0.1", 0))
+        server.listen(1)
+        port = server.getsockname()[1]
+        results = {}
+
+        def other_thread_connect():
+            s = socket.socket()
+            try:
+                s.connect(("127.0.0.1", port))
+                results["other"] = "ok"
+            except Exception as e:  # noqa: BLE001
+                results["other"] = f"{type(e).__name__}: {e}"
+            finally:
+                s.close()
+
+        try:
+            with _NoNetwork():
+                with pytest.raises(RuntimeError, match="offline replay"):
+                    socket.create_connection(("127.0.0.1", port))
+                t = _threading.Thread(target=other_thread_connect)
+                t.start()
+                t.join(timeout=10)
+            assert results["other"] == "ok"
+            # guard removed after exit: this thread connects again
+            s = socket.socket()
+            s.connect(("127.0.0.1", port))
+            s.close()
+            assert socket.socket.connect is not None
+        finally:
+            server.close()
+
+    def test_capsule_metrics_counted(self):
+        before = metrics.FLIGHTRECORDER_CAPSULES.value({"controller": "provisioning"})
+        cluster, provider, controller = _env(n_pods=2)
+        controller.reconcile()
+        after = metrics.FLIGHTRECORDER_CAPSULES.value({"controller": "provisioning"})
+        assert after == before + 1
+
+
+# ---------------------------------------------------------------------------
+# Record -> replay determinism (in-process)
+# ---------------------------------------------------------------------------
+
+
+class TestReplayDeterminism:
+    def test_provisioning_round_replays_byte_identical(self):
+        cluster, provider, controller = _env(n_pods=8)
+        result = controller.reconcile()
+        assert result.bound and not result.unschedulable
+        capsule = _roundtrip(FLIGHT.latest("provisioning"))
+        assert capsule["outputs"]["problem_digests"]
+        report = replay_capsule(capsule, solver="greedy")
+        assert report["diffs"]["digests_match"] is True
+        assert report["diffs"]["placements_match"] is True
+        assert report["diffs"]["unschedulable_match"] is True
+        assert report["diffs"]["decisions_match"] is True
+        assert report["match"] is True
+
+    def test_delta_encode_round_replays_byte_identical(self):
+        """A capsule recorded from a DELTA round must replay to the same
+        digest via a from-scratch full encode — PR 3's equivalence contract
+        is what makes capsule capture sufficient."""
+        cluster, provider, controller = _env(n_pods=8)
+        controller.reconcile()
+        for p in make_pods(3, prefix="churn", cpu="250m", memory="512Mi"):
+            cluster.add_pod(p)
+        controller.reconcile()
+        capsule = _roundtrip(FLIGHT.latest("provisioning"))
+        assert capsule["encode_mode"] == "delta"
+        report = replay_capsule(capsule, solver="greedy")
+        assert report["diffs"]["digests_match"] is True
+        assert report["match"] is True
+
+    def test_unschedulable_round_replays_with_same_verdicts(self):
+        # an impossible pod: no catalog type carries this resource
+        cluster, provider, controller = _env(n_pods=2)
+        cluster.add_pod(
+            make_pod(name="fr-impossible", extra_resources={"example.com/fpga": 4})
+        )
+        result = controller.reconcile()
+        assert "fr-impossible" in result.unschedulable
+        capsule = _roundtrip(FLIGHT.latest("provisioning"))
+        assert "unschedulable-pods" in capsule["anomalies"]
+        report = replay_capsule(capsule, solver="greedy")
+        assert report["match"] is True
+        assert "fr-impossible" in report["replayed"]["unschedulable"]
+
+    def test_mid_round_ice_cascade_replays_byte_identical(self):
+        """A round whose launch ICEs and re-solves in-round records >1
+        digest; replay pre-seeds the recorded ice-failed offerings into the
+        fake's ICE pools, so the same cascade (and the same refreshed
+        catalogs) reproduces digest-for-digest."""
+        cluster, provider, controller = _env(n_pods=4)
+        # ICE the offering the solver will choose first: dry-run the solve
+        # on a throwaway controller to learn the choice, then mark it
+        probe_cluster = Cluster()
+        probe_provider = FakeCloudProvider(catalog=generate_catalog(n_types=20))
+        probe = ProvisioningController(
+            probe_cluster, probe_provider, solver=GreedySolver(),
+            settings=Settings(batch_idle_duration=0, batch_max_duration=0),
+        )
+        probe_cluster.add_provisioner(make_provisioner())
+        for p in make_pods(4, prefix="fr", cpu="500m", memory="1Gi"):
+            probe_cluster.add_pod(p)
+        chosen = probe.reconcile().solve.new_nodes[0].option
+        provider.set_insufficient_capacity(
+            chosen.instance_type.name, chosen.zone, chosen.capacity_type
+        )
+        result = controller.reconcile()
+        capsule = _roundtrip(FLIGHT.latest("provisioning"))
+        assert len(capsule["outputs"]["problem_digests"]) > 1  # ICE re-solve ran
+        assert any(
+            d.get("outcome") == "ice-failed"
+            for d in capsule["outputs"]["decisions"]
+        )
+        assert result.bound  # pods degraded to the next-cheapest offering
+        report = replay_capsule(capsule, solver="greedy")
+        assert report["diffs"]["digests_match"] is True, report["diffs"]
+        assert report["match"] is True
+
+    def test_replay_does_not_pollute_live_decision_ring(self):
+        cluster, provider, controller = _env(n_pods=3)
+        controller.reconcile()
+        capsule = _roundtrip(FLIGHT.latest("provisioning"))
+        live_before = len(DECISIONS.query(limit=10000))
+        report = replay_capsule(capsule, solver="greedy")
+        assert report["match"] is True
+        assert report["replayed"]["decisions"]  # the replay captured its own
+        live_after = DECISIONS.query(limit=10000)
+        assert len(live_after) == live_before  # the LIVE ring saw nothing
+        assert not any(r.reconcile_id.startswith("replay.") for r in live_after)
+
+    def test_batch_order_reconstruction_preserves_canonical_order(self):
+        cluster, provider, controller = _env(n_pods=5)
+        controller.reconcile()
+        capsule = _roundtrip(FLIGHT.latest("provisioning"))
+        rebuilt = build_cluster(capsule)
+        assert [p.name for p in rebuilt.pending_pods()] == capsule["inputs"][
+            "batch_order"
+        ]
+
+    def test_deprovisioning_planned_and_matured_replay(self):
+        clock = FakeClock(1000.0)
+        cluster, provider, controller = _env(
+            n_pods=6, provisioner=make_provisioner(consolidation_enabled=True)
+        )
+        controller.reconcile()
+        victim = sorted(cluster.nodes)[0]
+        for p in list(cluster.pods_on_node(victim)):
+            cluster.delete_pod(p.name)
+        settings = Settings(stabilization_window=0, consolidation_validation_ttl=15)
+        term = TerminationController(cluster, provider, clock=clock)
+        dep = DeprovisioningController(
+            cluster, provider, term, solver=GreedySolver(),
+            settings=settings, clock=clock,
+        )
+        assert dep.reconcile() is None and dep.pending_action is not None
+        planned = _roundtrip(FLIGHT.latest("deprovisioning"))
+        assert planned["outputs"]["planned"]["reason"] == "consolidation-delete"
+        report = replay_capsule(planned, solver="greedy")
+        assert report["match"] is True
+
+        clock.step(16)
+        executed = dep.reconcile()
+        assert executed is not None
+        matured = _roundtrip(FLIGHT.latest("deprovisioning"))
+        assert matured["inputs"]["had_pending_action"] is not None
+        report2 = replay_capsule(matured, solver="greedy")
+        assert report2["match"] is True
+        assert report2["replayed"]["action"]["nodes"] == [victim]
+
+
+# ---------------------------------------------------------------------------
+# Counterfactual overrides
+# ---------------------------------------------------------------------------
+
+
+class TestCounterfactuals:
+    def test_offering_mask_diverts_placement(self):
+        cluster, provider, controller = _env(n_pods=4)
+        controller.reconcile()
+        capsule = _roundtrip(FLIGHT.latest("provisioning"))
+        chosen = capsule["outputs"]["placements"]["fr-0"]
+        override = (
+            f"offerings={chosen['instance_type']}/{chosen['zone']}/"
+            f"{chosen['capacity_type']}=unavailable"
+        )
+        report = replay_capsule(capsule, overrides=[override], solver="greedy")
+        assert report["counterfactual"] is True
+        replayed = report["replayed"]["placements"].get("fr-0")
+        assert replayed is not None  # still schedules...
+        assert (
+            replayed["instance_type"], replayed["zone"], replayed["capacity_type"]
+        ) != (
+            chosen["instance_type"], chosen["zone"], chosen["capacity_type"]
+        )  # ...but on a different offering
+
+    def test_limit_raise_schedules_blocked_pod(self):
+        """The runbook counterfactual: 'would this pod have scheduled with a
+        higher limit?' — record a limit-blocked round, replay with the
+        ceiling lifted, watch the pod schedule."""
+        prov = make_provisioner(limits=Resources(cpu="1"))
+        cluster, provider, controller = _env(n_pods=0, provisioner=prov)
+        for p in make_pods(4, prefix="blocked", cpu="900m", memory="512Mi"):
+            cluster.add_pod(p)
+        result = controller.reconcile()
+        assert result.unschedulable  # the limit blocked part of the batch
+        capsule = _roundtrip(FLIGHT.latest("provisioning"))
+        report = replay_capsule(
+            capsule,
+            overrides=["provisioner.default.limits.cpu=100"],
+            solver="greedy",
+        )
+        assert report["counterfactual"] is True
+        assert report["replayed"]["unschedulable"] == []
+
+    def test_limits_none_removes_only_the_named_resource(self):
+        prov = make_provisioner(limits=Resources(cpu="1", memory="1Gi"))
+        cluster, provider, controller = _env(n_pods=1, provisioner=prov)
+        controller.reconcile()
+        capsule = _roundtrip(FLIGHT.latest("provisioning"))
+        out = apply_overrides(
+            capsule, ["provisioner.default.limits.cpu=none"]
+        )
+        limits = out["inputs"]["objects"]["provisioners"][0]["limits"]
+        assert "cpu" not in limits
+        assert "memory" in limits  # the other ceiling stands
+        # removing the last resource collapses to no-limits
+        out2 = apply_overrides(
+            out, ["provisioner.default.limits.memory=none"]
+        )
+        assert out2["inputs"]["objects"]["provisioners"][0]["limits"] is None
+
+    def test_settings_override_round_trips(self):
+        cluster, provider, controller = _env(n_pods=2)
+        controller.reconcile()
+        capsule = _roundtrip(FLIGHT.latest("provisioning"))
+        report = replay_capsule(
+            capsule,
+            overrides=["settings.encode_delta_enabled=false"],
+            solver="greedy",
+        )
+        assert report["counterfactual"] is True
+        # digests still byte-equal: delta-disabled full encode is the oracle
+        assert report["diffs"]["digests_match"] is True
+
+    def test_bad_overrides_rejected(self):
+        cluster, provider, controller = _env(n_pods=1)
+        controller.reconcile()
+        capsule = _roundtrip(FLIGHT.latest("provisioning"))
+        for bad in (
+            "settings.no_such_field=1",
+            "offerings=ghost/zone/ct=unavailable",
+            "provisioner.ghost.limits.cpu=1",
+            "nonsense=1",
+            # malformed VALUES must surface as OverrideError too (the CLI
+            # prints 'bad override', never a traceback)
+            "settings.batch_max_duration=abc",
+            "offerings=*/*/spot=price:cheap",
+            "provisioner.default.weight=heavy",
+            "provisioner.default.limits.cpu=lots",
+        ):
+            with pytest.raises(OverrideError):
+                apply_overrides(capsule, [bad])
+
+
+# ---------------------------------------------------------------------------
+# HTTP surface + dumps + CLI
+# ---------------------------------------------------------------------------
+
+
+class TestEndpointsAndCLI:
+    def _get(self, port, path):
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}") as r:
+            return r.status, r.headers, r.read()
+
+    def test_list_fetch_and_404(self):
+        cluster, provider, controller = _env(n_pods=3)
+        controller.reconcile()
+        server = OperatorHTTPServer(port=0).start()
+        try:
+            _, _, body = self._get(server.port, "/debug/flightrecorder")
+            listing = json.loads(body)["capsules"]
+            assert listing and listing[0]["controller"] == "provisioning"
+            cid = listing[0]["id"]
+            status, headers, payload = self._get(
+                server.port, f"/debug/flightrecorder/{cid}"
+            )
+            assert status == 200
+            assert headers["Content-Encoding"] == "gzip"
+            capsule = json.loads(gzip.decompress(payload))
+            assert capsule["id"] == cid
+            assert capsule["outputs"]["problem_digests"]
+            with pytest.raises(urllib.error.HTTPError) as err:
+                self._get(server.port, "/debug/flightrecorder/no-such-capsule")
+            assert err.value.code == 404
+        finally:
+            server.stop()
+
+    def test_anomaly_auto_dump_and_on_demand_dump(self, tmp_path):
+        FLIGHT.configure(32, dump_dir=str(tmp_path))
+        cluster, provider, controller = _env(n_pods=1)
+        cluster.add_pod(
+            make_pod(name="fr-stuck", extra_resources={"example.com/fpga": 1})
+        )
+        controller.reconcile()  # unschedulable -> anomaly -> auto-dump
+        dumps = list(tmp_path.glob("capsule-*.json.gz"))
+        assert len(dumps) == 1
+        capsule = load_capsule(str(dumps[0]))
+        assert "unschedulable-pods" in capsule["anomalies"]
+        # on-demand dump over HTTP
+        server = OperatorHTTPServer(port=0).start()
+        try:
+            cid = FLIGHT.list()[0]["id"]
+            _, _, body = self._get(
+                server.port, f"/debug/flightrecorder/{cid}?dump=1"
+            )
+            path = json.loads(body)["path"]
+            assert os.path.exists(path)
+        finally:
+            server.stop()
+
+    def test_replay_cli_end_to_end(self, tmp_path, capsys):
+        cluster, provider, controller = _env(n_pods=3)
+        controller.reconcile()
+        cid = FLIGHT.list()[0]["id"]
+        path = FLIGHT.dump(cid, str(tmp_path))
+        rc = replay_main([path, "--solver", "greedy", "--explain", "pod=fr-0"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "MATCH" in out
+        assert "pod fr-0" in out
+        # --json mode emits the full machine-readable report
+        rc = replay_main([path, "--solver", "greedy", "--json"])
+        out = capsys.readouterr().out
+        report = json.loads(out)
+        assert rc == 0 and report["match"] is True
+
+
+# ---------------------------------------------------------------------------
+# Runtime-health gauges (satellite)
+# ---------------------------------------------------------------------------
+
+
+class TestRuntimeHealth:
+    def test_loop_lag_gauge_set_by_kit(self):
+        ticks = iter([0.0, 10.0, 10.0, 10.0, 10.0])
+        kit = SingletonController("lagtest", lambda: None, interval=2.0,
+                                  clock=lambda: next(ticks))
+        assert kit.run_if_due()  # first run: no lag sample (never scheduled)
+        assert kit.run_if_due()  # due at 2.0, ran at 10.0 -> 8s late
+        assert metrics.RECONCILE_LOOP_LAG.value({"controller": "lagtest"}) == 8.0
+
+    def test_process_memory_gauge_refreshes_pre_scrape(self):
+        from karpenter_tpu.utils import runtimehealth
+        from karpenter_tpu.utils.metrics import Registry
+
+        assert runtimehealth.rss_bytes() > 0
+        reg = Registry()
+        reg.register(metrics.PROCESS_MEMORY)
+        runtimehealth.install(registry=reg)
+        exposition = reg.exposition()
+        assert "karpenter_tpu_process_memory_bytes" in exposition
+        assert metrics.PROCESS_MEMORY.value() > 0
+
+    def test_tracemalloc_export_gated_by_setting(self):
+        from karpenter_tpu.utils import runtimehealth
+        from karpenter_tpu.utils.metrics import Registry
+
+        reg = Registry()
+        reg.register(metrics.TRACEMALLOC_TOP)
+        runtimehealth.install(registry=reg, memory_profiling=True)
+        try:
+            _ = [bytearray(1024) for _ in range(200)]  # some allocations
+            reg.exposition()
+            assert metrics.TRACEMALLOC_TOP._values  # sites exported
+        finally:
+            runtimehealth.disable_memory_profiling()
+        reg.exposition()
+        assert not metrics.TRACEMALLOC_TOP._values  # cleared when disabled
+
+    def test_operator_wires_recorder_and_health(self):
+        from karpenter_tpu.operator import Operator
+
+        op = Operator.new(
+            settings=Settings(flight_recorder_capacity=7, batch_idle_duration=0,
+                              batch_max_duration=0)
+        )
+        try:
+            assert FLIGHT.capacity == 7
+        finally:
+            op.close()
+
+
+# ---------------------------------------------------------------------------
+# E2E over real HTTP (satellite 3 / acceptance criterion)
+# ---------------------------------------------------------------------------
+
+
+class TestCapsuleRoundTripE2E:
+    def _env(self):
+        store = Cluster()
+        api = ClusterAPIServer(backing=store).start()
+        svc = CloudHTTPService(generate_catalog(n_types=20)).start()
+        cluster = HTTPCluster(
+            api.endpoint, watch=False, retry_policy=no_sleep_policy()
+        )
+        provider = HTTPCloudProvider(svc.endpoint, retry_policy=no_sleep_policy())
+        controller = ProvisioningController(
+            cluster, provider,
+            settings=Settings(batch_idle_duration=0, batch_max_duration=0),
+        )
+        cluster.add_provisioner(make_provisioner())
+        return store, api, svc, cluster, provider, controller
+
+    def test_live_http_reconcile_replays_offline_identically(self):
+        store, api, svc, cluster, provider, controller = self._env()
+        try:
+            for p in make_pods(5, prefix="e2e", cpu="500m", memory="1Gi"):
+                cluster.add_pod(p)
+            kit = SingletonController("provisioning", controller.reconcile)
+            assert kit.run_if_due()
+            assert kit.consecutive_errors == 0
+
+            # fetch the capsule the way an operator would: gzip over HTTP
+            server = OperatorHTTPServer(port=0).start()
+            try:
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{server.port}/debug/flightrecorder"
+                ) as r:
+                    listing = json.loads(r.read())["capsules"]
+                assert listing
+                cid = listing[0]["id"]
+                assert cid.startswith("provisioning.")  # kit reconcile id
+                assert listing[0]["trace_id"]
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{server.port}/debug/flightrecorder/{cid}"
+                ) as r:
+                    capsule = json.loads(gzip.decompress(r.read()))
+            finally:
+                server.stop()
+        finally:
+            cluster.close()
+            api.stop()
+            svc.stop()
+
+        # apiserver and cloud are DOWN now: the replay must not notice.
+        # forbid_network (default) additionally denies any socket connect.
+        report = replay_capsule(capsule)
+        assert report["diffs"]["digests_match"] is True, report["diffs"]
+        assert report["diffs"]["placements_match"] is True, report["diffs"]
+        assert report["diffs"]["unschedulable_match"] is True
+        assert report["match"] is True
+        # every recorded pod placed identically
+        assert set(report["replayed"]["placements"]) == {
+            f"e2e-{i}" for i in range(5)
+        }
+
+    def test_network_guard_actually_denies(self):
+        import socket
+
+        from karpenter_tpu.replay import _NoNetwork
+
+        with _NoNetwork():
+            with pytest.raises(RuntimeError, match="offline replay"):
+                socket.create_connection(("127.0.0.1", 9))
