@@ -976,6 +976,107 @@ def bench_rpc_overhead(repeats=10, n_pods=300):
     }
 
 
+def bench_gang_preemption(rounds=10, gang_size=8, fill_pods=60, serve_churn=4):
+    """Gang scheduling + priority preemption scenario (ISSUE 6): a cluster
+    saturated with low-priority serving pods (provisioner limits block any
+    further scale-up — the capacity crunch), into which 8-rank high-priority
+    training gangs arrive every round alongside fresh serving churn. Each
+    gang must either bind WHOLE in one round (normally by preempting the
+    cheapest-to-evict serving pods) or defer whole.
+
+    Reports gang-admission latency p50 (reconcile wall time of rounds that
+    admitted a gang), preemption-round p50 (rounds that executed evictions),
+    and ``partial_gangs`` — the count of gangs ever observed partially bound,
+    which must be ZERO (the acceptance criterion this scenario pins)."""
+    import statistics as _st
+
+    from karpenter_tpu.api import ObjectMeta, Pod, Provisioner, Resources
+    from karpenter_tpu.api import labels as wk
+    from karpenter_tpu.api.settings import Settings
+    from karpenter_tpu.cloudprovider import FakeCloudProvider, generate_catalog
+    from karpenter_tpu.controllers.provisioning import ProvisioningController
+    from karpenter_tpu.solver.solver import GreedySolver
+    from karpenter_tpu.state import Cluster
+    from karpenter_tpu.utils import metrics as _m
+
+    def _total(counter) -> float:
+        with counter._lock:
+            return sum(counter._values.values())
+
+    cluster = Cluster()
+    provider = FakeCloudProvider(catalog=generate_catalog(n_types=20))
+    controller = ProvisioningController(
+        cluster, provider, solver=GreedySolver(),
+        settings=Settings(batch_idle_duration=0, batch_max_duration=0),
+    )
+    # ceiling sized to the serving fill: once the fill lands, no new node
+    # may launch — gangs can only enter by evicting serving pods
+    cluster.add_provisioner(
+        Provisioner(meta=ObjectMeta(name="default"), limits=Resources(cpu=fill_pods * 2))
+    )
+    for i in range(fill_pods):
+        cluster.add_pod(
+            Pod(meta=ObjectMeta(name=f"serve-{i}", owner_kind="ReplicaSet"),
+                requests=Resources(cpu="1", memory="1Gi"))
+        )
+    controller.reconcile()  # the fill round (not measured)
+
+    admit_times, preempt_times = [], []
+    admitted = partial = deferred = 0
+    for r in range(rounds):
+        gang = f"train-{r}"
+        members = []
+        for i in range(gang_size):
+            p = Pod(
+                meta=ObjectMeta(
+                    name=f"{gang}-{i}", owner_kind="Job",
+                    annotations={
+                        wk.POD_GROUP: gang,
+                        wk.POD_GROUP_MIN_MEMBERS: str(gang_size),
+                    },
+                ),
+                requests=Resources(cpu="1", memory="1Gi"),
+                priority=100,
+            )
+            members.append(p.name)
+            cluster.add_pod(p)
+        for i in range(serve_churn):
+            cluster.add_pod(
+                Pod(meta=ObjectMeta(name=f"serve-{r}-{i}", owner_kind="ReplicaSet"),
+                    requests=Resources(cpu="1", memory="1Gi"))
+            )
+        evictions0 = _total(_m.PREEMPTION_EVICTIONS)
+        t0 = time.perf_counter()
+        controller.reconcile()
+        dt = time.perf_counter() - t0
+        bound = sum(1 for n in members if cluster.pods[n].node_name is not None)
+        if bound == gang_size:
+            admitted += 1
+            admit_times.append(dt)
+        elif bound == 0:
+            deferred += 1
+        else:
+            partial += 1  # the invariant this scenario exists to pin
+        if _total(_m.PREEMPTION_EVICTIONS) > evictions0:
+            preempt_times.append(dt)
+
+    return {
+        "rounds": rounds,
+        "gang_size": gang_size,
+        "gangs_admitted": admitted,
+        "gangs_deferred": deferred,
+        "partial_gangs": partial,
+        "zero_partial": bool(partial == 0),
+        "gang_admission_p50_ms": (
+            round(_st.median(admit_times) * 1e3, 3) if admit_times else None
+        ),
+        "preemption_round_p50_ms": (
+            round(_st.median(preempt_times) * 1e3, 3) if preempt_times else None
+        ),
+        "preemption_rounds": len(preempt_times),
+    }
+
+
 def bench_decision_overhead(repeats=10, n_pods=300):
     """Decision-audit + trace-propagation overhead guard: a full provisioning
     round (solve + launch + bind) with the decision ring recording vs.
@@ -1274,6 +1375,12 @@ def _run_details(dry_run: bool = False) -> dict:
             )
         except Exception as e:
             details["flightrecorder_overhead"] = {"error": f"{type(e).__name__}: {e}"}
+        try:
+            details["gang_preemption"] = bench_gang_preemption(
+                rounds=3, gang_size=4, fill_pods=12, serve_churn=2
+            )
+        except Exception as e:
+            details["gang_preemption"] = {"error": f"{type(e).__name__}: {e}"}
         return details
     for name, make in CONFIGS:
         try:
@@ -1291,6 +1398,7 @@ def _run_details(dry_run: bool = False) -> dict:
         ("rpc_overhead", bench_rpc_overhead),
         ("decision_overhead", bench_decision_overhead),
         ("flightrecorder_overhead", bench_flightrecorder_overhead),
+        ("gang_preemption", bench_gang_preemption),
     ):
         try:
             details[key] = fn()
@@ -1355,6 +1463,7 @@ def main(argv=None):
     sweep = details.get("consolidation_sweep", {})
     decisions = details.get("decision_overhead", {})
     flightrec = details.get("flightrecorder_overhead", {})
+    gangs = details.get("gang_preemption", {})
     summary = {
         "metric": line["metric"],
         "value": line["value"],
@@ -1373,6 +1482,9 @@ def main(argv=None):
         "decision_within_budget": decisions.get("within_budget"),
         "flightrecorder_overhead_pct": flightrec.get("flightrecorder_overhead_pct"),
         "flightrecorder_within_budget": flightrec.get("within_budget"),
+        "gang_admission_p50_ms": gangs.get("gang_admission_p50_ms"),
+        "preemption_round_p50_ms": gangs.get("preemption_round_p50_ms"),
+        "gang_zero_partial": gangs.get("zero_partial"),
         "summary": True,
     }
     # the summary is the parse target: STRICT JSON, no NaN/Infinity tokens —

@@ -1,0 +1,127 @@
+"""Settings/doc/deploy drift gate — ``hack/docs`` verification for the
+settings surface.
+
+Checks, in EVERY direction, that the three places a setting must appear agree:
+
+* every ``Settings`` dataclass field has a row in the generated
+  ``docs/settings.md`` (run ``python hack/gen_docs.py`` to refresh);
+* every documented row names a field that still exists;
+* every field has a ``KARPENTER_TPU_<NAME>`` key in the deploy ConfigMap
+  manifest(s) (``deploy/manifests/configmap-*-global-settings.yaml`` — run
+  ``python deploy/render.py --out-dir deploy/manifests`` to refresh);
+* every ConfigMap key maps back to a live field (a deleted setting must take
+  its manifest key with it — a stale env key would fail ``Settings.from_env``
+  at operator boot, the worst place to discover drift).
+
+Wired as a tier-1 test (``tests/test_settings_docs.py``), same pattern as
+``check_metrics_docs.py`` / ``check_debug_endpoints.py``, and runnable
+standalone::
+
+    python hack/check_settings_docs.py   # exits 1 and prints the drift
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import re
+import sys
+from dataclasses import fields
+from typing import Dict, List
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+DOC = os.path.join(ROOT, "docs", "settings.md")
+MANIFEST_GLOB = os.path.join(
+    ROOT, "deploy", "manifests", "configmap-*-global-settings.yaml"
+)
+
+_ROW = re.compile(r"^\|\s*`([a-z0-9_]+)`\s*\|")
+_ENV_PREFIX = "KARPENTER_TPU_"
+
+
+def declared_settings() -> List[str]:
+    from karpenter_tpu.api.settings import Settings
+
+    return [f.name for f in fields(Settings) if not f.name.startswith("_")]
+
+
+def documented_settings(path: str = DOC) -> List[str]:
+    if not os.path.exists(path):
+        return []
+    with open(path) as f:
+        return [m.group(1) for line in f if (m := _ROW.match(line.strip()))]
+
+
+def configmap_keys() -> Dict[str, List[str]]:
+    """{manifest path: [env keys]} for every global-settings ConfigMap."""
+    import yaml
+
+    out: Dict[str, List[str]] = {}
+    for path in sorted(glob.glob(MANIFEST_GLOB)):
+        with open(path) as f:
+            obj = yaml.safe_load(f)
+        out[path] = sorted((obj or {}).get("data", {}).keys())
+    return out
+
+
+def check() -> List[str]:
+    """Every drift problem as a human-readable line; empty means clean."""
+    declared = declared_settings()
+    documented = documented_settings()
+    problems: List[str] = []
+    for name in declared:
+        if name not in documented:
+            problems.append(
+                f"setting {name} missing from docs/settings.md "
+                "(run python hack/gen_docs.py)"
+            )
+    for name in documented:
+        if name not in declared:
+            problems.append(
+                f"docs/settings.md documents {name} which no longer exists "
+                "(run python hack/gen_docs.py)"
+            )
+    manifests = configmap_keys()
+    if not manifests:
+        problems.append(f"no global-settings ConfigMap manifest matches {MANIFEST_GLOB}")
+    env_of = {f"{_ENV_PREFIX}{n.upper()}": n for n in declared}
+    from karpenter_tpu.api.settings import Settings
+
+    defaults = Settings(cluster_name="drift-check")
+    for path, keys in manifests.items():
+        rel = os.path.relpath(path, ROOT)
+        for name in declared:
+            # the renderer omits fields whose default is None or a mapping
+            # (deploy/render.py settings_configmap) — mirror that rule, or
+            # the gate flags manifests the renderer itself just produced
+            v = getattr(defaults, name)
+            if v is None or isinstance(v, dict):
+                continue
+            key = f"{_ENV_PREFIX}{name.upper()}"
+            if key not in keys:
+                problems.append(
+                    f"setting {name} missing from {rel} as {key} "
+                    "(run python deploy/render.py --out-dir deploy/manifests)"
+                )
+        for key in keys:
+            if key not in env_of:
+                problems.append(
+                    f"{rel} carries {key} which maps to no Settings field "
+                    "(run python deploy/render.py --out-dir deploy/manifests)"
+                )
+    return problems
+
+
+def main() -> int:
+    problems = check()
+    for p in problems:
+        print(f"DRIFT: {p}", file=sys.stderr)
+    if problems:
+        return 1
+    print(f"settings docs current: {len(declared_settings())} settings checked")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -25,6 +25,16 @@ EMPTINESS_TIMESTAMP_ANNOTATION = f"{GROUP}/emptiness-timestamp"
 LAUNCH_TEMPLATE_ANNOTATION = f"{GROUP}/launch-template"  # resolved config name
 TERMINATION_FINALIZER = f"{GROUP}/termination"
 
+# Gang scheduling (all-or-nothing pod groups): members name their gang with
+# the pod-group key as a LABEL or ANNOTATION (label preferred — it enters the
+# scheduling signature through the label surface; the annotation form is the
+# controller-friendly fallback and is folded into the signature explicitly by
+# encode._signature). ``min-members`` rides an annotation on any member: the
+# gang schedules only once at least that many members exist, and always as a
+# unit — all pending members place in one round or none do.
+POD_GROUP = f"{GROUP}/pod-group"
+POD_GROUP_MIN_MEMBERS = f"{GROUP}/pod-group-min-members"
+
 # Instance-type detail labels (reference: karpenter.k8s.aws/instance-*,
 # types.go:67-122)
 INSTANCE_GROUP = f"instance.{GROUP}"

@@ -179,6 +179,24 @@ class Pod:
         except ValueError:
             return 0.0
 
+    def pod_group(self) -> Optional[str]:
+        """Gang membership key (label preferred, annotation fallback); None
+        for pods outside any gang. Both forms are scheduling identity: the
+        label rides the signature's label surface, the annotation is folded
+        in explicitly (encode._signature's gang component)."""
+        return self.meta.labels.get(wk.POD_GROUP) or self.meta.annotations.get(
+            wk.POD_GROUP
+        )
+
+    def pod_group_min_members(self) -> int:
+        """The gang's all-or-nothing quorum (>=1). An unparseable or missing
+        annotation degrades to 1 — the gang still places atomically, it just
+        never waits for absent members."""
+        try:
+            return max(int(self.meta.annotations.get(wk.POD_GROUP_MIN_MEMBERS, 1)), 1)
+        except (TypeError, ValueError):
+            return 1
+
     def is_pending(self) -> bool:
         return self.phase == "Pending" and self.node_name is None
 

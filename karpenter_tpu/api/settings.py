@@ -71,6 +71,18 @@ class Settings:
     # karpenter_tpu_tracemalloc_top_bytes — measurable overhead, off by
     # default; karpenter_tpu_process_memory_bytes is always exported.
     memory_profiling_enabled: bool = False
+    # gang scheduling (solver/gang.py + the provisioning gang gate):
+    # all-or-nothing pod groups with rank-aware single-zone repacking.
+    # A no-op on batches without pod-group keys, so it defaults on.
+    gang_scheduling_enabled: bool = True
+    # priority preemption (controllers/preemption.py): unschedulable
+    # higher-priority gangs/pods evict the cheapest lower-priority victims
+    # (victim gangs whole) and bind onto the freed capacity in-round.
+    preemption_enabled: bool = True
+    # consecutive deferral rounds before a still-pending gang escalates to a
+    # GangWaitExceeded warning event (it keeps deferring either way —
+    # all-or-nothing is not negotiable); 0 disables the escalation.
+    gang_max_wait_rounds: int = 8
 
     def validate(self) -> None:
         if not self.cluster_name:
@@ -104,6 +116,10 @@ class Settings:
         if self.flight_recorder_capacity < 0:
             raise ValueError(
                 "flightRecorderCapacity must be >= 0 (0 disables the flight recorder)"
+            )
+        if self.gang_max_wait_rounds < 0:
+            raise ValueError(
+                "gangMaxWaitRounds must be >= 0 (0 disables the wait escalation)"
             )
 
     # -- config system (reference: karpenter-global-settings ConfigMap,

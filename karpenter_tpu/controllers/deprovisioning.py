@@ -476,6 +476,13 @@ class DeprovisioningController:
                 if not pod.owned():
                     blocker = (pod.name, "controllerless pod cannot be recreated")
                     break
+                if self.settings.gang_scheduling_enabled and pod.pod_group():
+                    # conservative: consolidation re-places pods one at a
+                    # time, which would transiently drop a gang below quorum
+                    # — an atomic pod group moves only via preemption (whole)
+                    # or its own controller, never a cost sweep
+                    blocker = (pod.name, "gang member (atomic pod group)")
+                    break
                 if self.termination._pdb_blocks(pod):
                     blocker = (pod.name, "pod disruption budget violated")
                     break

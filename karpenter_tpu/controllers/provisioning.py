@@ -16,7 +16,7 @@ failed offerings.
 from __future__ import annotations
 
 import time
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from ..api import labels as wk
@@ -27,7 +27,9 @@ from ..api.settings import Settings
 from ..api.taints import tolerates_all
 from ..cloudprovider.interface import CloudProvider, CloudProviderError, InsufficientCapacityError
 from ..cloudprovider.types import InstanceType
+from ..solver import gang as gangmod
 from ..solver.encode import ExistingNode
+from ..solver.gang import Gang
 from ..solver.result import NewNodeSpec, SolveResult
 from ..solver.session import EncodeSession
 from ..solver.solver import Solver, TPUSolver
@@ -36,6 +38,7 @@ from ..utils import metrics
 from ..utils.decisions import DECISIONS
 from ..utils.events import Recorder
 from ..utils.resilience import RetryPolicy, retry_policy_from_settings
+from .preemption import MAX_PREEMPTORS_PER_ROUND, PreemptionPlanner, Preemptor
 
 class MachineNameSeq:
     """Monotonic machine-name counter. Not a bare ``itertools.count``: the
@@ -105,6 +108,26 @@ class ProvisioningResult:
     bound: Dict[str, str]  # pod name -> node name
     unschedulable: List[str]
     solve: Optional[SolveResult] = None
+    # gang members the gate deferred this round (all-or-nothing: below quorum
+    # or no atomic placement) — deliberately NOT in ``unschedulable``, which
+    # carries per-pod infeasibility; gangs wait by design
+    gang_deferred: List[str] = field(default_factory=list)
+
+
+@dataclass
+class GangGateOutcome:
+    """One cascade round's gang-gate verdicts (see _gang_gate)."""
+
+    solve: SolveResult  # the gated (possibly stripped/swapped) result shell
+    deferred: List[str]  # member names stripped this round
+    admitted: List[str]  # member names whose gang fully placed
+    admitted_gangs: List[str]
+    capacity_deferred: List[str]  # gang names deferred for capacity (quorum met)
+    # per admitted gang: the zone set / scatter / price-delta details the
+    # final ``gang-admitted`` verdict carries — emitted only once the round
+    # ends with every member actually BOUND (launch failures can still split
+    # a gate-admitted gang; _finalize_gangs rolls those back instead)
+    admitted_details: Dict[str, Dict] = field(default_factory=dict)
 
 
 class ProvisioningController:
@@ -139,6 +162,13 @@ class ProvisioningController:
             full_resync_every=self.settings.encode_full_resync_every,
             enabled=self.settings.encode_delta_enabled,
         )
+        # gang gate state: consecutive deferral RECONCILES per gang (the
+        # gang_max_wait_rounds escalation), reset on admission; _ticked is
+        # the per-reconcile guard so cascade re-solves within one reconcile
+        # count as a single wait
+        self._gang_wait: Dict[str, int] = {}
+        self._gang_wait_ticked: set = set()
+        self.preemption = PreemptionPlanner(cluster, self.solver, self.recorder)
         cluster.watch(self._on_event)
 
     def _on_event(self, event: str, obj) -> None:
@@ -226,6 +256,22 @@ class ProvisioningController:
             return result
 
         daemonsets = self.cluster.daemonsets()
+        # gangs in this batch (empty dict when the feature is off or no pod
+        # carries a pod-group key — the gate is then a no-op)
+        gangs: Dict[str, Gang] = (
+            gangmod.collect_gangs(pods)
+            if self.settings.gang_scheduling_enabled
+            else {}
+        )
+        self._gang_wait_ticked.clear()  # new reconcile: each gang may tick once
+        if len(self._gang_wait) > 512:
+            # bound the wait map: gangs that vanished without ever admitting
+            # (cancelled jobs, deleted members) would otherwise accrete one
+            # entry each, forever, in a long-lived operator
+            live = {g for p in self.cluster.pods.values() if (g := p.pod_group())}
+            self._gang_wait = {
+                k: v for k, v in self._gang_wait.items() if k in live
+            }
 
         # Pool cascade (reference: provisioners are tried highest-weight-first
         # and a pool that cannot host — limits reached, zone coverage too
@@ -238,6 +284,12 @@ class ProvisioningController:
         batch = list(pods)
         exhausted: set = set()
         ice_retries = 0
+        # gangs the gate deferred for CAPACITY (quorum met, no atomic
+        # placement) — the preemption planner's work list after the cascade
+        capacity_gangs: Dict[str, Gang] = {}
+        # per-gang admission details from the LAST gate round that fully
+        # placed it — the final gang-admitted verdict's payload
+        gang_admit_details: Dict[str, Dict] = {}
         # why each pod ended the pass unschedulable (the audit-log reason):
         # limits exhaustion and catalog infeasibility are DIFFERENT root
         # causes and must not be conflated in /debug/decisions
@@ -295,6 +347,27 @@ class ProvisioningController:
             if cap is not None:
                 cap.add_digest(solve.problem_digest)
             metrics.SOLVE_DURATION.observe(solve.stats.get("total_s", 0.0))
+            if gangs:
+                # all-or-nothing gate BEFORE anything binds: partial gang
+                # placements are stripped (and scattered full placements
+                # rank-aware repacked) on a fresh result shell — the solver
+                # may have served this SolveResult from a cache, so its lists
+                # are never mutated in place
+                gate = self._gang_gate(solve, gangs, round_provs, daemonsets, cap)
+                solve = gate.solve
+                admitted = set(gate.admitted)
+                result.gang_deferred = [
+                    n for n in result.gang_deferred if n not in admitted
+                ]
+                for name in gate.deferred:
+                    if name not in result.gang_deferred:
+                        result.gang_deferred.append(name)
+                for gname in gate.admitted_gangs:
+                    capacity_gangs.pop(gname, None)
+                for gname in gate.capacity_deferred:
+                    capacity_gangs[gname] = gangs[gname]
+                    gang_admit_details.pop(gname, None)
+                gang_admit_details.update(gate.admitted_details)
             limit_hit, ice_failed = self._apply_solve(solve, result, round_provs)
             retry_ice = bool(ice_failed) and ice_retries < self._ICE_RETRIES
             if retry_ice:
@@ -325,6 +398,23 @@ class ProvisioningController:
                     object_kind="Pod", type="Warning",
                 )
             break
+        # Preemption: higher-priority demand that survived EVERY cascade round
+        # (a capacity-deferred or launch-blocked gang, or an unschedulable
+        # prioritized pod) may displace cheaper lower-priority victims and
+        # bind in this same round.
+        preempted_gangs: set = set()
+        if self.settings.preemption_enabled and (
+            result.unschedulable or result.gang_deferred or capacity_gangs
+        ):
+            preempted_gangs = self._run_preemption(
+                result, gangs, capacity_gangs, cap
+            )
+        # All-or-nothing epilogue: launch failures (limits, ICE, cloud
+        # errors) can split a gate-admitted gang AFTER the gate ran — roll
+        # those bindings back so a gang is never partially placed, and emit
+        # the gang-admitted verdict only for gangs that actually bound whole.
+        if gangs:
+            self._finalize_gangs(gangs, result, gang_admit_details, preempted_gangs)
         # final per-pod unschedulable verdicts for the audit log (the pods
         # that survived every cascade round unplaced); metric inc'd once
         for i, name in enumerate(result.unschedulable):
@@ -342,6 +432,350 @@ class ProvisioningController:
     #: the failed offering(s) freshly masked, so one retry normally lands the
     #: next-cheapest offering; a storm falls back to the next reconcile
     _ICE_RETRIES = 2
+
+    # -- gang scheduling ----------------------------------------------------
+    def _gang_gate(
+        self,
+        solve: SolveResult,
+        gangs: Dict[str, Gang],
+        round_provs,
+        daemonsets,
+        cap,
+    ) -> "GangGateOutcome":
+        """All-or-nothing + rank-aware gate between solve and bind.
+
+        Per gang (deterministic name order): below quorum or partially placed
+        -> every member's placement is STRIPPED and the gang defers whole
+        (``gang-deferred-insufficient-members`` / ``gang-deferred`` verdicts);
+        fully placed but zone-scattered on pure fresh nodes -> a bounded
+        single-zone replan (solver/gang.py) swaps in topology-adjacent
+        placement when it beats the scatter-penalized cost; fully placed ->
+        ``gang-admitted`` with the zone set and price delta. Returns a NEW
+        SolveResult shell — the input (possibly cache-shared) is not mutated.
+        """
+        node_zone = lambda name: (  # noqa: E731 — tiny closure over the store
+            n.zone() if (n := self.cluster.nodes.get(name)) is not None else ""
+        )
+        strip: set = set()
+        deferred: List[str] = []
+        admitted: List[str] = []
+        admitted_gangs: List[str] = []
+        capacity_deferred: List[str] = []
+        admitted_details: Dict[str, Dict] = {}
+        drop_spec_idx: set = set()
+        swap_specs: List[NewNodeSpec] = []
+        digest_sink = cap.add_digest if cap is not None else None
+        for name in sorted(gangs):
+            g = gangs[name]
+            # judge only the members still unbound: a mid-cascade round must
+            # not re-defer (or roll back) a gang whose members an EARLIER
+            # round already bound — it heals the remainder instead
+            unbound = [p for p in g.pods if p.node_name is None]
+            if not unbound:
+                continue  # fully bound by an earlier round: nothing to judge
+            bound = gangmod.bound_members(self.cluster, name)
+            g_round = Gang(
+                name=name, pods=unbound, min_members=g.min_members,
+                priority=g.priority,
+            )
+            unbound_names = g_round.member_names
+            placement = gangmod.gang_placement(solve, g_round, node_zone)
+            alive = len(unbound) + len(bound)
+            if alive < g.min_members:
+                strip.update(unbound_names)
+                deferred.extend(sorted(unbound_names))
+                self._note_gang_deferral(
+                    g, "gang-deferred-insufficient-members",
+                    f"{alive}/{g.min_members} members present",
+                    {"members": alive, "min_members": g.min_members},
+                )
+                continue
+            if placement.unplaced:
+                strip.update(unbound_names)
+                deferred.extend(sorted(unbound_names))
+                capacity_deferred.append(name)
+                self._note_gang_deferral(
+                    g, "gang-deferred",
+                    "insufficient capacity for atomic placement",
+                    {
+                        "members": len(g.pods),
+                        "unplaced": len(placement.unplaced),
+                    },
+                )
+                continue
+            # fully placed: rank-aware zone packing for pure fresh-node gangs
+            # (only when the WHOLE gang is being placed this round — already-
+            # bound members pin their zones and are never repacked)
+            price_delta = 0.0
+            zones = set(placement.zones)
+            zones.update(z for p in bound if (z := node_zone(p.node_name or "")))
+            if placement.pure and len(zones) > 1 and not bound:
+                replan = gangmod.rank_aware_replan(
+                    self.solver, g, placement.cost, zones, round_provs,
+                    daemonsets=daemonsets, digest_sink=digest_sink,
+                )
+                if replan is not None:
+                    zone, specs, cost = replan
+                    drop_spec_idx.update(placement.pure_spec_idx)
+                    swap_specs.extend(specs)
+                    price_delta = round(cost - placement.cost, 5)
+                    zones = {zone}
+            admitted.extend(sorted(unbound_names))
+            admitted_gangs.append(name)
+            admitted_details[name] = {
+                "members": len(g.pods),
+                "zones": sorted(zones),
+                "scattered": len(zones) > 1,
+                "price_delta": price_delta,
+            }
+        if not strip and not drop_spec_idx:
+            return GangGateOutcome(
+                solve, deferred, admitted, admitted_gangs, capacity_deferred,
+                admitted_details,
+            )
+        new_nodes: List[NewNodeSpec] = []
+        for idx, spec in enumerate(solve.new_nodes):
+            if idx in drop_spec_idx:
+                continue  # replaced by the rank-aware single-zone specs
+            names = [n for n in spec.pod_names if n not in strip]
+            if not names:
+                continue
+            if len(names) == len(spec.pod_names):
+                new_nodes.append(spec)
+            else:
+                new_nodes.append(
+                    NewNodeSpec(
+                        option=spec.option, pod_names=names,
+                        option_index=spec.option_index,
+                    )
+                )
+        new_nodes.extend(swap_specs)
+        existing: Dict[str, List[str]] = {}
+        for node_name, pod_names in solve.existing_assignments.items():
+            names = [n for n in pod_names if n not in strip]
+            if names:
+                existing[node_name] = names
+        gated = SolveResult(
+            new_nodes=new_nodes,
+            existing_assignments=existing,
+            unschedulable=[n for n in solve.unschedulable if n not in strip],
+            cost=sum(s.option.price for s in new_nodes),
+            stats=dict(solve.stats),
+            problem_digest=solve.problem_digest,
+        )
+        if deferred and cap is not None:
+            from ..utils.flightrecorder import TRIGGER_GANG_DEFERRED
+
+            cap.note_anomaly(TRIGGER_GANG_DEFERRED)
+        return GangGateOutcome(
+            gated, deferred, admitted, admitted_gangs, capacity_deferred,
+            admitted_details,
+        )
+
+    def _note_gang_deferral(
+        self, g: Gang, outcome: str, reason: str, details: Dict
+    ) -> None:
+        # one wait tick per RECONCILE, not per cascade round: limit-hit/ICE
+        # re-solve rounds re-judge a still-deferred gang several times within
+        # a single reconcile, and each is the same wait, not a new one
+        if g.name in self._gang_wait_ticked:
+            waited = self._gang_wait.get(g.name, 1)
+        else:
+            waited = self._gang_wait.get(g.name, 0) + 1
+            self._gang_wait[g.name] = waited
+            self._gang_wait_ticked.add(g.name)
+            # escalate exactly once when the wait budget is crossed: the gang
+            # keeps deferring (all-or-nothing is not negotiable) but operators
+            # get the same FailedScheduling signal an unschedulable pod would
+            if waited == self.settings.gang_max_wait_rounds:
+                self.recorder.publish(
+                    "GangWaitExceeded",
+                    f"gang {g.name} still pending after {waited} rounds: {reason}",
+                    object_name=g.name, object_kind="PodGroup", type="Warning",
+                )
+        metrics.GANG_VERDICTS.inc({"outcome": outcome.replace("gang-", "", 1)})
+        DECISIONS.record_coalesced(
+            "gang", outcome, pod=g.name, reason=reason,
+            details={**details, "wait_rounds": waited},
+        )
+
+    # -- preemption ---------------------------------------------------------
+    def _run_preemption(
+        self,
+        result: ProvisioningResult,
+        gangs: Dict[str, Gang],
+        capacity_gangs: Dict[str, Gang],
+        cap,
+    ) -> set:
+        """Displace lower-priority victims for the round's still-unplaced
+        higher-priority demand, highest priority first, bounded per round.
+        Gangs preempt WHOLE (their trial solve places every pending member or
+        the plan is rejected) — a gang member never preempts as a singleton.
+        Returns the names of gangs admitted via preemption."""
+        floor = None
+        for p in self.cluster.pods.values():
+            if p.node_name is not None and not p.is_daemonset:
+                if floor is None or p.priority < floor:
+                    floor = p.priority
+        if floor is None:
+            return set()  # nothing bound, nothing to evict
+        launch_blocked = set(result.unschedulable)
+        preemptors: List[Preemptor] = []
+        # a gang preempts as a unit only when CAPACITY blocked it — the gate
+        # deferred it with quorum met (capacity_gangs) or launches failed
+        # after admission (members in unschedulable). A quorum-deferred gang
+        # (members only in gang_deferred) must NEVER preempt: evicting
+        # victims to bind a sub-quorum gang is the exact partial-placement
+        # failure gang scheduling exists to prevent.
+        for gname in sorted(gangs):
+            g = gangs[gname]
+            if gname not in capacity_gangs and not (g.member_names & launch_blocked):
+                continue
+            pending = [
+                q for n in sorted(g.member_names)
+                if (q := self.cluster.pods.get(n)) is not None and q.is_pending()
+            ]
+            alive = len(pending) + len(gangmod.bound_members(self.cluster, gname))
+            if alive < g.min_members:
+                continue  # belt-and-braces: below quorum, never preempt
+            if pending and g.priority > floor:
+                preemptors.append(
+                    Preemptor(
+                        name=gname, pods=pending, priority=g.priority,
+                        is_gang=True,
+                    )
+                )
+        gang_members = {n for g in gangs.values() for n in g.member_names}
+        for name in sorted(set(result.unschedulable)):
+            pod = self.cluster.pods.get(name)
+            if (
+                pod is not None and pod.is_pending()
+                and name not in gang_members and pod.priority > floor
+            ):
+                preemptors.append(
+                    Preemptor(name=name, pods=[pod], priority=pod.priority)
+                )
+        preemptors.sort(key=lambda p: (-p.priority, p.name))
+        digest_sink = cap.add_digest if cap is not None else None
+        preempted_gangs: set = set()
+        for pre in preemptors[:MAX_PREEMPTORS_PER_ROUND]:
+            plan = self.preemption.plan(pre, digest_sink=digest_sink)
+            if plan is None:
+                DECISIONS.record_coalesced(
+                    "preemption", "infeasible", pod=pre.name,
+                    reason="no eligible lower-priority victim set frees "
+                           "enough compatible capacity",
+                )
+                continue
+            self.preemption.execute(plan)
+            # victims bound EARLIER THIS RECONCILE (e.g. fresh serving churn
+            # the cascade just placed) are Pending again: drop them from the
+            # round's bound map so the result/capsule agrees with cluster
+            # state and _finalize_gangs never mistakes a preempted victim
+            # gang for a launch-failure partial placement
+            for victim in plan.victim_names:
+                result.bound.pop(victim, None)
+            # the accepted trial IS the post-eviction placement: bind it
+            self._apply_solve(plan.result, result, ())
+            placed = {p.meta.name for p in pre.pods}
+            result.unschedulable = [
+                n for n in result.unschedulable if n not in placed
+            ]
+            result.gang_deferred = [
+                n for n in result.gang_deferred if n not in placed
+            ]
+            if pre.is_gang:
+                preempted_gangs.add(pre.name)
+                self._gang_wait.pop(pre.name, None)
+                metrics.GANG_VERDICTS.inc({"outcome": "admitted-preemption"})
+                DECISIONS.record(
+                    "gang", "gang-admitted", pod=pre.name,
+                    reason="admitted after preemption",
+                    details={
+                        "members": len(pre.pods),
+                        "victims": plan.victim_names,
+                        "price_delta": plan.price_delta,
+                    },
+                )
+        return preempted_gangs
+
+    def _finalize_gangs(
+        self,
+        gangs: Dict[str, Gang],
+        result: ProvisioningResult,
+        admit_details: Dict[str, Dict],
+        preempted_gangs: set,
+    ) -> None:
+        """End-of-round all-or-nothing enforcement. A gang some of whose
+        members bound while others could not (a launch failure split a
+        gate-admitted gang across specs) has its fresh bindings ROLLED BACK —
+        the pods return to Pending through the eviction path (watch events
+        keep the delta encoder's dirty set exact) and the gang defers whole.
+        Gangs that bound completely get their ``gang-admitted`` verdict here,
+        where "admitted" provably means "running"."""
+        from .termination import evict_pod
+
+        unsched = set(result.unschedulable)
+        for name in sorted(gangs):
+            g = gangs[name]
+            members = g.member_names
+            bound_now = sorted(n for n in members if n in result.bound)
+            if not bound_now:
+                if members & unsched:
+                    # launches failed for the WHOLE gang (limits/ICE/cloud
+                    # errors after the gate admitted it): nothing bound, but
+                    # the gang must still explain itself as deferred — its
+                    # members wait by design, they are not per-pod infeasible
+                    result.unschedulable = [
+                        n for n in result.unschedulable if n not in members
+                    ]
+                    for n in sorted(members):
+                        if n not in result.gang_deferred:
+                            result.gang_deferred.append(n)
+                    self._note_gang_deferral(
+                        g, "gang-deferred",
+                        "launch failures blocked atomic placement",
+                        {"members": len(g.pods)},
+                    )
+                continue
+            still_pending = sorted(
+                n for n in members
+                if (q := self.cluster.pods.get(n)) is not None and q.is_pending()
+            )
+            if still_pending:
+                for n in bound_now:
+                    pod = self.cluster.pods.get(n)
+                    if pod is not None and pod.node_name is not None:
+                        # requeue_unowned: this is a rollback of a bind made
+                        # THIS round, not an eviction — an unowned member is
+                        # un-placed, never deleted (deleting it would leave
+                        # the gang permanently below quorum)
+                        evict_pod(
+                            self.cluster, pod, self.recorder,
+                            reason=f"gang {name} partial placement rolled back",
+                            requeue_unowned=True,
+                        )
+                    result.bound.pop(n, None)
+                result.unschedulable = [
+                    n for n in result.unschedulable if n not in members
+                ]
+                for n in sorted(members):
+                    if n not in result.gang_deferred:
+                        result.gang_deferred.append(n)
+                self._note_gang_deferral(
+                    g, "gang-deferred",
+                    "partial placement rolled back (launch failures)",
+                    {"members": len(g.pods), "rolled_back": len(bound_now)},
+                )
+                continue
+            if name in preempted_gangs:
+                continue  # verdict already emitted by the preemption path
+            metrics.GANG_VERDICTS.inc({"outcome": "admitted"})
+            DECISIONS.record(
+                "gang", "gang-admitted", pod=name,
+                details=admit_details.get(name, {"members": len(g.pods)}),
+            )
+            self._gang_wait.pop(name, None)
 
     def _apply_solve(
         self,

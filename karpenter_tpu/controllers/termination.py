@@ -14,7 +14,7 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import List, Optional
+from typing import AbstractSet, List, Optional
 
 from ..api import labels as wk
 from ..api.objects import Node, Pod
@@ -23,6 +23,53 @@ from ..state.cluster import Cluster
 from ..utils import metrics
 from ..utils.cache import Clock
 from ..utils.events import Recorder
+
+
+def evict_pod(
+    cluster: Cluster,
+    pod: Pod,
+    recorder: Recorder,
+    reason: str = "evicted",
+    requeue_unowned: bool = False,
+) -> None:
+    """The kube eviction-API semantics, shared by node drain and the
+    preemption planner: an owned pod returns to Pending (its controller
+    recreates it — the unbind fires a MODIFIED watch event, so the delta
+    encoder's dirty set and the batch window both see it re-enter the pending
+    population); an unowned pod is deleted outright (a DELETED event).
+    ``requeue_unowned`` is for rolling back a bind made THIS round (the gang
+    partial-placement epilogue): nothing ran yet, so even an unowned pod is
+    simply un-placed rather than destroyed."""
+    if pod.owned() or requeue_unowned:
+        pod.node_name = None
+        pod.phase = "Pending"
+        cluster.update(pod)
+    else:
+        cluster.delete_pod(pod.name)
+    recorder.publish("Evicted", reason, object_name=pod.name, object_kind="Pod")
+
+
+def pdb_blocks(cluster: Cluster, pod: Pod, planned: AbstractSet[str] = frozenset()) -> bool:
+    """Eviction-API accounting: an eviction is allowed only while it keeps the
+    budget satisfied, counting pods ALREADY disrupted (selected but not bound
+    to a node) against maxUnavailable — so draining N nodes at once cannot
+    take every replica of a maxUnavailable=1 budget in one pass. Shared by
+    drain, consolidation candidate filtering, and preemption victim vetting;
+    ``planned`` names pods an in-flight plan has already slated for eviction,
+    counted as disrupted so a multi-victim preemption plan cannot collectively
+    blow a budget its victims would each clear alone."""
+    for pdb in cluster.pdbs_for_pod(pod):
+        selected = [p for p in cluster.pods.values() if pdb.selects(p)]
+        healthy = sum(
+            1 for p in selected
+            if p.node_name is not None and p.name not in planned
+        )
+        unavailable = len(selected) - healthy
+        if pdb.min_available is not None and healthy - 1 < pdb.min_available:
+            return True
+        if pdb.max_unavailable is not None and unavailable + 1 > pdb.max_unavailable:
+            return True
+    return False
 
 
 class TerminationController:
@@ -153,27 +200,7 @@ class TerminationController:
         return blocked
 
     def _pdb_blocks(self, pod: Pod) -> bool:
-        """Eviction-API accounting: an eviction is allowed only while it keeps the
-        budget satisfied, counting pods ALREADY disrupted (selected but not bound
-        to a node) against maxUnavailable — so draining N nodes at once cannot
-        take every replica of a maxUnavailable=1 budget in one pass."""
-        for pdb in self.cluster.pdbs_for_pod(pod):
-            selected = [p for p in self.cluster.pods.values() if pdb.selects(p)]
-            healthy = sum(1 for p in selected if p.node_name is not None)
-            unavailable = len(selected) - healthy
-            if pdb.min_available is not None and healthy - 1 < pdb.min_available:
-                return True
-            if pdb.max_unavailable is not None and unavailable + 1 > pdb.max_unavailable:
-                return True
-        return False
+        return pdb_blocks(self.cluster, pod)
 
     def _evict(self, pod: Pod) -> None:
-        if pod.owned():
-            # the owning controller recreates it: back to Pending
-            pod.node_name = None
-            pod.phase = "Pending"
-            self.cluster.update(pod)
-        else:
-            self.cluster.delete_pod(pod.name)
-        self.recorder.publish("Evicted", f"evicted from {pod.name}",
-                              object_name=pod.name, object_kind="Pod")
+        evict_pod(self.cluster, pod, self.recorder, reason=f"evicted from {pod.name}")

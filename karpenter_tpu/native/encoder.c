@@ -13,9 +13,10 @@
  *   - the signature tuple layout is (requests_items, node_selector_items,
  *     req_terms, tolerations, spread, affinity, labels_items)
  *   - pods with any "complex" field non-empty (required_affinity_terms,
- *     tolerations, topology_spread, affinity_terms) are signed by calling
- *     back into the Python _signature — only the dominant simple shape is
- *     specialized here
+ *     tolerations, topology_spread, affinity_terms) — or carrying a gang /
+ *     priority component (nonzero priority, annotation-form pod-group key) —
+ *     are signed by calling back into the Python _signature; only the
+ *     dominant simple shape is specialized here
  *   - items tuples are insertion-ordered (see encode._items_t for why that
  *     is safe for grouping)
  *   - the computed signature is cached on pod.__dict__["_sched_sig"] with
@@ -32,7 +33,8 @@
 static PyObject *sig_key = NULL; /* interned "_sched_sig" */
 static PyObject *s_required_affinity_terms, *s_tolerations, *s_topology_spread,
     *s_affinity_terms, *s_requests, *s_r, *s_node_selector, *s_meta, *s_labels,
-    *s_preferred_affinity_terms, *s_volume_zones;
+    *s_preferred_affinity_terms, *s_volume_zones, *s_priority, *s_annotations,
+    *pod_group_key; /* "karpenter.tpu/pod-group" (lockstep with labels.POD_GROUP) */
 
 /* tuple(d.items()) for a dict; () for empty/non-dict (caller validates). */
 static PyObject *
@@ -92,6 +94,45 @@ nonempty_list_attr(PyObject *obj, PyObject *idict, PyObject *name)
     return n > 0;
 }
 
+/* Gang/priority carrier check: encode._signature appends a gang component
+ * for pods with a nonzero priority or an annotation-form pod-group key, so
+ * those pods must take the Python signature path (and never merge through
+ * the adjacency fast path — a gang member must not bucket with an
+ * otherwise-identical plain pod). Returns 1 when the pod carries either,
+ * 0 otherwise, -1 on error. */
+static int
+gang_or_priority(PyObject *pod, PyObject *idict)
+{
+    PyObject *prio, *meta, *ann;
+    int truthy;
+
+    prio = field_get(pod, idict, s_priority);
+    if (prio == NULL)
+        return -1;
+    truthy = PyObject_IsTrue(prio);
+    Py_DECREF(prio);
+    if (truthy != 0)
+        return truthy; /* nonzero priority or error */
+    meta = field_get(pod, idict, s_meta);
+    if (meta == NULL)
+        return -1;
+    ann = PyObject_GetAttr(meta, s_annotations);
+    Py_DECREF(meta);
+    if (ann == NULL)
+        return -1;
+    if (PyDict_CheckExact(ann)) {
+        if (PyDict_GET_SIZE(ann) == 0) {
+            Py_DECREF(ann);
+            return 0;
+        }
+        truthy = PyDict_Contains(ann, pod_group_key);
+    } else {
+        truthy = PySequence_Contains(ann, pod_group_key);
+    }
+    Py_DECREF(ann);
+    return truthy;
+}
+
 static PyObject *
 signature_for(PyObject *pod, PyObject *py_signature, int *simple_out)
 {
@@ -128,6 +169,8 @@ signature_for(PyObject *pod, PyObject *py_signature, int *simple_out)
         complex_shape = nonempty_list_attr(pod, dict, s_preferred_affinity_terms);
     if (complex_shape == 0)
         complex_shape = nonempty_list_attr(pod, dict, s_volume_zones);
+    if (complex_shape == 0)
+        complex_shape = gang_or_priority(pod, dict);
     if (complex_shape < 0) {
         Py_DECREF(dict);
         return NULL;
@@ -230,6 +273,8 @@ matches_prev(PyObject *pod, PyObject *prev_r, PyObject *prev_sel,
         complex_shape = nonempty_list_attr(pod, NULL, s_preferred_affinity_terms);
     if (complex_shape == 0)
         complex_shape = nonempty_list_attr(pod, NULL, s_volume_zones);
+    if (complex_shape == 0)
+        complex_shape = gang_or_priority(pod, NULL);
     if (complex_shape != 0)
         return complex_shape < 0 ? -1 : 0;
 
@@ -406,11 +451,15 @@ PyInit__encoder(void)
     s_labels = PyUnicode_InternFromString("labels");
     s_preferred_affinity_terms = PyUnicode_InternFromString("preferred_affinity_terms");
     s_volume_zones = PyUnicode_InternFromString("volume_zones");
+    s_priority = PyUnicode_InternFromString("priority");
+    s_annotations = PyUnicode_InternFromString("annotations");
+    pod_group_key = PyUnicode_InternFromString("karpenter.tpu/pod-group");
     if (sig_key == NULL || s_required_affinity_terms == NULL ||
         s_tolerations == NULL || s_topology_spread == NULL ||
         s_affinity_terms == NULL || s_requests == NULL || s_r == NULL ||
         s_node_selector == NULL || s_meta == NULL || s_labels == NULL ||
-        s_preferred_affinity_terms == NULL || s_volume_zones == NULL)
+        s_preferred_affinity_terms == NULL || s_volume_zones == NULL ||
+        s_priority == NULL || s_annotations == NULL || pod_group_key == NULL)
         return NULL;
     return PyModule_Create(&moduledef);
 }

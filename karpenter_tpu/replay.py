@@ -460,7 +460,7 @@ def replay_capsule(
         "recorded": {
             k: recorded.get(k)
             for k in ("problem_digests", "placements", "unschedulable",
-                      "action", "planned", "decisions")
+                      "gang_deferred", "action", "planned", "decisions")
             if k in recorded
         },
     }
@@ -486,6 +486,13 @@ def replay_capsule(
             sorted(recorded.get("unschedulable", []))
             == sorted(replayed.get("unschedulable", []))
         )
+        # gang deferral is a round OUTPUT like unschedulable: a replay that
+        # defers a different member set diverged even when digests and bound
+        # placements agree (pre-gang capsules lack the key on both sides)
+        diffs["gang_deferred_match"] = (
+            sorted(recorded.get("gang_deferred", []))
+            == sorted(replayed.get("gang_deferred", []))
+        )
         rec_keys = _decision_keys(recorded.get("decisions", []))
         rep_keys = _decision_keys(replayed.get("decisions", []))
         diffs["decisions_match"] = rec_keys == rep_keys
@@ -493,6 +500,7 @@ def replay_capsule(
             diffs["digests_match"]
             and diffs["placements_match"]
             and diffs["unschedulable_match"]
+            and diffs["gang_deferred_match"]
         )
     else:
         rec_action = recorded.get("action") or recorded.get("planned")
@@ -748,6 +756,9 @@ def _print_summary(report: Dict) -> None:
         print(f"  unschedulable: recorded={len(rec.get('unschedulable') or [])} "
               f"replayed={len(rep.get('unschedulable') or [])} "
               f"equal={diffs.get('unschedulable_match')}")
+        print(f"  gang_deferred: recorded={len(rec.get('gang_deferred') or [])} "
+              f"replayed={len(rep.get('gang_deferred') or [])} "
+              f"equal={diffs.get('gang_deferred_match')}")
         print(f"  decisions: equal={diffs.get('decisions_match')}")
     else:
         rep = report.get("replayed", {})

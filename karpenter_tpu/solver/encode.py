@@ -150,6 +150,21 @@ def _signature(pod: Pod) -> tuple:
     aff = _EMPTY
     if pod.affinity_terms:
         aff = tuple(sorted(_aff_sig(t) for t in pod.affinity_terms))
+    # Gang/priority component: a gang member (annotation-form pod-group; the
+    # label form already rides the label surface) or a prioritized pod must
+    # never bucket with an otherwise-identical plain pod — the gang gate's
+    # all-or-nothing unit and the preemption planner's entitlement both key
+    # off group purity. Absent for the plain-pod common case, so existing
+    # signatures (and problem digests) are unchanged. The native encoder
+    # defers these pods to this function (encoder.c: gang/priority check).
+    gang = _EMPTY
+    ann = pod.meta.annotations
+    if pod.priority or (ann and wk.POD_GROUP in ann):
+        gang = (
+            pod.priority,
+            ann.get(wk.POD_GROUP, ""),
+            ann.get(wk.POD_GROUP_MIN_MEMBERS, ""),
+        )
     sig = (
         _items_t(pod.requests.items_mapping()),
         _items_t(pod.node_selector),
@@ -161,6 +176,8 @@ def _signature(pod: Pod) -> tuple:
         soft,
         vz,
     )
+    if gang is not _EMPTY:
+        sig = sig + (gang,)
     pod.__dict__["_sched_sig"] = sig
     return sig
 

@@ -58,6 +58,7 @@ TRIGGER_ERROR = "reconcile-error"
 TRIGGER_UNSCHEDULABLE = "unschedulable-pods"
 TRIGGER_FULL_ENCODE = "full-encode-fallback"
 TRIGGER_BREAKER = "breaker-open"
+TRIGGER_GANG_DEFERRED = "gang-deferred"
 
 #: full-encode reasons that are NORMAL operation, not an anomaly: the first
 #: encode of a session, the periodic backstop, and a disabled delta path
@@ -329,6 +330,7 @@ def provisioning_outputs(result, cluster) -> Dict:
     return {
         "placements": placements,
         "unschedulable": sorted(set(result.unschedulable)),
+        "gang_deferred": sorted(set(getattr(result, "gang_deferred", []) or [])),
         "new_nodes": [
             {
                 "name": m.meta.name,

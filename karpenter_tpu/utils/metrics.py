@@ -293,6 +293,20 @@ PODS_UNSCHEDULABLE = Gauge(
     help="Pods the last provisioning pass could not place on any offering.",
     registry=REGISTRY,
 )
+GANG_VERDICTS = Counter(
+    "karpenter_tpu_gang_verdicts_total",
+    help="Gang-gate verdicts per pod group per round, labeled by outcome: "
+         "admitted, deferred (atomic placement impossible), "
+         "deferred-insufficient-members (below quorum), admitted-preemption "
+         "(placed after evicting victims).",
+    registry=REGISTRY,
+)
+PREEMPTION_EVICTIONS = Counter(
+    "karpenter_tpu_preemption_evictions_total",
+    help="Pods evicted by the preemption planner to place higher-priority "
+         "demand, labeled by preemptor kind (gang or pod).",
+    registry=REGISTRY,
+)
 NODES_CREATED = Counter(
     "karpenter_tpu_nodes_created_total",
     help="Nodes launched, labeled by owning provisioner.",

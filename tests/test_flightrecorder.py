@@ -125,12 +125,26 @@ class TestInstanceTypeCodec:
 
     def test_encode_digest_survives_codec_round_trip(self):
         """The contract everything rests on: a from-scratch encode of
-        codec-round-tripped inputs is byte-identical to the original."""
+        codec-round-tripped inputs is byte-identical to the original —
+        including the ISSUE 6 gang/priority fields (pod-group annotations and
+        ``priority``), which carry scheduling identity through the signature's
+        gang component."""
+        import random
+
         from karpenter_tpu.api import codec
+        from karpenter_tpu.api import labels as wk
         from karpenter_tpu.solver.encode import encode
         from karpenter_tpu.solver.solver import problem_digest
 
-        pods = make_pods(5, prefix="dig", cpu="250m", memory="512Mi")
+        rng = random.Random(6)
+        pods = make_pods(12, prefix="dig", cpu="250m", memory="512Mi")
+        for p in pods:
+            if rng.random() < 0.5:
+                p.priority = rng.choice([1, 50, 1000])
+            if rng.random() < 0.5:
+                p.meta.annotations[wk.POD_GROUP] = f"g{rng.randint(0, 2)}"
+                if rng.random() < 0.5:
+                    p.meta.annotations[wk.POD_GROUP_MIN_MEMBERS] = "4"
         prov = make_provisioner()
         types = FakeCloudProvider(
             catalog=generate_catalog(n_types=10)
@@ -148,6 +162,31 @@ class TestInstanceTypeCodec:
             for t in types
         ]
         assert problem_digest(encode(pods2, [(prov2, types2)])) == original
+
+    def test_gang_fields_stay_off_the_wire_when_unset(self):
+        """ISSUE 6 satellite: the sparse pod codec must not grow for pods
+        without gang/priority fields — ``priority`` and the pod-group
+        annotations appear on the wire exactly when set, and round-trip
+        exactly when they do."""
+        from karpenter_tpu.api import codec
+        from karpenter_tpu.api import labels as wk
+
+        plain = make_pod(name="plain")
+        wire = codec.pod_to_wire(plain)
+        assert "priority" not in wire
+        assert "annotations" not in wire["meta"]
+
+        member = make_pod(name="member")
+        member.priority = 100
+        member.meta.annotations[wk.POD_GROUP] = "train"
+        member.meta.annotations[wk.POD_GROUP_MIN_MEMBERS] = "8"
+        wire = codec.pod_to_wire(member)
+        assert wire["priority"] == 100
+        assert wire["meta"]["annotations"][wk.POD_GROUP] == "train"
+        back = codec.pod_from_wire(json.loads(json.dumps(wire)))
+        assert back.priority == 100
+        assert back.pod_group() == "train"
+        assert back.pod_group_min_members() == 8
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,477 @@
+"""ISSUE 6 suite: gang scheduling — all-or-nothing pod groups with
+rank-aware TPU-slice placement.
+
+The acceptance criterion class (:class:`TestAllOrNothingProperty`) is the
+core invariant: over random gang/pod mixes under a FaultPlan-driven capacity
+crunch, a gang is NEVER partially bound — every member lands in one round or
+the gang defers with a ``gang-deferred`` verdict — and the delta-encode path
+agrees with a from-scratch full encode at problem-digest level with gang
+pods in the mix.
+"""
+
+from __future__ import annotations
+
+import random
+
+import pytest
+
+from karpenter_tpu.api import ObjectMeta, Pod, Provisioner, Resources
+from karpenter_tpu.api import labels as wk
+from karpenter_tpu.api.settings import Settings
+from karpenter_tpu.cloudprovider import FakeCloudProvider, generate_catalog
+from karpenter_tpu.cloudprovider.catalog import make_instance_type
+from karpenter_tpu.cloudprovider.types import Offering
+from karpenter_tpu.controllers.provisioning import ProvisioningController
+from karpenter_tpu.solver import gang as gangmod
+from karpenter_tpu.solver.encode import encode, group_pods
+from karpenter_tpu.solver.session import EncodeSession
+from karpenter_tpu.solver.solver import GreedySolver, problem_digest
+from karpenter_tpu.state import Cluster
+from karpenter_tpu.utils.decisions import DECISIONS
+from karpenter_tpu.utils.faults import Fault, FaultPlan
+
+from helpers import make_pod, make_pods, make_provisioner
+
+
+@pytest.fixture(autouse=True)
+def _fresh_decisions():
+    DECISIONS.configure(2048)
+    DECISIONS.clear()
+    yield
+    DECISIONS.clear()
+
+
+def gang_pod(name, group, min_members=None, cpu="500m", memory="1Gi", priority=0):
+    p = make_pod(name=name, cpu=cpu, memory=memory)
+    p.meta.annotations[wk.POD_GROUP] = group
+    if min_members is not None:
+        p.meta.annotations[wk.POD_GROUP_MIN_MEMBERS] = str(min_members)
+    p.priority = priority
+    return p
+
+
+def build_env(catalog=None, limits=None, settings=None, fault_plan=None):
+    cluster = Cluster()
+    provider = FakeCloudProvider(
+        catalog=catalog or generate_catalog(n_types=20), fault_plan=fault_plan
+    )
+    controller = ProvisioningController(
+        cluster, provider, solver=GreedySolver(),
+        settings=settings or Settings(batch_idle_duration=0, batch_max_duration=0),
+    )
+    cluster.add_provisioner(make_provisioner(limits=limits))
+    return cluster, provider, controller
+
+
+# ---------------------------------------------------------------------------
+# Model: membership, quorum, signatures
+# ---------------------------------------------------------------------------
+
+
+class TestGangModel:
+    def test_pod_group_label_preferred_annotation_fallback(self):
+        p = make_pod(labels={wk.POD_GROUP: "from-label"})
+        p.meta.annotations[wk.POD_GROUP] = "from-annotation"
+        assert p.pod_group() == "from-label"
+        q = make_pod()
+        q.meta.annotations[wk.POD_GROUP] = "from-annotation"
+        assert q.pod_group() == "from-annotation"
+        assert make_pod().pod_group() is None
+
+    def test_min_members_parse_and_floor(self):
+        p = gang_pod("a", "g", min_members=8)
+        assert p.pod_group_min_members() == 8
+        q = gang_pod("b", "g")
+        assert q.pod_group_min_members() == 1
+        r = gang_pod("c", "g")
+        r.meta.annotations[wk.POD_GROUP_MIN_MEMBERS] = "not-a-number"
+        assert r.pod_group_min_members() == 1
+
+    def test_collect_gangs_quorum_and_entitlement(self):
+        pods = [
+            gang_pod("g-1", "train", min_members=4, priority=50),
+            gang_pod("g-0", "train", priority=10),
+            make_pod(name="plain"),
+        ]
+        gangs = gangmod.collect_gangs(pods)
+        assert list(gangs) == ["train"]
+        g = gangs["train"]
+        assert [p.meta.name for p in g.pods] == ["g-0", "g-1"]  # name-sorted
+        assert g.min_members == 4  # max over members
+        assert g.priority == 10  # min over members (weakest rank)
+
+    def test_gang_pods_never_bucket_with_identical_plain_pods(self):
+        """Gang identity is scheduling identity: annotation-form members and
+        prioritized pods split from value-identical plain pods, on both the
+        native and pure-Python grouping paths."""
+        plain = make_pods(3, prefix="plain", cpu="1")
+        members = [gang_pod(f"m-{i}", "tj", min_members=2, cpu="1", memory="128Mi")
+                   for i in range(2)]
+        hi = make_pod(name="hi", cpu="1")
+        hi.priority = 7
+        groups = group_pods(plain[:1] + members + plain[1:] + [hi])
+        names = [[p.meta.name for p in g.pods] for g in groups]
+        assert names == [["plain-0", "plain-1", "plain-2"], ["m-0", "m-1"], ["hi"]]
+
+
+# ---------------------------------------------------------------------------
+# The gang gate
+# ---------------------------------------------------------------------------
+
+
+class TestGangGate:
+    def test_fitting_gang_admits_whole_with_verdict(self):
+        cluster, provider, ctl = build_env()
+        for i in range(8):
+            cluster.add_pod(gang_pod(f"rank-{i}", "tj", min_members=8))
+        result = ctl.reconcile()
+        assert len(result.bound) == 8
+        assert not result.unschedulable and not result.gang_deferred
+        recs = DECISIONS.query(kind="gang")
+        assert [(r.outcome, r.pod) for r in recs] == [("gang-admitted", "tj")]
+        assert recs[0].details["members"] == 8
+        assert "zones" in recs[0].details
+
+    def test_below_quorum_gang_defers_whole(self):
+        cluster, provider, ctl = build_env()
+        for i in range(3):
+            cluster.add_pod(gang_pod(f"w-{i}", "waiting", min_members=5))
+        cluster.add_pod(make_pod(name="bystander", cpu="250m"))
+        result = ctl.reconcile()
+        # the bystander schedules; the sub-quorum gang binds NOTHING
+        assert "bystander" in result.bound
+        assert not any(n.startswith("w-") for n in result.bound)
+        assert sorted(result.gang_deferred) == ["w-0", "w-1", "w-2"]
+        assert result.unschedulable == []
+        recs = DECISIONS.query(kind="gang")
+        assert recs[0].outcome == "gang-deferred-insufficient-members"
+        assert recs[0].pod == "waiting"
+        assert recs[0].details["members"] == 3
+        assert recs[0].details["min_members"] == 5
+
+    def test_quorum_counts_already_bound_members(self):
+        cluster, provider, ctl = build_env()
+        for i in range(5):
+            cluster.add_pod(gang_pod(f"q-{i}", "quorum", min_members=5))
+        ctl.reconcile()
+        assert all(cluster.pods[f"q-{i}"].node_name for i in range(5))
+        # a replacement member arrives alone (e.g. one rank restarted): the
+        # 4 running members count toward the quorum, so it schedules
+        cluster.add_pod(gang_pod("q-5", "quorum", min_members=5))
+        result = ctl.reconcile()
+        assert "q-5" in result.bound
+
+    def test_deferral_coalesces_and_escalates_after_wait_budget(self):
+        cluster, provider, ctl = build_env(
+            settings=Settings(
+                batch_idle_duration=0, batch_max_duration=0,
+                gang_max_wait_rounds=3,
+            ),
+        )
+        for i in range(2):
+            cluster.add_pod(gang_pod(f"w-{i}", "stuck", min_members=4))
+        for _ in range(4):
+            ctl.reconcile()
+        recs = [r for r in DECISIONS.query(kind="gang") if r.pod == "stuck"]
+        assert len(recs) == 1  # coalesced, not one per round
+        assert recs[0].count == 4
+        assert recs[0].details["wait_rounds"] == 4
+        warnings = ctl.recorder.events(reason="GangWaitExceeded")
+        assert len(warnings) == 1  # escalated exactly once, at the threshold
+
+    def test_gang_scheduling_disabled_places_members_independently(self):
+        cluster, provider, ctl = build_env(
+            settings=Settings(
+                batch_idle_duration=0, batch_max_duration=0,
+                gang_scheduling_enabled=False,
+            ),
+        )
+        for i in range(3):
+            cluster.add_pod(gang_pod(f"d-{i}", "ignored", min_members=8))
+        result = ctl.reconcile()
+        # below quorum, but the gate is off: pods place like plain pods
+        assert len(result.bound) == 3
+        assert DECISIONS.query(kind="gang") == []
+
+    def test_later_cascade_rounds_do_not_rejudge_a_bound_gang(self):
+        """A gang bound in cascade round 1 must not be re-deferred when a
+        later round runs for OTHER pods (pool cascade after a limit hit):
+        the gate judges only still-unbound members."""
+        prov_a = make_provisioner(name="pool-a", limits=Resources(cpu=4.0))
+        prov_a.weight = 10
+        cluster = Cluster()
+        provider = FakeCloudProvider(catalog=generate_catalog(n_types=20))
+        ctl = ProvisioningController(
+            cluster, provider, solver=GreedySolver(),
+            settings=Settings(batch_idle_duration=0, batch_max_duration=0),
+        )
+        cluster.add_provisioner(prov_a)
+        cluster.add_provisioner(make_provisioner(name="pool-b"))
+        for i in range(2):
+            cluster.add_pod(gang_pod(f"g-{i}", "tj", min_members=2, cpu="500m"))
+        # a big serving pod whose spec breaks pool-a's ceiling, forcing a
+        # second cascade round against pool-b AFTER the gang already bound
+        cluster.add_pod(make_pod(name="big-serve", cpu="8", memory="8Gi"))
+        result = ctl.reconcile()
+        assert all(f"g-{i}" in result.bound for i in range(2))
+        assert "big-serve" in result.bound
+        assert result.gang_deferred == []
+        recs = [r for r in DECISIONS.query(kind="gang") if r.pod == "tj"]
+        assert [r.outcome for r in recs] == ["gang-admitted"]
+        # the admission verdict kept its placement details across the rounds
+        assert "zones" in recs[0].details and recs[0].details["zones"]
+
+    def test_partial_launch_rolls_back_bindings(self):
+        """A gate-admitted gang split across two node specs where the second
+        spec is limit-blocked must not stay half-bound: the epilogue rolls
+        the bound members back to Pending and the gang defers whole."""
+        one_type = [
+            make_instance_type("only.4x", "c", "5", "4x", 4, 16.0, 1.0,
+                               ["zone-a"], spot=False)
+        ]
+        usable = one_type[0].allocatable().get("cpu")
+        # gang needs two nodes; limits allow exactly one
+        cluster, provider, ctl = build_env(
+            catalog=one_type,
+            limits=Resources(cpu=5.0),
+            settings=Settings(
+                batch_idle_duration=0, batch_max_duration=0,
+                preemption_enabled=False,
+            ),
+        )
+        n = int(usable) + 2  # spills onto a second node
+        for i in range(n):
+            cluster.add_pod(gang_pod(f"s-{i}", "split", min_members=n, cpu="1"))
+        result = ctl.reconcile()
+        assert result.bound == {}
+        assert sorted(result.gang_deferred) == sorted(f"s-{i}" for i in range(n))
+        assert all(cluster.pods[f"s-{i}"].node_name is None for i in range(n))
+        recs = [r for r in DECISIONS.query(kind="gang") if r.pod == "split"]
+        assert recs and recs[0].outcome == "gang-deferred"
+        assert "rolled back" in recs[0].reason
+
+    def test_partial_rollback_requeues_unowned_members(self):
+        """Rolling back a split gang must un-place, never DELETE, unowned
+        members — rollback undoes THIS round's bind, it is not an eviction,
+        and deleting a controllerless member would leave the gang below
+        quorum forever."""
+        one_type = [
+            make_instance_type("only.4x", "c", "5", "4x", 4, 16.0, 1.0,
+                               ["zone-a"], spot=False)
+        ]
+        usable = one_type[0].allocatable().get("cpu")
+        cluster, provider, ctl = build_env(
+            catalog=one_type,
+            limits=Resources(cpu=5.0),  # room for one node; gang needs two
+            settings=Settings(
+                batch_idle_duration=0, batch_max_duration=0,
+                preemption_enabled=False,
+            ),
+        )
+        n = int(usable) + 2
+        for i in range(n):
+            p = gang_pod(f"u-{i}", "bare", min_members=n, cpu="1")
+            p.meta.owner_kind = None  # statically created: no controller
+            cluster.add_pod(p)
+        result = ctl.reconcile()
+        assert result.bound == {}
+        for i in range(n):
+            p = cluster.pods.get(f"u-{i}")
+            assert p is not None, f"u-{i} was DELETED by rollback"
+            assert p.node_name is None and p.is_pending()
+        assert sorted(result.gang_deferred) == sorted(f"u-{i}" for i in range(n))
+
+
+# ---------------------------------------------------------------------------
+# Rank-aware placement
+# ---------------------------------------------------------------------------
+
+
+class TestRankAwarePlacement:
+    def _split_zone_catalog(self):
+        od = wk.CAPACITY_TYPE_ON_DEMAND
+        big = make_instance_type(
+            "big.4x", "c", "5", "4x", 4, 16.0, 2.9, ["zone-b"], spot=False
+        )
+        small = make_instance_type(
+            "small.1x", "c", "5", "1x", 2, 4.0, 1.0, ["zone-a"], spot=False
+        )
+        assert big.offerings == [Offering("zone-b", od, 2.9)]
+        assert small.offerings == [Offering("zone-a", od, 1.0)]
+        return [big, small]
+
+    def test_scattered_gang_repacks_onto_one_zone(self):
+        """The cost-minimal mix (3 ranks on a zone-b big node + 1 on a
+        zone-a small) scatters the gang; the rank-aware replan pays the
+        within-penalty premium for topology adjacency and lands all ranks
+        in zone-a."""
+        cluster, provider, ctl = build_env(catalog=self._split_zone_catalog())
+        for i in range(4):
+            cluster.add_pod(gang_pod(f"rank-{i}", "tj", min_members=4, cpu="1"))
+        result = ctl.reconcile()
+        zones = {n.meta.labels.get(wk.ZONE) for n in result.nodes}
+        assert zones == {"zone-a"}
+        rec = DECISIONS.query(kind="gang")[0]
+        assert rec.outcome == "gang-admitted"
+        assert rec.details["zones"] == ["zone-a"]
+        assert rec.details["scattered"] is False
+        assert rec.details["price_delta"] == pytest.approx(0.1)
+
+    def test_scatter_stands_when_single_zone_exceeds_penalty(self):
+        """When the cheapest single-zone plan costs more than the scatter
+        penalty allows, the scattered placement is admitted and the verdict
+        says so — the penalty is a budget, not a mandate."""
+        od = wk.CAPACITY_TYPE_ON_DEMAND
+        big = make_instance_type(
+            "big.4x", "c", "5", "4x", 4, 16.0, 2.9, ["zone-b"], spot=False
+        )
+        # scattered optimum 2.9 + 2.0 = 4.9, penalty budget 5.39; both
+        # single-zone plans (zone-b 2x big = 5.8, zone-a 4x small = 8.0)
+        # blow the budget, so the scatter must stand
+        small = make_instance_type(
+            "small.1x", "c", "5", "1x", 2, 4.0, 1.0, ["zone-a"], spot=False
+        ).with_offerings([Offering("zone-a", od, 2.0)])
+        cluster, provider, ctl = build_env(catalog=[big, small])
+        for i in range(4):
+            cluster.add_pod(gang_pod(f"rank-{i}", "tj", min_members=4, cpu="1"))
+        result = ctl.reconcile()
+        assert len(result.bound) == 4
+        rec = DECISIONS.query(kind="gang")[0]
+        assert rec.outcome == "gang-admitted"
+        assert rec.details["scattered"] is True
+        assert sorted(rec.details["zones"]) == ["zone-a", "zone-b"]
+
+
+# ---------------------------------------------------------------------------
+# Consolidation never splits a gang
+# ---------------------------------------------------------------------------
+
+
+class TestConsolidationGuard:
+    def test_gang_hosting_node_is_not_consolidatable(self):
+        from karpenter_tpu.controllers.deprovisioning import DeprovisioningController
+        from karpenter_tpu.controllers.termination import TerminationController
+        from karpenter_tpu.utils.cache import FakeClock
+
+        cluster, provider, ctl = build_env()
+        cluster.provisioners["default"].consolidation_enabled = True
+        for i in range(2):
+            cluster.add_pod(gang_pod(f"g-{i}", "tj", min_members=2, cpu="100m"))
+        ctl.reconcile()
+        assert all(cluster.pods[f"g-{i}"].node_name for i in range(2))
+        clock = FakeClock(0.0)
+        term = TerminationController(cluster, provider, clock=clock)
+        deprov = DeprovisioningController(
+            cluster, provider, term,
+            settings=Settings(
+                consolidation_validation_ttl=0.0, stabilization_window=0.0
+            ),
+            clock=clock,
+        )
+        assert deprov._consolidatable() == []
+        blocked = [
+            r for r in DECISIONS.query(kind="consolidation")
+            if r.outcome == "blocked"
+        ]
+        assert blocked and "gang member" in blocked[0].reason
+
+
+# ---------------------------------------------------------------------------
+# The acceptance property: never partially placed + delta == full
+# ---------------------------------------------------------------------------
+
+
+class TestAllOrNothingProperty:
+    @pytest.mark.parametrize("seed", range(4))
+    def test_random_gang_mix_under_capacity_crunch(self, seed):
+        """Random gang/pod mixes, arrivals spread over rounds, against a
+        small catalog with FaultPlan-scripted insufficient-capacity faults on
+        launch: after EVERY round, every gang is fully bound or fully
+        pending (never split), deferred gangs carry gang-deferred verdicts,
+        and the session's delta encode stays digest-identical to a
+        from-scratch full encode of its canonical pod order."""
+        rng = random.Random(seed)
+        plan = FaultPlan(sleep=lambda s: None)
+        # scripted capacity crunch: bursts of ICE on create, arriving at
+        # random points of the scenario
+        faults = []
+        for _ in range(rng.randint(2, 6)):
+            faults.extend(
+                [Fault(kind="capacity", reason="crunch")] * rng.randint(1, 3)
+            )
+        plan.script("create", faults)
+        cluster, provider, ctl = build_env(
+            catalog=generate_catalog(n_types=6), fault_plan=plan,
+        )
+        prov = cluster.provisioners["default"]
+        gang_sizes = {}
+        serial = 0
+        for rnd in range(6):
+            # arrivals: a gang, some plain pods, sometimes a partial gang
+            if rng.random() < 0.7:
+                g = f"gang-{rnd}"
+                size = rng.choice([2, 4, 8])
+                arrive = size if rng.random() < 0.7 else rng.randint(1, size - 1)
+                gang_sizes[g] = size
+                for i in range(arrive):
+                    cluster.add_pod(
+                        gang_pod(
+                            f"{g}-m{i}", g, min_members=size,
+                            cpu=rng.choice(["500m", "1"]),
+                            priority=rng.choice([0, 0, 50]),
+                        )
+                    )
+            for _ in range(rng.randint(0, 3)):
+                serial += 1
+                cluster.add_pod(make_pod(name=f"plain-{serial}", cpu="250m"))
+            ctl.reconcile()
+
+            # invariant 1: no gang is ever split
+            for g, size in gang_sizes.items():
+                members = [
+                    p for p in cluster.pods.values() if p.pod_group() == g
+                ]
+                bound = [p for p in members if p.node_name is not None]
+                assert len(bound) in (0, len(members)), (
+                    f"seed {seed} round {rnd}: gang {g} split "
+                    f"{len(bound)}/{len(members)}"
+                )
+                if members and not bound:
+                    # a fully-pending gang must explain itself in the log
+                    recs = [
+                        r for r in DECISIONS.query(kind="gang") if r.pod == g
+                    ]
+                    assert recs and recs[0].outcome.startswith("gang-deferred")
+
+            # invariant 2: delta encode == full encode (problem digest), with
+            # gang pods inside the canonical order
+            types = provider.get_instance_types(prov)
+            existing = cluster.existing_capacity()
+            session_problem = ctl.encode_session.encode(
+                cluster.pending_pods(), [(prov, types)], existing=existing
+            )
+            oracle = encode(
+                ctl.encode_session.ordered_pods(), [(prov, types)],
+                existing=existing,
+            )
+            assert problem_digest(session_problem) == problem_digest(oracle)
+
+    def test_session_delta_mode_survives_gang_churn(self):
+        """Steady-state gang arrivals ride the delta path (no full-encode
+        fallback) and still match the oracle."""
+        cluster, provider, ctl = build_env()
+        prov = cluster.provisioners["default"]
+        cluster.add_pod(make_pod(name="warm", cpu="250m"))
+        ctl.reconcile()
+        for i in range(4):
+            cluster.add_pod(gang_pod(f"rank-{i}", "tj", min_members=4))
+        types = provider.get_instance_types(prov)
+        existing = cluster.existing_capacity()
+        session_problem = ctl.encode_session.encode(
+            cluster.pending_pods(), [(prov, types)], existing=existing
+        )
+        assert ctl.encode_session.last_mode == "delta"
+        oracle = encode(
+            ctl.encode_session.ordered_pods(), [(prov, types)], existing=existing
+        )
+        assert problem_digest(session_problem) == problem_digest(oracle)

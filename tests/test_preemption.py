@@ -1,0 +1,408 @@
+"""ISSUE 6 suite: priority preemption — cheapest-to-evict victim planning,
+whole-gang evictions, same-round re-solve, and byte-identical flight-recorder
+replay of a preemption round (the acceptance criterion class at the end).
+"""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from karpenter_tpu.api import ObjectMeta, PodDisruptionBudget, Resources
+from karpenter_tpu.api import labels as wk
+from karpenter_tpu.api.objects import Node
+from karpenter_tpu.api.settings import Settings
+from karpenter_tpu.cloudprovider import FakeCloudProvider, generate_catalog
+from karpenter_tpu.controllers.provisioning import ProvisioningController
+from karpenter_tpu.replay import replay_capsule
+from karpenter_tpu.solver.encode import encode
+from karpenter_tpu.solver.solver import GreedySolver, problem_digest
+from karpenter_tpu.state import Cluster
+from karpenter_tpu.utils.decisions import DECISIONS
+from karpenter_tpu.utils.flightrecorder import FLIGHT
+
+from helpers import make_pod, make_provisioner
+
+
+@pytest.fixture(autouse=True)
+def _fresh_rings():
+    DECISIONS.configure(2048)
+    DECISIONS.clear()
+    FLIGHT.configure(32)
+    FLIGHT.clear()
+    yield
+    FLIGHT.clear()
+    DECISIONS.clear()
+
+
+def _full_cluster(settings=None, node_cpu=4, n_nodes=2, pods_per_node=4,
+                  victim_kw=None):
+    """A saturated cluster: ``n_nodes`` managed nodes full of low-priority
+    bound pods, and a provisioner ceiling that blocks any further launch."""
+    cluster = Cluster()
+    provider = FakeCloudProvider(catalog=generate_catalog(n_types=20))
+    controller = ProvisioningController(
+        cluster, provider, solver=GreedySolver(),
+        settings=settings or Settings(batch_idle_duration=0, batch_max_duration=0),
+    )
+    cluster.add_provisioner(make_provisioner(limits=Resources(cpu=0.5)))
+    for ni in range(n_nodes):
+        node = Node(
+            meta=ObjectMeta(
+                name=f"n{ni}",
+                labels={
+                    wk.PROVISIONER_NAME: "default", wk.ZONE: "zone-a",
+                    wk.INSTANCE_TYPE: "t",
+                },
+            ),
+            allocatable=Resources(cpu=node_cpu, memory="8Gi", pods=20),
+            capacity=Resources(cpu=node_cpu, memory="8Gi", pods=20),
+            ready=True,
+        )
+        cluster.add_node(node)
+        for pi in range(pods_per_node):
+            p = make_pod(name=f"low-{ni}-{pi}", cpu="1", memory="1Gi",
+                         **(victim_kw or {}))
+            cluster.add_pod(p)
+            cluster.bind_pod(p.name, node.name)
+    return cluster, provider, controller
+
+
+def _gang(cluster, name, size, priority=100, cpu="1"):
+    for i in range(size):
+        p = make_pod(name=f"{name}-{i}", cpu=cpu, memory="1Gi")
+        p.priority = priority
+        p.meta.annotations[wk.POD_GROUP] = name
+        p.meta.annotations[wk.POD_GROUP_MIN_MEMBERS] = str(size)
+        cluster.add_pod(p)
+    return [f"{name}-{i}" for i in range(size)]
+
+
+class TestPreemption:
+    def test_high_priority_gang_preempts_and_binds_in_round(self):
+        cluster, provider, ctl = _full_cluster()
+        members = _gang(cluster, "urgent", 4)
+        result = ctl.reconcile()
+        assert all(m in result.bound for m in members)
+        evicted = [
+            p.name for p in cluster.pods.values()
+            if p.name.startswith("low-") and p.node_name is None
+        ]
+        assert len(evicted) == 4  # exactly the capacity needed, no more
+        recs = DECISIONS.query(kind="preemption")
+        assert {r.outcome for r in recs} == {"preempted-by"}
+        assert sorted(r.pod for r in recs) == sorted(evicted)
+        details = recs[0].details
+        assert details["preemptor"] == "urgent"
+        assert sorted(details["victims"]) == sorted(evicted)
+        assert "price_delta" in details and "eviction_cost" in details
+        gang_recs = DECISIONS.query(kind="gang")
+        assert any(
+            r.outcome == "gang-admitted" and "preemption" in r.reason
+            for r in gang_recs
+        )
+
+    def test_single_high_priority_pod_preempts(self):
+        cluster, provider, ctl = _full_cluster()
+        p = make_pod(name="critical", cpu="1", memory="1Gi")
+        p.priority = 1000
+        cluster.add_pod(p)
+        result = ctl.reconcile()
+        assert "critical" in result.bound
+        recs = DECISIONS.query(kind="preemption")
+        assert len([r for r in recs if r.outcome == "preempted-by"]) == 1
+        assert "pod critical" in recs[0].reason
+
+    def test_cheapest_victims_evicted_first(self):
+        """pod-deletion-cost orders victim units: the planner must take the
+        cheap ones and leave the expensive ones bound."""
+        cluster, provider, ctl = _full_cluster(n_nodes=1, pods_per_node=0)
+        node = cluster.nodes["n0"]
+        for i, cost in enumerate([100, 1, 100, 1]):
+            p = make_pod(name=f"v-{i}", cpu="1", memory="1Gi")
+            p.meta.annotations["controller.kubernetes.io/pod-deletion-cost"] = str(cost)
+            cluster.add_pod(p)
+            cluster.bind_pod(p.name, node.name)
+        hi = make_pod(name="hi", cpu="2", memory="2Gi")
+        hi.priority = 10
+        cluster.add_pod(hi)
+        result = ctl.reconcile()
+        assert "hi" in result.bound
+        evicted = {p.name for p in cluster.pods.values() if p.node_name is None}
+        assert evicted == {"v-1", "v-3"}  # the two cheap ones
+
+    def test_victim_gang_evicted_whole(self):
+        """Evicting one member evicts the gang: freeing 1 cpu costs the whole
+        2-member victim gang, never a partial eviction."""
+        cluster, provider, ctl = _full_cluster(
+            n_nodes=1, pods_per_node=0, node_cpu=2
+        )
+        node = cluster.nodes["n0"]
+        for i in range(2):
+            p = make_pod(name=f"vg-{i}", cpu="1", memory="1Gi")
+            p.meta.annotations[wk.POD_GROUP] = "victim-gang"
+            cluster.add_pod(p)
+            cluster.bind_pod(p.name, node.name)
+        hi = make_pod(name="hi", cpu="1", memory="1Gi")
+        hi.priority = 10
+        cluster.add_pod(hi)
+        result = ctl.reconcile()
+        assert "hi" in result.bound
+        assert cluster.pods["vg-0"].node_name is None
+        assert cluster.pods["vg-1"].node_name is None
+        recs = DECISIONS.query(kind="preemption")
+        assert sorted(r.pod for r in recs) == ["vg-0", "vg-1"]
+
+    def test_equal_or_higher_priority_never_victimized(self):
+        cluster, provider, ctl = _full_cluster()
+        for p in cluster.pods.values():
+            p.priority = 100  # victims as entitled as the preemptor
+        members = _gang(cluster, "urgent", 4, priority=100)
+        result = ctl.reconcile()
+        assert not any(m in result.bound for m in members)
+        assert all(
+            p.node_name is not None
+            for p in cluster.pods.values() if p.name.startswith("low-")
+        )
+        assert DECISIONS.query(kind="preemption") == []
+
+    def test_pdb_protected_and_unowned_victims_skipped(self):
+        cluster, provider, ctl = _full_cluster(
+            n_nodes=1, victim_kw={"labels": {"app": "guarded"}}
+        )
+        cluster.add_pdb(
+            PodDisruptionBudget(
+                meta=ObjectMeta(name="guard"),
+                selector={"app": "guarded"},
+                max_unavailable=0,
+            )
+        )
+        hi = make_pod(name="hi", cpu="1", memory="1Gi")
+        hi.priority = 10
+        cluster.add_pod(hi)
+        result = ctl.reconcile()
+        assert "hi" not in result.bound
+        assert all(
+            p.node_name is not None
+            for p in cluster.pods.values() if p.name.startswith("low-")
+        )
+        infeasible = [
+            r for r in DECISIONS.query(kind="preemption")
+            if r.outcome == "infeasible"
+        ]
+        assert infeasible and infeasible[0].pod == "hi"
+
+    def test_pdb_vetting_is_cumulative_across_victims(self):
+        """Two victims that each clear a maxUnavailable=1 budget ALONE must
+        not both be evicted for one preemptor: the plan counts its own
+        already-slated victims as disrupted, so the second accrual is
+        rejected and the whole plan comes back infeasible — no eviction."""
+        cluster, provider, ctl = _full_cluster(
+            n_nodes=1, victim_kw={"labels": {"app": "guarded"}}
+        )
+        cluster.add_pdb(
+            PodDisruptionBudget(
+                meta=ObjectMeta(name="guard"),
+                selector={"app": "guarded"},
+                max_unavailable=1,
+            )
+        )
+        hi = make_pod(name="hi", cpu="2", memory="2Gi")  # needs TWO victims
+        hi.priority = 10
+        cluster.add_pod(hi)
+        result = ctl.reconcile()
+        assert "hi" not in result.bound
+        assert all(
+            p.node_name is not None
+            for p in cluster.pods.values() if p.name.startswith("low-")
+        )
+        outcomes = {r.outcome for r in DECISIONS.query(kind="preemption")}
+        assert outcomes == {"infeasible"}
+
+    def test_victim_gang_with_unmanaged_member_is_untouchable(self):
+        """A bound victim gang with a member on an UNMANAGED node can never
+        be evicted whole, so it must never be evicted at all: taking only
+        the managed members would leave a sub-quorum remnant burning
+        capacity — the exact failure gang scheduling exists to prevent."""
+        cluster, provider, ctl = _full_cluster(n_nodes=1, pods_per_node=0)
+        outside = Node(  # pre-existing node, no provisioner label
+            meta=ObjectMeta(
+                name="outside",
+                labels={wk.ZONE: "zone-a", wk.INSTANCE_TYPE: "t"},
+            ),
+            allocatable=Resources(cpu=4, memory="8Gi", pods=20),
+            capacity=Resources(cpu=4, memory="8Gi", pods=20),
+            ready=True,
+        )
+        cluster.add_node(outside)
+        for i, node in enumerate(["n0", "n0", "outside", "outside"]):
+            p = make_pod(name=f"vg-{i}", cpu="1", memory="1Gi")
+            p.meta.annotations[wk.POD_GROUP] = "victims"
+            p.meta.annotations[wk.POD_GROUP_MIN_MEMBERS] = "4"
+            cluster.add_pod(p)
+            cluster.bind_pod(p.name, node)
+        hi = make_pod(name="hi", cpu="3", memory="2Gi")  # > n0's 2 free cpu
+        hi.priority = 10
+        cluster.add_pod(hi)
+        result = ctl.reconcile()
+        assert "hi" not in result.bound
+        assert all(
+            p.node_name is not None
+            for p in cluster.pods.values() if p.name.startswith("vg-")
+        )
+        outcomes = {r.outcome for r in DECISIONS.query(kind="preemption")}
+        assert outcomes == {"infeasible"}
+
+    def test_same_round_bound_victims_leave_result_bound(self):
+        """Victims the cascade bound EARLIER in the same reconcile must not
+        linger in ``result.bound`` after preemption evicts them — the round's
+        report (and its flight-recorder capsule) has to agree with cluster
+        state. FFD places the larger serving pods onto the node first; the
+        gang then preempts them within the same round."""
+        cluster, provider, ctl = _full_cluster(n_nodes=1, pods_per_node=0)
+        for i in range(2):
+            p = make_pod(name=f"serve-{i}", cpu="2", memory="1Gi")
+            p.priority = 1
+            cluster.add_pod(p)
+        members = _gang(cluster, "urgent", 4, priority=100, cpu="1")
+        result = ctl.reconcile()
+        assert all(m in result.bound for m in members)
+        evicted = [
+            p.name for p in cluster.pods.values()
+            if p.name.startswith("serve-") and p.node_name is None
+        ]
+        assert evicted, "expected same-round-bound serving pods to be preempted"
+        assert not any(v in result.bound for v in evicted)
+        for name, node in result.bound.items():
+            assert cluster.pods[name].node_name == node
+
+    def test_infeasible_plan_executes_no_eviction(self):
+        """A gang too big to ever fit must not evict anyone speculatively:
+        trial solves are what-ifs, eviction happens only on a feasible plan."""
+        cluster, provider, ctl = _full_cluster(n_nodes=1)
+        _gang(cluster, "huge", 16, priority=100)
+        ctl.reconcile()
+        assert all(
+            p.node_name is not None
+            for p in cluster.pods.values() if p.name.startswith("low-")
+        )
+        assert not any(
+            r.outcome == "preempted-by" for r in DECISIONS.query(kind="preemption")
+        )
+
+    def test_below_quorum_gang_never_preempts(self):
+        """A sub-quorum gang must not buy its way in by evicting victims:
+        binding 5/8 ranks after preemption is the exact partial-placement
+        failure gang scheduling exists to prevent."""
+        cluster, provider, ctl = _full_cluster()
+        for i in range(5):  # min-members=8, only 5 arrived
+            p = make_pod(name=f"sub-{i}", cpu="1", memory="1Gi")
+            p.priority = 100
+            p.meta.annotations[wk.POD_GROUP] = "subq"
+            p.meta.annotations[wk.POD_GROUP_MIN_MEMBERS] = "8"
+            cluster.add_pod(p)
+        result = ctl.reconcile()
+        assert not any(n.startswith("sub-") for n in result.bound)
+        assert all(
+            p.node_name is not None
+            for p in cluster.pods.values() if p.name.startswith("low-")
+        )
+        assert DECISIONS.query(kind="preemption") == []
+        recs = [r for r in DECISIONS.query(kind="gang") if r.pod == "subq"]
+        assert recs and recs[0].outcome == "gang-deferred-insufficient-members"
+
+    def test_preemption_disabled_defers_instead(self):
+        cluster, provider, ctl = _full_cluster(
+            settings=Settings(
+                batch_idle_duration=0, batch_max_duration=0,
+                preemption_enabled=False,
+            ),
+        )
+        members = _gang(cluster, "urgent", 4)
+        result = ctl.reconcile()
+        assert not any(m in result.bound for m in members)
+        assert DECISIONS.query(kind="preemption") == []
+        assert all(
+            p.node_name is not None
+            for p in cluster.pods.values() if p.name.startswith("low-")
+        )
+
+    def test_evictions_feed_the_delta_encode_dirty_set(self):
+        """Preemption evictions re-enter the PR3 dirty-set machinery as
+        ordinary watch events: the NEXT encode runs on the delta path and is
+        digest-identical to a from-scratch full encode of the session's
+        canonical pod order (evicted victims included, at the end)."""
+        cluster, provider, ctl = _full_cluster()
+        _gang(cluster, "urgent", 4)
+        ctl.reconcile()
+        # victims are pending again; the session saw unbinds as watch events
+        pending = cluster.pending_pods()
+        assert any(p.name.startswith("low-") for p in pending)
+        prov = cluster.provisioners["default"]
+        types = provider.get_instance_types(prov)
+        existing = cluster.existing_capacity()
+        problem = ctl.encode_session.encode(
+            pending, [(prov, types)], existing=existing
+        )
+        assert ctl.encode_session.last_mode == "delta"
+        oracle = encode(
+            ctl.encode_session.ordered_pods(), [(prov, types)], existing=existing
+        )
+        assert problem_digest(problem) == problem_digest(oracle)
+
+
+class TestPreemptionReplay:
+    """Acceptance criterion: every eviction carries a ``preempted-by``
+    DecisionRecord that replays byte-identically from its flight-recorder
+    capsule — victim set, re-solve digests, placements, verdicts."""
+
+    def test_preemption_round_replays_byte_identical(self):
+        cluster, provider, ctl = _full_cluster()
+        members = _gang(cluster, "urgent", 4)
+        ctl.reconcile()
+        capsule = FLIGHT.latest("provisioning")
+        assert capsule is not None
+        # the capsule carries the cascade AND preemption-trial digests
+        assert len(capsule["outputs"]["problem_digests"]) >= 2
+        recorded_preemptions = [
+            d for d in capsule["outputs"]["decisions"]
+            if d.get("kind") == "preemption"
+        ]
+        assert recorded_preemptions
+        capsule = json.loads(json.dumps(capsule, default=str))  # transport
+        report = replay_capsule(capsule)
+        assert report["match"], report["diffs"]
+        assert report["diffs"]["digests_match"]
+        assert report["diffs"]["placements_match"]
+        assert report["diffs"]["decisions_match"]
+        replayed = [
+            (d["outcome"], d["pod"])
+            for d in report["replayed"]["decisions"]
+            if d.get("kind") == "preemption"
+        ]
+        assert sorted(replayed) == sorted(
+            (d["outcome"], d["pod"]) for d in recorded_preemptions
+        )
+        # the gang's members replay onto the same existing nodes
+        for m in members:
+            assert report["replayed"]["placements"][m]["existing"] is True
+
+    def test_counterfactual_preemption_off(self):
+        """--override settings.preemption_enabled=false answers 'what would
+        have happened without preemption': the gang defers, nobody is
+        evicted."""
+        cluster, provider, ctl = _full_cluster()
+        members = _gang(cluster, "urgent", 4)
+        ctl.reconcile()
+        capsule = json.loads(json.dumps(FLIGHT.latest("provisioning"), default=str))
+        report = replay_capsule(
+            capsule, overrides=["settings.preemption_enabled=false"]
+        )
+        assert report["counterfactual"]
+        assert set(members).isdisjoint(report["replayed"]["placements"])
+        assert sorted(report["replayed"]["gang_deferred"]) == sorted(members)
+        assert not any(
+            d.get("outcome") == "preempted-by"
+            for d in report["replayed"]["decisions"]
+        )

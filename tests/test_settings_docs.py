@@ -1,0 +1,37 @@
+"""Tier-1 settings-drift gate: the Settings dataclass, the generated
+docs/settings.md, and the deploy ConfigMap manifests must agree in every
+direction (hack/check_settings_docs.py)."""
+
+from __future__ import annotations
+
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(ROOT, "hack"))
+
+import check_settings_docs  # noqa: E402
+
+
+def test_settings_docs_and_manifests_current():
+    problems = check_settings_docs.check()
+    assert problems == [], "\n".join(problems)
+
+
+def test_gate_sees_all_three_surfaces():
+    declared = check_settings_docs.declared_settings()
+    assert "gang_scheduling_enabled" in declared
+    assert "preemption_enabled" in declared
+    assert "gang_max_wait_rounds" in declared
+    documented = check_settings_docs.documented_settings()
+    assert set(declared) <= set(documented)
+    manifests = check_settings_docs.configmap_keys()
+    assert manifests, "no global-settings ConfigMap manifest found"
+    for keys in manifests.values():
+        assert "KARPENTER_TPU_GANG_SCHEDULING_ENABLED" in keys
+
+
+def test_gate_catches_doc_drift(tmp_path):
+    doc = tmp_path / "settings.md"
+    doc.write_text("| `no_such_setting` | `KARPENTER_TPU_NO_SUCH_SETTING` | `1` |\n")
+    assert check_settings_docs.documented_settings(str(doc)) == ["no_such_setting"]
