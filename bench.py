@@ -1077,6 +1077,198 @@ def bench_gang_preemption(rounds=10, gang_size=8, fill_pods=60, serve_churn=4):
     }
 
 
+def bench_spot_churn(n_pods=240, waves=3, replace_budget=2, n_types=20):
+    """Spot-churn robustness scenario (ISSUE 7): a spot-heavy fleet under a
+    scripted interruption schedule (utils/faults.InterruptionSchedule) —
+    reclaim waves across >= 2 capacity pools, a rebalance-recommendation
+    wave exercising the proactive replace-before-drain path, and a price
+    spike — with risk-aware pricing and the diversification gate on.
+
+    Correctness under churn, not latency: asserts sustained reclamation ends
+    every round with ZERO pending pods within ``replace_budget`` reconcile
+    rounds, and that total hourly cost stays within a band of the
+    on-demand-only lower bound (the price of robustness must be bounded).
+    """
+    from karpenter_tpu.api import ObjectMeta, Pod, Provisioner, Resources
+    from karpenter_tpu.api import labels as wk
+    from karpenter_tpu.api.settings import Settings
+    from karpenter_tpu.cloudprovider import FakeCloudProvider, generate_catalog
+    from karpenter_tpu.controllers.interruption import FakeQueue, InterruptionController
+    from karpenter_tpu.controllers.provisioning import ProvisioningController
+    from karpenter_tpu.controllers.termination import TerminationController
+    from karpenter_tpu.solver.solver import GreedySolver
+    from karpenter_tpu.state import Cluster
+    from karpenter_tpu.utils.cache import FakeClock
+    from karpenter_tpu.utils.faults import InterruptionSchedule, PriceSpike, ReclaimWave
+    from karpenter_tpu.utils.riskcache import InterruptionRiskCache
+
+    def make_pods(cluster, n):
+        for i in range(n):
+            cluster.add_pod(
+                Pod(meta=ObjectMeta(name=f"web-{i}", owner_kind="ReplicaSet"),
+                    requests=Resources(cpu="500m", memory="512Mi"))
+            )
+
+    def fleet_cost(cluster, provider) -> float:
+        total = 0.0
+        for node in cluster.nodes.values():
+            total += provider.pricing.price(*node.capacity_pool()) or 0.0
+        return total
+
+    # -- on-demand-only lower bound: same pods, catalog without spot --------
+    od_catalog = [
+        it.with_offerings(
+            [o for o in it.offerings if o.capacity_type == wk.CAPACITY_TYPE_ON_DEMAND]
+        )
+        for it in generate_catalog(n_types=n_types)
+    ]
+    od_cluster = Cluster()
+    od_provider = FakeCloudProvider(catalog=od_catalog)
+    od_ctl = ProvisioningController(
+        od_cluster, od_provider, solver=GreedySolver(),
+        settings=Settings(batch_idle_duration=0, batch_max_duration=0),
+    )
+    od_cluster.add_provisioner(Provisioner(meta=ObjectMeta(name="default")))
+    make_pods(od_cluster, n_pods)
+    od_ctl.reconcile()
+    od_lower_bound = fleet_cost(od_cluster, od_provider)
+
+    # -- the churn environment ---------------------------------------------
+    settings = Settings(
+        batch_idle_duration=0, batch_max_duration=0,
+        spot_enabled=True, spot_diversification_max_frac=0.5,
+    )
+    cluster = Cluster()
+    provider = FakeCloudProvider(catalog=generate_catalog(n_types=n_types))
+    for s in provider.subnets:
+        s.available_ips = 1 << 20
+    clock = FakeClock(0.0)
+    risk = InterruptionRiskCache(
+        halflife_s=settings.risk_decay_halflife_s, clock=clock
+    )
+    provider.attach_risk_cache(risk)
+    ctl = ProvisioningController(
+        cluster, provider, solver=GreedySolver(), settings=settings
+    )
+    term = TerminationController(cluster, provider, clock=clock)
+    queue = FakeQueue()
+    intr = InterruptionController(
+        cluster, queue, term,
+        unavailable_offerings=provider.unavailable_offerings,
+        risk_cache=risk, provisioning=ctl, provider=provider,
+        settings=settings, clock=clock,
+    )
+    cluster.add_provisioner(Provisioner(meta=ObjectMeta(name="default")))
+    make_pods(cluster, n_pods)
+    ctl.reconcile()
+
+    def spot_pool_nodes():
+        out = []
+        for node in cluster.nodes.values():
+            pool = node.capacity_pool()
+            if pool[2] == wk.CAPACITY_TYPE_SPOT:
+                out.append((pool, node.name))
+        return out
+
+    # script the waves: each reclaim wave takes EVERY live spot node (the
+    # wildcard pool — whatever pools the risk-fleeing replacements land in,
+    # the next wave chases them there), preceded by one rebalance-
+    # recommendation wave exercising the proactive replace-before-drain
+    # path, plus a price spike on the first pool the fleet used.
+    # Deterministic and seedless, like every FaultPlan script.
+    pools = sorted({pool for pool, _ in spot_pool_nodes()})
+    wave_list = [
+        ReclaimWave(
+            round_no=0, pool=pools[0] if pools else ("*", "*", wk.CAPACITY_TYPE_SPOT),
+            fraction=0.5, rebalance_first=True,
+        )
+    ]
+    for i in range(waves):
+        wave_list.append(
+            ReclaimWave(
+                round_no=1 + 2 * i, pool=("*", "*", wk.CAPACITY_TYPE_SPOT),
+                fraction=1.0,
+            )
+        )
+    schedule = InterruptionSchedule(
+        waves=wave_list,
+        spikes=[
+            PriceSpike(round_no=2, instance_type=p[0], zone=p[1], factor=3.0)
+            for p in pools[:1]
+        ],
+    )
+
+    reclaims = rebalances = 0
+    pools_reclaimed = set()
+    unsched_p100 = 0
+    max_rounds_to_replace = 0
+    costs = []
+    rounds = schedule.last_round() + 2
+    for r in range(rounds):
+        for spike in schedule.spikes_for(r):
+            cur = provider.pricing.spot_price(spike.instance_type, spike.zone) or 0.0
+            provider.pricing.set_spot_price(
+                spike.instance_type, spike.zone, round(cur * spike.factor, 6)
+            )
+        for wave in schedule.waves_for(r):
+            live = spot_pool_nodes()
+            pool_of = dict((name, pool) for pool, name in live)
+            for name in InterruptionSchedule.victims(wave, live):
+                node = cluster.nodes.get(name)
+                if node is None:
+                    continue
+                iid = node.provider_id.rsplit("/", 1)[-1]
+                detail_type = (
+                    "Instance Rebalance Recommendation" if wave.rebalance_first
+                    else "Spot Instance Interruption Warning"
+                )
+                queue.send({
+                    "version": "0", "source": "cloud.compute",
+                    "detail-type": detail_type,
+                    "detail": {"instance-id": iid},
+                })
+                if wave.rebalance_first:
+                    rebalances += 1
+                else:
+                    reclaims += 1
+                    pools_reclaimed.add(pool_of[name])
+        intr.reconcile(max_messages=100)
+        while len(queue):
+            intr.reconcile(max_messages=100)
+        used = 0
+        # keep reconciling PAST the budget (bounded) so an over-budget
+        # replacement is measured rather than truncated at the cap — the
+        # regression gate's rounds-to-replace arm compares against
+        # replace_budget and needs the real number to ever fire
+        while cluster.pending_pods() and used < replace_budget + 4:
+            ctl.reconcile()
+            used += 1
+        max_rounds_to_replace = max(max_rounds_to_replace, used)
+        pending = len(cluster.pending_pods())
+        unsched_p100 = max(unsched_p100, pending)
+        costs.append(fleet_cost(cluster, provider))
+        clock.step(10.0)
+
+    mean_cost = sum(costs) / len(costs) if costs else 0.0
+    frac = round(mean_cost / od_lower_bound, 4) if od_lower_bound > 0 else None
+    return {
+        "pods": n_pods,
+        "waves": len(wave_list),
+        "pools": len(pools),
+        "pools_reclaimed": len(pools_reclaimed),
+        "reclaims_survived": reclaims,
+        "rebalances": rebalances,
+        "unschedulable_p100": unsched_p100,
+        "zero_unschedulable": bool(unsched_p100 == 0),
+        "max_rounds_to_replace": max_rounds_to_replace,
+        "replace_budget": replace_budget,
+        "od_lower_bound_cost": round(od_lower_bound, 4),
+        "mean_cost": round(mean_cost, 4),
+        "cost_vs_ondemand_frac": frac,
+        "within_cost_band": bool(frac is not None and frac <= 1.5),
+    }
+
+
 def bench_decision_overhead(repeats=10, n_pods=300):
     """Decision-audit + trace-propagation overhead guard: a full provisioning
     round (solve + launch + bind) with the decision ring recording vs.
@@ -1381,6 +1573,10 @@ def _run_details(dry_run: bool = False) -> dict:
             )
         except Exception as e:
             details["gang_preemption"] = {"error": f"{type(e).__name__}: {e}"}
+        try:
+            details["spot_churn"] = bench_spot_churn(n_pods=24, waves=2)
+        except Exception as e:
+            details["spot_churn"] = {"error": f"{type(e).__name__}: {e}"}
         return details
     for name, make in CONFIGS:
         try:
@@ -1399,6 +1595,7 @@ def _run_details(dry_run: bool = False) -> dict:
         ("decision_overhead", bench_decision_overhead),
         ("flightrecorder_overhead", bench_flightrecorder_overhead),
         ("gang_preemption", bench_gang_preemption),
+        ("spot_churn", bench_spot_churn),
     ):
         try:
             details[key] = fn()
@@ -1464,6 +1661,7 @@ def main(argv=None):
     decisions = details.get("decision_overhead", {})
     flightrec = details.get("flightrecorder_overhead", {})
     gangs = details.get("gang_preemption", {})
+    spot = details.get("spot_churn", {})
     summary = {
         "metric": line["metric"],
         "value": line["value"],
@@ -1485,6 +1683,11 @@ def main(argv=None):
         "gang_admission_p50_ms": gangs.get("gang_admission_p50_ms"),
         "preemption_round_p50_ms": gangs.get("preemption_round_p50_ms"),
         "gang_zero_partial": gangs.get("zero_partial"),
+        # spot-churn robustness (ISSUE 7): the trajectory JSON tracks
+        # correctness-under-reclamation, not just latency
+        "spot_reclaims_survived": spot.get("reclaims_survived"),
+        "spot_unschedulable_p100": spot.get("unschedulable_p100"),
+        "spot_cost_vs_ondemand_frac": spot.get("cost_vs_ondemand_frac"),
         "summary": True,
     }
     # the summary is the parse target: STRICT JSON, no NaN/Infinity tokens —

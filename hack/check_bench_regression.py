@@ -1,7 +1,9 @@
-"""Bench-regression gate for the incremental-reconcile hot path.
+"""Bench-regression gate for the incremental-reconcile hot path and the
+spot-churn robustness contract.
 
-Runs the two ISSUE-3 scenarios from bench.py at reduced scale and FAILS
-(exit 1) when either regresses past its floor:
+Runs the ISSUE-3 scenarios plus the ISSUE-7 ``spot_churn`` scenario from
+bench.py at reduced scale and FAILS (exit 1) when any regresses past its
+floor:
 
 * ``delta_reconcile``: steady-state delta encode must stay >= MIN_SPEEDUP x
   faster than a full re-encode (the acceptance bar is 5x at full 50k scale;
@@ -10,6 +12,10 @@ Runs the two ISSUE-3 scenarios from bench.py at reduced scale and FAILS
 * ``consolidation_sweep``: the parallel sweep's chosen action must be
   IDENTICAL to the serial sweep's — any divergence is a correctness bug,
   whatever the timing says.
+* ``spot_churn``: sustained scripted reclamation (>= 3 reclaim waves across
+  >= 2 spot pools) must end every settle window with ZERO pending pods,
+  every victim replaced within the 2-reconcile budget, and mean fleet cost
+  <= COST_BAND x the on-demand-only lower bound.
 
 Usage:  python hack/check_bench_regression.py [--full]
         (--full runs the acceptance-scale 50k/160 configuration)
@@ -29,6 +35,9 @@ ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, ROOT)
 
 MIN_DELTA_SPEEDUP = 3.0
+#: spot_churn: mean fleet cost must stay within this factor of the
+#: on-demand-only lower bound (the ISSUE-7 acceptance band)
+COST_BAND = 1.5
 
 
 def run_checks(full: bool = False) -> list:
@@ -39,10 +48,15 @@ def run_checks(full: bool = False) -> list:
     if full:
         delta = bench.bench_delta_reconcile()
         sweep = bench.bench_sweep_parallel()
+        churn = bench.bench_spot_churn()
     else:
         delta = bench.bench_delta_reconcile(n_pods=20_000, rounds=5, n_types=100)
         sweep = bench.bench_sweep_parallel(n_candidates=24)
-    print(json.dumps({"delta_reconcile": delta, "consolidation_sweep": sweep}))
+        churn = bench.bench_spot_churn(n_pods=120, waves=3)
+    print(json.dumps({
+        "delta_reconcile": delta, "consolidation_sweep": sweep,
+        "spot_churn": churn,
+    }))
 
     if delta.get("encode_speedup", 0.0) < MIN_DELTA_SPEEDUP:
         failures.append(
@@ -67,6 +81,31 @@ def run_checks(full: bool = False) -> list:
         failures.append(
             "parallel consolidation sweep diverged from the serial action: "
             f"{sweep.get('chosen_action')!r}"
+        )
+    # -- spot_churn gate (ISSUE 7) ------------------------------------------
+    if churn.get("unschedulable_p100", 1) != 0:
+        failures.append(
+            f"spot_churn left {churn.get('unschedulable_p100')} pods pending "
+            "at steady state (must be zero under sustained reclamation)"
+        )
+    if churn.get("max_rounds_to_replace", 99) > churn.get("replace_budget", 2):
+        failures.append(
+            f"spot_churn victims took {churn.get('max_rounds_to_replace')} "
+            f"reconcile rounds to replace (budget "
+            f"{churn.get('replace_budget', 2)})"
+        )
+    if churn.get("reclaims_survived", 0) < 3 or churn.get("pools_reclaimed", 0) < 2:
+        failures.append(
+            "spot_churn exercised too little churn "
+            f"(reclaims={churn.get('reclaims_survived')}, "
+            f"pools={churn.get('pools_reclaimed')}) — the scenario itself "
+            "regressed, the gate is vacuous"
+        )
+    frac = churn.get("cost_vs_ondemand_frac")
+    if frac is None or frac > COST_BAND:
+        failures.append(
+            f"spot_churn mean cost {frac}x the on-demand-only lower bound "
+            f"(band {COST_BAND}x)"
         )
     return failures
 

@@ -35,6 +35,14 @@ TERMINATION_FINALIZER = f"{GROUP}/termination"
 POD_GROUP = f"{GROUP}/pod-group"
 POD_GROUP_MIN_MEMBERS = f"{GROUP}/pod-group-min-members"
 
+# Per-pod spot-diversification override (annotation): a fraction in (0, 1]
+# tightening/loosening settings.spot_diversification_max_frac for this pod's
+# group, or "none" to opt the group out of the gate. Pool identity affects
+# grouping: a carrier must never bucket with an otherwise-identical plain
+# pod, so encode._signature folds the value in (and the native encoder
+# defers carriers to Python, like gang members).
+SPOT_DIVERSIFICATION = f"{GROUP}/spot-diversification-max-frac"
+
 # Instance-type detail labels (reference: karpenter.k8s.aws/instance-*,
 # types.go:67-122)
 INSTANCE_GROUP = f"instance.{GROUP}"

@@ -237,6 +237,19 @@ class Node:
     def instance_type(self) -> str:
         return self.meta.labels.get(wk.INSTANCE_TYPE, "")
 
+    def capacity_pool(self) -> Tuple[str, str, str]:
+        """The node's ``(instance_type, zone, capacity_type)`` capacity-pool
+        key — the unit of risk accounting (riskcache), diversification
+        masking and pool pricing. Unset labels yield ``""`` (unlike
+        ``capacity_type()``, which defaults to on-demand for scheduling): an
+        unlabeled node must never alias a real pool's evidence."""
+        labels = self.meta.labels
+        return (
+            labels.get(wk.INSTANCE_TYPE, ""),
+            labels.get(wk.ZONE, ""),
+            labels.get(wk.CAPACITY_TYPE, ""),
+        )
+
     def provisioner_name(self) -> Optional[str]:
         return self.meta.labels.get(wk.PROVISIONER_NAME)
 

@@ -83,6 +83,23 @@ class Settings:
     # GangWaitExceeded warning event (it keeps deferring either way —
     # all-or-nothing is not negotiable); 0 disables the escalation.
     gang_max_wait_rounds: int = 8
+    # risk-aware spot capacity pools (utils/riskcache.py + the rebalance
+    # controller): when enabled, offerings carry live interruption
+    # probabilities, the solver prices price + p * interruption_penalty_cost,
+    # the diversification gate respreads groups concentrated in one spot
+    # pool, and rebalance recommendations launch replacement capacity BEFORE
+    # draining. Off by default: plain clusters see byte-identical behavior.
+    spot_enabled: bool = False
+    # $-hours equivalent cost of one interruption (drain + reschedule + the
+    # work lost inside the 2-minute notice): the solver's risk penalty is
+    # p_interrupt * this, added to each offering's price objective.
+    interruption_penalty_cost: float = 10.0
+    # max fraction of a pod group's (or gang's) members the solver may land
+    # in any single SPOT capacity pool; 1.0 disables the diversification gate.
+    spot_diversification_max_frac: float = 0.5
+    # halflife of realized-interruption evidence in the risk cache: a pool
+    # that stops churning decays back toward its prior at this rate.
+    risk_decay_halflife_s: float = 600.0
 
     def validate(self) -> None:
         if not self.cluster_name:
@@ -121,6 +138,14 @@ class Settings:
             raise ValueError(
                 "gangMaxWaitRounds must be >= 0 (0 disables the wait escalation)"
             )
+        if self.interruption_penalty_cost < 0:
+            raise ValueError("interruptionPenaltyCost must be >= 0")
+        if not 0 < self.spot_diversification_max_frac <= 1:
+            raise ValueError(
+                "spotDiversificationMaxFrac must be in (0, 1] (1.0 disables the gate)"
+            )
+        if self.risk_decay_halflife_s <= 0:
+            raise ValueError("riskDecayHalflifeS must be > 0")
 
     # -- config system (reference: karpenter-global-settings ConfigMap,
     # settings.go:40-93; env/flag ingestion in the operator bootstrap) -------

@@ -96,9 +96,16 @@ class FakeCloudProvider(WindowedBatchers, CloudProvider):
         # Live pricing over the catalog's static anchors (pricing.go:85);
         # get_instance_types serves offerings at current prices and its cache
         # key includes pricing.version, so a refresh invalidates consumers.
-        from .pricing import PricingProvider
+        from .pricing import CapacityPoolProvider, PricingProvider
 
         self.pricing = PricingProvider(self.catalog)
+        # Capacity-pool risk axis: when the operator (or a test) attaches an
+        # InterruptionRiskCache via ``attach_risk_cache``, get_instance_types
+        # stamps each offering's interruption_probability from it — the same
+        # pattern as the ICE mask riding ``available``. None = risk off, and
+        # every offering keeps probability 0.0 (legacy digests unchanged).
+        self.risk_cache = None
+        self.pools = CapacityPoolProvider(self.pricing, None)
         # CreateFleet-style batcher: concurrent create() calls with the same
         # launch shape coalesce into one fleet call (createfleet.go:33-110,
         # windows batcher.go:29-35 — 35ms idle / 1s max / 1000 items).
@@ -146,6 +153,14 @@ class FakeCloudProvider(WindowedBatchers, CloudProvider):
             self.catalog_version += 1
             # in place: PricingController holds a reference to this object
             self.pricing.reload(catalog)
+
+    def attach_risk_cache(self, risk_cache) -> None:
+        """Wire an InterruptionRiskCache so offerings carry live
+        interruption probabilities (risk version joins the catalog cache
+        key, so a recorded reclaim invalidates instance-type lists the way
+        an ICE mark does)."""
+        self.risk_cache = risk_cache
+        self.pools.risk = risk_cache
 
     def set_insufficient_capacity(self, instance_type: str, zone: str, capacity_type: str) -> None:
         self.insufficient_capacity_pools.add((instance_type, zone, capacity_type))
@@ -442,7 +457,7 @@ class FakeCloudProvider(WindowedBatchers, CloudProvider):
             provisioner.meta.resource_version if provisioner is not None else None,
             self.unavailable_offerings.seqnum,
             self.catalog_version,
-            self.pricing.version,
+            self.pools.version,  # covers pricing.version + risk-cache writes
             int(time.time() // 60),
         )
         cached = self._it_cache.get(pname)
@@ -459,6 +474,9 @@ class FakeCloudProvider(WindowedBatchers, CloudProvider):
                     price=self.pricing.price(it.name, o.zone, o.capacity_type) or o.price,
                     available=o.available
                     and not self.unavailable_offerings.is_unavailable(
+                        it.name, o.zone, o.capacity_type
+                    ),
+                    interruption_probability=self.pools.probability(
                         it.name, o.zone, o.capacity_type
                     ),
                 )

@@ -19,6 +19,7 @@ from __future__ import annotations
 import hashlib
 import math
 import threading
+from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from ..api import labels as wk
@@ -135,6 +136,54 @@ class PricingProvider:
         with self._lock:
             self._set_fallback(catalog)
             self.version += 1
+
+
+@dataclass(frozen=True)
+class PoolQuote:
+    """The live view of one capacity pool: what it costs right now and how
+    likely the cloud is to take it back. ``risk_cost(penalty)`` is the
+    expected-interruption term the solver adds to the price objective."""
+
+    instance_type: str
+    zone: str
+    capacity_type: str
+    price: Optional[float]
+    interruption_probability: float
+
+    def risk_cost(self, penalty: float) -> float:
+        return self.interruption_probability * penalty
+
+
+class CapacityPoolProvider:
+    """Joins the live price book with the interruption-risk cache into one
+    per-pool quote surface — the capacity-pool abstraction the providers
+    stamp onto offerings. ``version`` covers both inputs, so any
+    price-refresh OR risk write invalidates downstream seqnum-keyed
+    instance-type caches exactly like the ICE seqnum does."""
+
+    def __init__(self, pricing: PricingProvider, risk=None):
+        self.pricing = pricing
+        self.risk = risk  # Optional[InterruptionRiskCache]; None = risk off
+
+    @property
+    def version(self) -> int:
+        return self.pricing.version + (self.risk.version if self.risk is not None else 0)
+
+    def probability(self, instance_type: str, zone: str, capacity_type: str) -> float:
+        if self.risk is None:
+            return 0.0
+        return self.risk.probability(instance_type, zone, capacity_type)
+
+    def quote(self, instance_type: str, zone: str, capacity_type: str) -> PoolQuote:
+        return PoolQuote(
+            instance_type=instance_type,
+            zone=zone,
+            capacity_type=capacity_type,
+            price=self.pricing.price(instance_type, zone, capacity_type),
+            interruption_probability=self.probability(
+                instance_type, zone, capacity_type
+            ),
+        )
 
 
 class PricingController:

@@ -36,12 +36,28 @@ class Offering:
 
     Reference: cloudprovider.Offering built per zone x capacity-type x price x
     availability (/root/reference/pkg/providers/instancetype/instancetype.go:120-148).
+
+    ``interruption_probability`` is the risk axis of the capacity pool this
+    offering draws from: the provider stamps it from the interruption-risk
+    cache (utils/riskcache.py) the same way ``available`` bakes in the ICE
+    mask, so the estimate rides the seqnum-cached instance-type lists and
+    the flight recorder captures it per round. 0.0 (the on-demand/disabled
+    value) keeps legacy constructions and problem digests unchanged.
     """
 
     zone: str
     capacity_type: str
     price: float
     available: bool = True
+    interruption_probability: float = 0.0
+
+    def pool_key(self, instance_type_name: str) -> "CapacityPool":
+        return (instance_type_name, self.zone, self.capacity_type)
+
+
+#: one capacity pool: the (instance_type, zone, capacity_type) triple that
+#: shares a price feed, an ICE mask and an interruption-risk estimate
+CapacityPool = tuple
 
 
 @dataclass(frozen=True)
@@ -95,12 +111,17 @@ class InstanceType:
 # ---------------------------------------------------------------------------
 
 def offering_to_wire(o: Offering) -> Dict:
-    return {
+    out = {
         "zone": o.zone,
         "capacityType": o.capacity_type,
         "price": o.price,
         "available": o.available,
     }
+    # sparse: 0.0 (on-demand / risk-disabled) stays off the wire, so capsules
+    # recorded before the risk axis existed decode identically
+    if o.interruption_probability:
+        out["interruptionProbability"] = o.interruption_probability
+    return out
 
 
 def offering_from_wire(d: Dict) -> Offering:
@@ -109,6 +130,7 @@ def offering_from_wire(d: Dict) -> Offering:
         capacity_type=d["capacityType"],
         price=d["price"],
         available=d.get("available", True),
+        interruption_probability=d.get("interruptionProbability", 0.0),
     )
 
 

@@ -79,6 +79,11 @@ class DeprovisioningController:
         self.settings = settings or Settings()
         self.recorder = recorder or Recorder()
         self.clock = clock or Clock()
+        # risk-priced objective: consolidation what-ifs must price spot risk
+        # the same way provisioning does, or the sweep would "save" money by
+        # repacking onto pools the next solve refuses
+        if self.settings.spot_enabled:
+            self.solver.risk_penalty = self.settings.interruption_penalty_cost
         from ..utils.resilience import retry_policy_from_settings
 
         # replacement launches retry transient failures like provisioning does
@@ -104,6 +109,7 @@ class DeprovisioningController:
                 quality_race=True,
                 quality_sync=False,
             )
+            self.quality_solver.risk_penalty = self.solver.risk_penalty
         # sweep solves attributed by winning backend (observability for the
         # "which engine answered" question; surfaced by the benchmark).
         # Guarded by _counts_lock: parallel sweep workers report here.
@@ -444,7 +450,7 @@ class DeprovisioningController:
         if s is None:
             return None
         if isinstance(s, TPUSolver):
-            return TPUSolver(
+            clone = TPUSolver(
                 portfolio=s.portfolio,
                 seed=s.seed,
                 max_slots=s.max_slots,
@@ -455,9 +461,14 @@ class DeprovisioningController:
                 quality_race=s.quality_race,
                 quality_sync=s.quality_sync,
             )
-        if isinstance(s, GreedySolver):
-            return GreedySolver()
-        return type(s)()  # a solver type with a zero-arg constructor
+        elif isinstance(s, GreedySolver):
+            clone = GreedySolver()
+        else:
+            clone = type(s)()  # a solver type with a zero-arg constructor
+        # risk-priced objective must agree across workers, or a parallel
+        # sweep's sims would diverge from the serial action on spot catalogs
+        clone.risk_penalty = s.risk_penalty
+        return clone
 
     def _consolidatable(self) -> List[Node]:
         out = []

@@ -27,6 +27,7 @@ from ..api.settings import Settings
 from ..api.taints import tolerates_all
 from ..cloudprovider.interface import CloudProvider, CloudProviderError, InsufficientCapacityError
 from ..cloudprovider.types import InstanceType
+from ..solver import diversify
 from ..solver import gang as gangmod
 from ..solver.encode import ExistingNode
 from ..solver.gang import Gang
@@ -151,6 +152,10 @@ class ProvisioningController:
         # retry in-round with jittered backoff instead of failing the whole
         # reconcile and stalling on the kit's loop-level backoff
         self.retry_policy = retry_policy_from_settings(self.settings)
+        # risk-priced objective (spot capacity pools): the solver adds
+        # p_interrupt * penalty to every offering's price when enabled
+        if self.settings.spot_enabled:
+            self.solver.risk_penalty = self.settings.interruption_penalty_cost
         # machine-name sequence; the replay harness pins a private one to
         # the recorded capsule's snapshot so launched-node names reproduce
         self.machine_ids: Optional[MachineNameSeq] = None
@@ -202,6 +207,20 @@ class ProvisioningController:
                     self.batcher.note_arrival()
             else:
                 self._pending_seen.discard(obj.name)
+
+    def note_interrupted(self, pods: Sequence[Pod]) -> None:
+        """Interruption fast path (controllers/interruption.py): pods a
+        reclaimed node just drained are dirtied into the delta encoder and
+        arm the batch window SYNCHRONOUSLY, instead of waiting for the
+        eviction's watch event to trickle through an async informer — the
+        next provisioning round re-solves them immediately, so
+        rounds-to-replacement is 1, not 1-plus-watch-latency."""
+        for pod in pods:
+            if pod.is_pending() and pod.meta.deletion_timestamp is None:
+                self.encode_session.pod_event("ADDED", pod)
+                if pod.name not in self._pending_seen:
+                    self._pending_seen.add(pod.name)
+                    self.batcher.note_arrival()
 
     # -- the reconcile loop body -------------------------------------------
     def reconcile(self) -> ProvisioningResult:
@@ -294,7 +313,23 @@ class ProvisioningController:
         # limits exhaustion and catalog infeasibility are DIFFERENT root
         # causes and must not be conflated in /debug/decisions
         unsched_reason: Dict[str, str] = {}
-        for round_no in range(max(len(provisioners), 1) + 1 + self._ICE_RETRIES):
+        # spot-pool diversification (solver/diversify.py): units computed
+        # once per reconcile from the full batch; pools the gate masked for
+        # respreading accumulate here and apply to later rounds' catalogs
+        div_units = (
+            diversify.collect_units(
+                pods, gangs, self.settings.spot_diversification_max_frac
+            )
+            if self.settings.spot_enabled
+            else []
+        )
+        div_masked: set = set()
+        div_retries = 0
+        div_fallback = False  # placement-over-diversification escape taken
+        for round_no in range(
+            max(len(provisioners), 1) + 1 + self._ICE_RETRIES
+            + self._DIVERSIFY_RETRIES + 1
+        ):
             # instance-type lists refresh each round: an ICE mark from the
             # previous round's launches must mask the offering NOW, not next
             # reconcile (get_instance_types is seqnum-cached — cheap when
@@ -303,6 +338,15 @@ class ProvisioningController:
                 (p, self.provider.get_instance_types(p))
                 for p in provisioners if p.name not in exhausted
             ]
+            if div_masked:
+                # respread rounds solve against the catalog minus the
+                # overweight pools (round 0 is always unmasked, so the
+                # capsule's recorded catalog is the clean one — replay
+                # re-derives the same masks from the same gate decisions)
+                round_provs = [
+                    (p, diversify.mask_pools(types, div_masked))
+                    for p, types in round_provs
+                ]
             if cap is not None and round_no == 0:
                 # complete round input, captured BEFORE anything mutates:
                 # the instance-type lists carry the ICE mask as offering
@@ -324,10 +368,15 @@ class ProvisioningController:
                         object_name=p.name, object_kind="Pod", type="Warning",
                     )
                 break
+            round_existing = self.cluster.existing_capacity()
+            if div_masked:
+                # a respread round must not rebind stripped pods onto the
+                # overweight pool's free EXISTING capacity either
+                round_existing = diversify.filter_existing(round_existing, div_masked)
             solve = self.solver.solve_pods(
                 batch,
                 round_provs,
-                existing=self.cluster.existing_capacity(),
+                existing=round_existing,
                 daemonsets=daemonsets,
                 session=self.encode_session,
             )
@@ -368,17 +417,71 @@ class ProvisioningController:
                     capacity_gangs[gname] = gangs[gname]
                     gang_admit_details.pop(gname, None)
                 gang_admit_details.update(gate.admitted_details)
+            div_stripped = False
+            if div_units:
+                # spot-pool concentration gate, after the gang gate (it must
+                # judge the placements that will actually bind): members over
+                # the per-pool cap are stripped and re-solve next round with
+                # the overweight pool masked
+                enforce = div_retries < self._DIVERSIFY_RETRIES and not div_fallback
+                div = diversify.gate(solve, div_units, self.cluster, enforce=enforce)
+                for v in div.verdicts:
+                    outcome_name = "accepted" if v["accepted"] else "respread"
+                    metrics.SPOT_DIVERSIFICATION.inc({"outcome": outcome_name})
+                    DECISIONS.record_coalesced(
+                        "diversification", outcome_name, pod=v["unit"],
+                        reason=(
+                            f"spot pool {v['pool']} holds {v['members']} members "
+                            f"(cap {v['cap']})"
+                        ),
+                        details=dict(v),
+                    )
+                if div.strip:
+                    solve = div.solve
+                    div_masked |= div.mask
+                    div_stripped = True
             limit_hit, ice_failed = self._apply_solve(solve, result, round_provs)
             retry_ice = bool(ice_failed) and ice_retries < self._ICE_RETRIES
             if retry_ice:
                 ice_retries += 1
-            if limit_hit or retry_ice:
+            if div_stripped:
+                div_retries += 1
+            if limit_hit or retry_ice or div_stripped:
                 exhausted |= limit_hit
                 # EVERYTHING still pending gets another round against the
                 # remaining pools — both the limit-blocked specs' pods and the
                 # pods this solve called unschedulable (their infeasibility may
                 # have come from the weight gate pinning them to the exhausted
                 # pool)
+                pending_again = [
+                    q for q in batch
+                    if (qq := self.cluster.pods.get(q.name)) is not None
+                    and qq.is_pending()
+                ]
+                if pending_again:
+                    names = {q.name for q in pending_again}
+                    result.unschedulable = [
+                        n for n in result.unschedulable if n not in names
+                    ]
+                    batch = pending_again
+                    continue
+            if (
+                solve.unschedulable and div_masked and not div_fallback
+                and self._mask_stranded(
+                    solve.unschedulable, div_masked, round_provs
+                )
+            ):
+                # placement outranks spread: a pod the diversification-masked
+                # catalog cannot host gets one re-solve against the full
+                # catalog with the gate disabled — zero unschedulable pods is
+                # the contract, concentration the lesser evil. Only pods the
+                # masking could actually have stranded count: a pod no masked
+                # pool can host is unschedulable for catalog reasons, and
+                # unmasking + re-solving cannot save it (it would otherwise
+                # buy a wasted extra solve round and disarm the gate every
+                # reconcile it stays pending)
+                div_fallback = True
+                div_masked.clear()
                 pending_again = [
                     q for q in batch
                     if (qq := self.cluster.pods.get(q.name)) is not None
@@ -428,10 +531,48 @@ class ProvisioningController:
         self.batcher.reset(upto_generation=batch_gen)
         return result
 
+    def _mask_stranded(self, names, masked, round_provs) -> bool:
+        """True when some unschedulable pod could plausibly have landed on a
+        diversification-masked pool — the only case where dropping the masks
+        and burning the fallback re-solve can help. Deliberately conservative
+        (requests-fit + label-surface checks, the same cheap approximation
+        ``rejected_alternatives`` uses): when in doubt the fallback runs,
+        because zero unschedulable pods outranks the extra solve round."""
+        pods = [p for p in (self.cluster.pods.get(n) for n in names) if p is not None]
+        if not pods:
+            return False
+        for prov, types in round_provs:
+            prov_reqs = Requirements.from_labels(prov.labels).intersect(
+                prov.requirements
+            )
+            for it in types:
+                pools = [m for m in masked if m[0] == it.name]
+                if not pools or not it.requirements.compatible(prov_reqs):
+                    continue
+                alloc = it.allocatable()
+                for pod in pods:
+                    if not pod.requests.fits(alloc):
+                        continue
+                    if not tolerates_all(list(pod.tolerations), tuple(prov.taints)):
+                        continue
+                    terms = pod.scheduling_requirement_terms()
+                    for _, zone, ct in pools:
+                        surface = it.requirements.add(
+                            Requirement.in_values(wk.ZONE, [zone]),
+                            Requirement.in_values(wk.CAPACITY_TYPE, [ct]),
+                        ).intersect(prov_reqs)
+                        if any(surface.compatible(term) for term in terms):
+                            return True
+        return False
+
     #: bounded in-round re-solves after ICE launch failures: each retry has
     #: the failed offering(s) freshly masked, so one retry normally lands the
     #: next-cheapest offering; a storm falls back to the next reconcile
     _ICE_RETRIES = 2
+    #: bounded in-round respread re-solves after the spot-diversification
+    #: gate strips over-concentrated members; each retry masks at least one
+    #: more pool, and the placement-over-diversification fallback runs last
+    _DIVERSIFY_RETRIES = 3
 
     # -- gang scheduling ----------------------------------------------------
     def _gang_gate(
@@ -777,6 +918,20 @@ class ProvisioningController:
             )
             self._gang_wait.pop(name, None)
 
+    def _bind(self, pod_name: str, node_name: str) -> None:
+        """Bind a pod and synchronously retire it from the delta session's
+        encoded set. The controller must not depend on watch delivery to
+        learn about its OWN binds: cascade re-solves within one reconcile
+        (gang/diversification strips, ICE retries) encode the shrunken batch
+        immediately, and an async informer delivering the MODIFIED event a
+        beat late would desync the session into a full-encode fallback.
+        The later watch event collapses idempotently in pod_event."""
+        self.cluster.bind_pod(pod_name, node_name)
+        pod = self.cluster.pods.get(pod_name)
+        if pod is not None:
+            self.encode_session.pod_event("DELETED", pod)
+        self._pending_seen.discard(pod_name)
+
     def _apply_solve(
         self,
         solve: SolveResult,
@@ -791,7 +946,7 @@ class ProvisioningController:
         for node_name, pod_names in solve.existing_assignments.items():
             names = list(pod_names)
             for i, pod_name in enumerate(names):
-                self.cluster.bind_pod(pod_name, node_name)
+                self._bind(pod_name, node_name)
                 result.bound[pod_name] = node_name
                 metrics.PODS_SCHEDULED.inc()
                 DECISIONS.record(
@@ -896,14 +1051,15 @@ class ProvisioningController:
             representative = self.cluster.pods.get(pods[0]) if pods else None
             if representative is not None and round_provs:
                 details["rejected_alternatives"] = rejected_alternatives(
-                    representative, spec.option, round_provs
+                    representative, spec.option, round_provs,
+                    penalty=getattr(self.solver, "risk_penalty", 0.0),
                 )
             DECISIONS.record(
                 "nomination", "launched", node=node.name,
                 details={**details, "pods": len(pods)},
             )
             for i, pod_name in enumerate(pods):
-                self.cluster.bind_pod(pod_name, node.name)
+                self._bind(pod_name, node.name)
                 result.bound[pod_name] = node.name
                 metrics.PODS_SCHEDULED.inc()
                 DECISIONS.record(
@@ -957,6 +1113,7 @@ def rejected_alternatives(
     chosen,
     round_provs: Sequence[Tuple[Provisioner, Sequence[InstanceType]]],
     k: int = 3,
+    penalty: float = 0.0,
 ) -> List[Dict[str, object]]:
     """The audit log's "why not something cheaper" answer: the top-``k``
     offerings CHEAPER than the chosen one, each classified by reject reason —
@@ -973,10 +1130,17 @@ def rejected_alternatives(
 
     Classification is a per-pod approximation of the encoder's compat row —
     deliberately cheap (one representative pod per node spec, label-surface
-    checks only), because it runs on the provisioning hot path."""
+    checks only), because it runs on the provisioning hot path.
+
+    ``penalty`` is the solver's risk penalty: cheaper/pricier is judged on
+    the RISK-ADJUSTED price ``price + interruption_probability * penalty``
+    the solve actually optimized, so a risky spot offering the solver priced
+    out reports reason ``price`` (its effective price lost) instead of
+    masquerading as a ``packing`` reject of a nominally-cheaper sticker."""
     terms = pod.scheduling_requirement_terms()
     tolerations = list(pod.tolerations)
     chosen_key = (chosen.instance_type.name, chosen.zone, chosen.capacity_type)
+    chosen_eff = chosen.price + getattr(chosen, "interruption_probability", 0.0) * penalty
     cheaper: List[Tuple[float, Dict[str, object]]] = []
     # only the single cheapest pricier offering is ever reported (the
     # no-cheaper-exists fallback), so track a scalar min instead of
@@ -1004,28 +1168,34 @@ def rejected_alternatives(
             for o in it.offerings:
                 if (it.name, o.zone, o.capacity_type) == chosen_key:
                     continue
+                o_eff = o.price + o.interruption_probability * penalty
+                entry_prices: Dict[str, object] = {"price": round(o.price, 5)}
+                if penalty:
+                    entry_prices["effective_price"] = round(o_eff, 5)
                 excluded = (
                     not prov_compatible
                     or not prov_zone.has(o.zone)
                     or not prov_ct.has(o.capacity_type)
                 )
                 if excluded:
-                    if o.price < chosen.price:
-                        cheaper.append((o.price, {
+                    if o_eff < chosen_eff:
+                        cheaper.append((o_eff, {
                             "instance_type": it.name, "zone": o.zone,
                             "capacity_type": o.capacity_type,
-                            "price": round(o.price, 5),
+                            **entry_prices,
                             "reason": "provisioner",
                         }))
                     continue
-                if o.price >= chosen.price:
+                if o_eff >= chosen_eff:
                     # pricier offerings need no compat analysis — "price" is
-                    # the reject reason by definition
-                    if best_pricier is None or o.price < best_pricier[0]:
-                        best_pricier = (o.price, {
+                    # the reject reason by definition (risk-adjusted when a
+                    # penalty is in force: a risky spot sticker-bargain that
+                    # effectively cost more LOST ON PRICE)
+                    if best_pricier is None or o_eff < best_pricier[0]:
+                        best_pricier = (o_eff, {
                             "instance_type": it.name, "zone": o.zone,
                             "capacity_type": o.capacity_type,
-                            "price": round(o.price, 5), "reason": "price",
+                            **entry_prices, "reason": "price",
                         })
                     continue
                 if not o.available:
@@ -1043,10 +1213,10 @@ def rejected_alternatives(
                         reason = "requirements"
                     else:
                         reason = "packing"
-                cheaper.append((o.price, {
+                cheaper.append((o_eff, {
                     "instance_type": it.name, "zone": o.zone,
                     "capacity_type": o.capacity_type,
-                    "price": round(o.price, 5), "reason": reason,
+                    **entry_prices, "reason": reason,
                 }))
     cheaper.sort(key=lambda t: t[0])
     out = [entry for _, entry in cheaper[:k]]

@@ -98,6 +98,18 @@ class Operator:
         from .utils import runtimehealth
 
         runtimehealth.install(memory_profiling=settings.memory_profiling_enabled)
+        # risk-aware spot capacity pools: the risk cache feeds offering
+        # interruption probabilities (provider stamping), the solver's risk
+        # penalty, and the rebalance controller's pool choices
+        risk_cache = None
+        if settings.spot_enabled:
+            from .utils.riskcache import InterruptionRiskCache
+
+            risk_cache = InterruptionRiskCache(
+                halflife_s=settings.risk_decay_halflife_s, clock=clock
+            )
+            if hasattr(provider, "attach_risk_cache"):
+                provider.attach_risk_cache(risk_cache)
         solver = solver or TPUSolver()
         provisioning = ProvisioningController(
             cluster, provider, solver=solver, settings=settings, recorder=recorder
@@ -115,6 +127,11 @@ class Operator:
                 cluster, queue if queue is not None else FakeQueue(), termination,
                 unavailable_offerings=getattr(provider, "unavailable_offerings", None),
                 recorder=recorder,
+                risk_cache=risk_cache,
+                provisioning=provisioning,
+                provider=provider if settings.spot_enabled else None,
+                settings=settings,
+                clock=clock,
             )
         nodetemplate = (
             NodeTemplateController(cluster, provider, recorder=recorder)

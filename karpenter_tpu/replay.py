@@ -79,6 +79,10 @@ def apply_overrides(capsule: Dict, overrides: Sequence[str]) -> Dict:
     * ``offerings=<type>/<zone>/<ct>=available|unavailable|price:<x>`` —
       flip an offering's availability (undo an ICE mask, simulate one) or
       reprice it; ``*`` wildcards any path segment;
+    * ``risk.<type>/<zone>/<ct>=<p>`` — repin a capacity pool's recorded
+      interruption probability ("what if this pool were riskier"): the
+      risk-priced solve and the rebalance controller's replacement choice
+      both see the counterfactual estimate; ``*`` wildcards segments;
     * ``provisioner.<name>.limits.<resource>=<quantity>`` — raise/lower a
       provisioner's resource ceiling (``none`` removes all limits);
     * ``provisioner.<name>.weight=<int>`` — re-rank the pool cascade.
@@ -99,14 +103,45 @@ def apply_overrides(capsule: Dict, overrides: Sequence[str]) -> Dict:
             settings[field] = _coerce_like(settings[field], value)
         elif key == "offerings":
             _apply_offering_override(inputs, value)
+        elif key.startswith("risk."):
+            _apply_risk_override(inputs, key[len("risk."):], value)
         elif key.startswith("provisioner."):
             _apply_provisioner_override(inputs, key[len("provisioner."):], value)
         else:
             raise OverrideError(
                 f"unknown override {key!r} (use settings.*, offerings=..., "
-                "provisioner.<name>.*)"
+                "risk.<type>/<zone>/<ct>=<p>, provisioner.<name>.*)"
             )
     return capsule
+
+
+def _apply_risk_override(inputs: Dict, sel: str, value: str) -> None:
+    parts = sel.split("/")
+    if len(parts) != 3:
+        raise OverrideError(
+            f"risk override {sel!r} is not risk.<type>/<zone>/<ct>=<p>"
+        )
+    it_name, zone, ct = parts
+    try:
+        p = float(value)
+    except ValueError as e:
+        raise OverrideError(str(e)) from None
+    if not 0.0 <= p <= 1.0:
+        raise OverrideError(f"risk probability {p} not in [0, 1]")
+    hit = 0
+    for types in inputs.get("instance_types", {}).values():
+        for it in types:
+            if it_name not in ("*", it["name"]):
+                continue
+            for o in it.get("offerings", []):
+                if zone not in ("*", o["zone"]):
+                    continue
+                if ct not in ("*", o["capacityType"]):
+                    continue
+                o["interruptionProbability"] = p
+                hit += 1
+    if hit == 0:
+        raise OverrideError(f"risk override {sel!r} matched nothing")
 
 
 def _apply_offering_override(inputs: Dict, spec: str) -> None:
@@ -267,14 +302,14 @@ class CapsuleCloudProvider:
                 if cached is not None and cached[0] == seq:
                     return cached[1]
                 # in-round ICE marks re-mask the recorded catalog exactly as
-                # the live provider's seqnum-keyed cache did
-                from .cloudprovider.types import Offering
+                # the live provider's seqnum-keyed cache did (replace(), so
+                # the recorded interruption probability rides along)
+                from dataclasses import replace as _replace
 
                 out = [
                     it.with_offerings([
-                        Offering(
-                            zone=o.zone, capacity_type=o.capacity_type,
-                            price=o.price,
+                        _replace(
+                            o,
                             available=o.available
                             and not self.unavailable_offerings.is_unavailable(
                                 it.name, o.zone, o.capacity_type
@@ -318,6 +353,17 @@ class _DigestTapSolver:
 
     def __getattr__(self, name):
         return getattr(self._inner, name)
+
+    def __setattr__(self, name, value):
+        # forward attribute WRITES to the wrapped solver too: the replayed
+        # controller configures its solver by assignment (risk_penalty from
+        # spot_enabled settings) and the inner solve path reads the value
+        # off the REAL solver — a set stranded on the proxy would replay a
+        # risk-priced round risk-neutral and falsely diverge
+        if name in ("_inner", "digests"):
+            object.__setattr__(self, name, value)
+        else:
+            setattr(self._inner, name, value)
 
 
 def _make_solver(capsule: Dict, name: Optional[str] = None):
@@ -439,6 +485,10 @@ def replay_capsule(
         tap = _DigestTapSolver(base_solver)
         if controller_kind == "provisioning":
             replayed = _replay_provisioning(capsule, cluster, provider, tap, settings)
+        elif controller_kind == "rebalance":
+            replayed = _replay_rebalance(
+                capsule, cluster, provider, base_solver, settings
+            )
         else:
             # the deprovisioner inspects its solver's concrete type (quality-
             # budget race construction, per-worker clones): hand it the REAL
@@ -460,7 +510,8 @@ def replay_capsule(
         "recorded": {
             k: recorded.get(k)
             for k in ("problem_digests", "placements", "unschedulable",
-                      "gang_deferred", "action", "planned", "decisions")
+                      "gang_deferred", "action", "planned", "decisions",
+                      "rebalance_actions")
             if k in recorded
         },
     }
@@ -502,6 +553,18 @@ def replay_capsule(
             and diffs["unschedulable_match"]
             and diffs["gang_deferred_match"]
         )
+    elif controller_kind == "rebalance":
+        # rebalance rounds compare the full ordered action list — pool,
+        # replacement offering AND replacement node name (the machine-name
+        # sequence is pinned, so names are replayable identity here)
+        diffs["rebalance_actions_match"] = (
+            (recorded.get("rebalance_actions") or [])
+            == (replayed.get("rebalance_actions") or [])
+        )
+        rec_keys = _decision_keys(recorded.get("decisions", []))
+        rep_keys = _decision_keys(replayed.get("decisions", []))
+        diffs["decisions_match"] = rec_keys == rep_keys
+        report["match"] = diffs["rebalance_actions_match"]
     else:
         rec_action = recorded.get("action") or recorded.get("planned")
         rep_action = replayed.get("action") or replayed.get("planned")
@@ -540,6 +603,58 @@ def _replay_provisioning(capsule, cluster, provider, solver, settings) -> Dict:
     controller.machine_ids = MachineNameSeq(capsule.get("machine_seq", 1))
     result = controller.reconcile()
     return provisioning_outputs(result, cluster)
+
+
+def _replay_rebalance(capsule, cluster, provider, solver, settings) -> Dict:
+    """Re-run a rebalance round offline: the recorded queue messages refeed
+    verbatim (garbage included), pending rebalances restore with their
+    remaining deadlines against a pinned clock, the machine-name sequence
+    pins to the capsule, and the capsule catalog — interruption
+    probabilities included — serves the replacement-pool choice. The
+    replayed action list must equal the recorded one byte-for-byte."""
+    from .controllers.interruption import (
+        FakeQueue, InterruptionController, PendingRebalance,
+    )
+    from .controllers.provisioning import MachineNameSeq, ProvisioningController
+    from .controllers.termination import TerminationController
+    from .utils.cache import FakeClock
+    from .utils.events import Recorder
+
+    inputs = capsule.get("inputs", {})
+    clock = FakeClock(capsule.get("clock_now", 0.0))
+    recorder = Recorder()
+    termination = TerminationController(
+        cluster, provider, recorder=recorder, clock=clock
+    )
+    prov_ctl = ProvisioningController(
+        cluster, provider, solver=solver, settings=settings
+    )
+    queue = FakeQueue()
+    for body in inputs.get("queue_messages", []):
+        queue.send_raw(body)
+    controller = InterruptionController(
+        cluster, queue, termination,
+        unavailable_offerings=provider.unavailable_offerings,
+        recorder=recorder,
+        provisioning=prov_ctl,
+        provider=provider,
+        settings=settings,
+        clock=clock,
+    )
+    controller.machine_ids = MachineNameSeq(capsule.get("machine_seq", 1))
+    prov_ctl.machine_ids = controller.machine_ids
+    for ent in inputs.get("rebalance_pending", []):
+        controller._rebalances[ent["node"]] = PendingRebalance(
+            node=ent["node"],
+            pool=tuple(ent["pool"]),
+            replacement=ent["replacement"],
+            deadline=clock.now() + float(ent.get("deadline_remaining", 0.0)),
+        )
+    controller.reconcile(max_messages=max(len(queue), 10))
+    # canonical (node, action) order: the capsule recorded _sorted_actions()
+    # (worker-pool append order is scheduler-dependent), so the replayed list
+    # must be compared in the same ordering
+    return {"rebalance_actions": controller._sorted_actions()}
 
 
 def _pending_action_from_wire(wire: Dict, cluster, provider, clock, settings):
@@ -699,7 +814,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--override", action="append", default=[],
                     help="counterfactual knob (repeatable): settings.<f>=<v>, "
                          "offerings=<type>/<zone>/<ct>=available|unavailable|"
-                         "price:<x>, provisioner.<name>.limits.<res>=<qty>, "
+                         "price:<x>, risk.<type>/<zone>/<ct>=<p>, "
+                         "provisioner.<name>.limits.<res>=<qty>, "
                          "provisioner.<name>.weight=<n>")
     ap.add_argument("--solver", default=None, choices=("tpu", "greedy"),
                     help="override the recorded solver")
@@ -760,6 +876,12 @@ def _print_summary(report: Dict) -> None:
               f"replayed={len(rep.get('gang_deferred') or [])} "
               f"equal={diffs.get('gang_deferred_match')}")
         print(f"  decisions: equal={diffs.get('decisions_match')}")
+    elif report["controller"] == "rebalance":
+        rep = report.get("replayed", {})
+        for a in rep.get("rebalance_actions") or []:
+            print(f"  {a.get('action')}: {a.get('node')} "
+                  f"(pool {'/'.join(a.get('pool', []))})")
+        print(f"  rebalance_actions_match={diffs.get('rebalance_actions_match')}")
     else:
         rep = report.get("replayed", {})
         print(f"  action: {rep.get('action') or rep.get('planned')}")

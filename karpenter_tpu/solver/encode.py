@@ -150,20 +150,23 @@ def _signature(pod: Pod) -> tuple:
     aff = _EMPTY
     if pod.affinity_terms:
         aff = tuple(sorted(_aff_sig(t) for t in pod.affinity_terms))
-    # Gang/priority component: a gang member (annotation-form pod-group; the
-    # label form already rides the label surface) or a prioritized pod must
-    # never bucket with an otherwise-identical plain pod — the gang gate's
-    # all-or-nothing unit and the preemption planner's entitlement both key
-    # off group purity. Absent for the plain-pod common case, so existing
-    # signatures (and problem digests) are unchanged. The native encoder
-    # defers these pods to this function (encoder.c: gang/priority check).
+    # Gang/priority/pool-policy component: a gang member (annotation-form
+    # pod-group; the label form already rides the label surface), a
+    # prioritized pod, or a spot-diversification carrier must never bucket
+    # with an otherwise-identical plain pod — the gang gate's all-or-nothing
+    # unit, the preemption planner's entitlement and the diversification
+    # gate's per-group pool caps all key off group purity. Absent for the
+    # plain-pod common case, so existing signatures (and problem digests)
+    # are unchanged. The native encoder defers these pods to this function
+    # (encoder.c: gang/priority/spot-div check).
     gang = _EMPTY
     ann = pod.meta.annotations
-    if pod.priority or (ann and wk.POD_GROUP in ann):
+    if pod.priority or (ann and (wk.POD_GROUP in ann or wk.SPOT_DIVERSIFICATION in ann)):
         gang = (
             pod.priority,
             ann.get(wk.POD_GROUP, ""),
             ann.get(wk.POD_GROUP_MIN_MEMBERS, ""),
+            ann.get(wk.SPOT_DIVERSIFICATION, ""),
         )
     sig = (
         _items_t(pod.requests.items_mapping()),
@@ -266,10 +269,25 @@ class LaunchOption:
     instance_type: InstanceType
     zone: str
     capacity_type: str
-    price: float
+    price: float  # the REAL hourly price (billing, savings, reports)
     node_requirements: Requirements  # label surface the resulting node will carry
     taints: Tuple[Taint, ...]
     allocatable: Resources  # after daemonset overhead
+    # capacity-pool risk axis: the offering's interruption-probability
+    # estimate and its expected-interruption cost (p * penalty). The solver
+    # objective is price + risk_cost; ``price`` itself stays the real price
+    # so launch decisions, consolidation savings and audit records report
+    # what the cluster actually pays.
+    interruption_probability: float = 0.0
+    risk_cost: float = 0.0
+
+    @property
+    def effective_price(self) -> float:
+        return self.price + self.risk_cost
+
+    @property
+    def pool(self) -> tuple:
+        return (self.instance_type.name, self.zone, self.capacity_type)
 
 
 _options_cache: Dict[tuple, tuple] = {}
@@ -291,6 +309,7 @@ def _get_option_table(options: List[LaunchOption]) -> "_ReqTable":
 def build_options(
     provisioners: Sequence[Tuple[Provisioner, Sequence[InstanceType]]],
     daemonsets: Sequence[Pod] = (),
+    risk_penalty: float = 0.0,
 ) -> List[LaunchOption]:
     """Flatten (provisioner x instance type x available offering) into launch options.
 
@@ -310,6 +329,7 @@ def build_options(
             for p, types in provisioners
         ),
         tuple(id(d) for d in daemonsets),
+        risk_penalty,  # the penalty scales every option's risk_cost
     )
     cached = _options_cache.get(key)
     if (
@@ -330,7 +350,7 @@ def build_options(
     # rebuilding the requirement table costs ~50ms the launch options don't
     # actually depend on. The content key covers everything the options are
     # built from: type spec surface + offerings + provisioner generation.
-    ckey = _options_content_key(provisioners, daemonsets)
+    ckey = _options_content_key(provisioners, daemonsets) + (risk_penalty,)
     ccached = _options_content_cache.get(ckey)
     if ccached is not None:
         # refresh the identity cache so the NEXT call hits the cheap path
@@ -390,6 +410,8 @@ def build_options(
                         node_requirements=node_reqs,
                         taints=taints,
                         allocatable=effective,
+                        interruption_probability=offering.interruption_probability,
+                        risk_cost=offering.interruption_probability * risk_penalty,
                     )
                 )
     _options_cache.clear()  # hold one generation; stale keys pin dead objects
@@ -454,7 +476,8 @@ def _type_sig(it: InstanceType) -> tuple:
             )
         ),
         tuple(
-            (o.zone, o.capacity_type, o.price, o.available)
+            (o.zone, o.capacity_type, o.price, o.available,
+             o.interruption_probability)
             for o in it.offerings
         ),
     )
@@ -927,7 +950,11 @@ def _option_arrays(
     opt_zone = np.zeros((O,), dtype=np.int32)
     for j, o in enumerate(options):
         alloc[j] = _vector(o.allocatable, axes)
-        price[j] = o.price
+        # the solve OBJECTIVE is the risk-adjusted effective price: the real
+        # price plus the expected-interruption penalty (0 when risk is off),
+        # so a cheap-but-reclaimable spot pool loses to a slightly pricier
+        # stable one exactly when the expected disruption cost says it should
+        price[j] = o.price + o.risk_cost
         opt_zone[j] = zone_index[o.zone]
     _opt_array_cache.clear()
     _opt_array_cache[key] = (options, (alloc, price, opt_zone))
@@ -1171,6 +1198,7 @@ def encode(
     existing: Sequence[ExistingNode] = (),
     daemonsets: Sequence[Pod] = (),
     weight_degate: frozenset = frozenset(),
+    risk_penalty: float = 0.0,
 ) -> EncodedProblem:
     with ENCODE_LOCK:
         # The ONLY vocab compaction boundary: every table built or reused
@@ -1178,7 +1206,7 @@ def encode(
         # eval reads.
         _maybe_compact_vocab()
         groups = group_pods(pods)
-        options = build_options(provisioners, daemonsets)
+        options = build_options(provisioners, daemonsets, risk_penalty)
 
         axes = _resource_axes(groups, options)
         zones = zone_list(options, existing)

@@ -239,6 +239,7 @@ class EncodeSession:
         existing: Sequence[ExistingNode] = (),
         daemonsets: Sequence[Pod] = (),
         weight_degate: frozenset = frozenset(),
+        risk_penalty: float = 0.0,
     ) -> EncodedProblem:
         t0 = time.perf_counter()
         with self._lock, ENCODE_LOCK:
@@ -247,12 +248,15 @@ class EncodeSession:
             reason = self._full_reason(weight_degate)
             if reason is None:
                 try:
-                    problem = self._delta_encode(pods, provisioners, existing, daemonsets)
+                    problem = self._delta_encode(
+                        pods, provisioners, existing, daemonsets, risk_penalty
+                    )
                 except _FullNeeded as e:
                     reason = str(e)
             if reason is not None:
                 problem = self._full_encode(
-                    pods, provisioners, existing, daemonsets, weight_degate
+                    pods, provisioners, existing, daemonsets, weight_degate,
+                    risk_penalty,
                 )
                 self.last_mode, self.last_full_reason = "full", reason
                 self.stats["full"] += 1
@@ -304,13 +308,16 @@ class EncodeSession:
             return "periodic-resync"
         return None
 
-    def _full_encode(self, pods, provisioners, existing, daemonsets, weight_degate):
+    def _full_encode(
+        self, pods, provisioners, existing, daemonsets, weight_degate,
+        risk_penalty=0.0,
+    ):
         """Full pipeline, capturing the pre-gate/pre-seed state the delta
         path patches next round. Mirrors encode() stage by stage."""
         self._ops.clear()
         pods = list(pods)
         groups = group_pods(pods)
-        options = build_options(provisioners, daemonsets)
+        options = build_options(provisioners, daemonsets, risk_penalty)
         axes = _resource_axes(groups, options)
         zones = zone_list(options, existing)
         zone_index = {z: i for i, z in enumerate(zones)}
@@ -444,14 +451,18 @@ class EncodeSession:
         else:
             rec.first_seq = self._seq[next(iter(rec.members))]
 
-    def _delta_encode(self, pods, provisioners, existing, daemonsets):
+    def _delta_encode(self, pods, provisioners, existing, daemonsets, risk_penalty=0.0):
         self._flush_ops()
         if len(pods) != len(self._seq):
             raise _FullNeeded("pod-set-desync")
 
         recs = sorted(self._by_sig.values(), key=lambda r: r.first_seq)
         groups = [r.fresh_group() for r in recs]
-        options = build_options(provisioners, daemonsets)
+        # risk_penalty scales every option's risk_cost, so a changed penalty
+        # (settings flip) yields a NEW option list here — the option-axis
+        # patch below then rebuilds the price array; compat columns are
+        # risk-independent and keep their patch-key reuse.
+        options = build_options(provisioners, daemonsets, risk_penalty)
 
         axes = _resource_axes(groups, options)
         if axes != self._axes:

@@ -364,6 +364,14 @@ def _problems_content_equal(a: EncodedProblem, b: EncodedProblem) -> bool:
 
 
 class Solver(abc.ABC):
+    #: per-interruption disruption cost ($-hours) scaling each offering's
+    #: expected-interruption term in the price objective: the encoder builds
+    #: options with risk_cost = interruption_probability * risk_penalty. Set
+    #: from settings by the controllers (0.0 = risk-neutral, the legacy
+    #: objective); every encode this solver drives — initial, relax, degate,
+    #: trial solves — uses the same value, preserving delta==full digests.
+    risk_penalty: float = 0.0
+
     @abc.abstractmethod
     def solve(self, problem: EncodedProblem) -> SolveResult: ...
 
@@ -437,9 +445,15 @@ class Solver(abc.ABC):
         with span("solve", pods=len(pods)):
             with span("solve.encode"):
                 if session is not None:
-                    fresh = session.encode(pods, provisioners, existing, daemonsets)
+                    fresh = session.encode(
+                        pods, provisioners, existing, daemonsets,
+                        risk_penalty=self.risk_penalty,
+                    )
                 else:
-                    fresh = encode(pods, provisioners, existing, daemonsets)
+                    fresh = encode(
+                        pods, provisioners, existing, daemonsets,
+                        risk_penalty=self.risk_penalty,
+                    )
                     fresh.__dict__["_encode_mode"] = phase_mode
                     _observe_phase(fresh, "encode", time.perf_counter() - t0)
                 problem = self._intern_problem(fresh)
@@ -489,7 +503,10 @@ class Solver(abc.ABC):
                 total_relaxed += relaxed_round
                 with span("solve.relax", pods=relaxed_round):
                     t_enc = time.perf_counter()
-                    problem = encode(work, provisioners, existing, daemonsets)
+                    problem = encode(
+                        work, provisioners, existing, daemonsets,
+                        risk_penalty=self.risk_penalty,
+                    )
                     encode_s += time.perf_counter() - t_enc
                     problem.__dict__["_entry_t"] = t0
                     result = self.solve(problem)
@@ -513,6 +530,7 @@ class Solver(abc.ABC):
                     problem2 = encode(
                         work or pods, provisioners, existing, daemonsets,
                         weight_degate=degate,
+                        risk_penalty=self.risk_penalty,
                     )
                     encode_s += time.perf_counter() - t_enc
                     problem2.__dict__["_entry_t"] = t0

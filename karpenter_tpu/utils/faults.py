@@ -117,6 +117,86 @@ def raise_for_fault(fault: Optional[Fault], plan: "FaultPlan", endpoint: str) ->
     )
 
 
+# ---------------------------------------------------------------------------
+# Scripted interruption schedules (the FaultPlan idea, generalized from
+# per-endpoint RPC faults to cluster-level capacity events): reclaim waves
+# per capacity pool and spot price spikes, keyed by round number. Drives the
+# spot_churn bench scenario and the interruption-storm tests — sustained,
+# deterministic reclamation with zero randomness, like every fault here.
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class ReclaimWave:
+    """One spot-reclaim wave: at ``round_no``, a ``fraction`` of the nodes in
+    ``pool`` (``(instance_type, zone, capacity_type)``; ``*`` wildcards a
+    segment) receive interruption events. ``rebalance_first=True`` sends the
+    rebalance recommendation instead of the 2-minute warning — the proactive
+    path's trigger."""
+
+    round_no: int
+    pool: Tuple[str, str, str]
+    fraction: float = 1.0
+    rebalance_first: bool = False
+
+    def selects(self, pool: Tuple[str, str, str]) -> bool:
+        return all(w in ("*", p) for w, p in zip(self.pool, pool))
+
+
+@dataclass(frozen=True)
+class PriceSpike:
+    """At ``round_no``, multiply one spot pool's live price by ``factor`` —
+    the market moving against a pool mid-churn."""
+
+    round_no: int
+    instance_type: str
+    zone: str
+    factor: float
+
+
+class InterruptionSchedule:
+    """A deterministic capacity-event timeline over bench/test rounds.
+
+    ``waves_for(round)`` / ``spikes_for(round)`` return the events scripted
+    for that round; ``victims(wave, nodes)`` picks the wave's victim nodes
+    deterministically (sorted by name, first ceil(fraction * count)), so two
+    runs of the same schedule reclaim the same nodes in the same order.
+    ``log`` records every fired event like FaultPlan's."""
+
+    def __init__(
+        self,
+        waves: Sequence[ReclaimWave] = (),
+        spikes: Sequence[PriceSpike] = (),
+    ):
+        self.waves = list(waves)
+        self.spikes = list(spikes)
+        self.log: List[Tuple[int, object]] = []
+
+    def waves_for(self, round_no: int) -> List[ReclaimWave]:
+        out = [w for w in self.waves if w.round_no == round_no]
+        self.log.extend((round_no, w) for w in out)
+        return out
+
+    def spikes_for(self, round_no: int) -> List[PriceSpike]:
+        out = [s for s in self.spikes if s.round_no == round_no]
+        self.log.extend((round_no, s) for s in out)
+        return out
+
+    @staticmethod
+    def victims(wave: ReclaimWave, pool_nodes: Sequence[Tuple[Tuple[str, str, str], str]]) -> List[str]:
+        """The wave's victim node names from ``(pool, node_name)`` pairs:
+        matching pools, name-sorted, first ceil(fraction * matching)."""
+        import math
+
+        names = sorted(name for pool, name in pool_nodes if wave.selects(pool))
+        if not names:
+            return []
+        return names[: max(1, math.ceil(wave.fraction * len(names)))]
+
+    def last_round(self) -> int:
+        rounds = [w.round_no for w in self.waves] + [s.round_no for s in self.spikes]
+        return max(rounds) if rounds else -1
+
+
 class ScriptedTransport:
     """A fake HTTP transport for the client retry tests: wraps a real
     transport callable and applies a FaultPlan in front of it, raising the

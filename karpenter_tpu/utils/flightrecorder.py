@@ -241,6 +241,13 @@ class CapsuleBuilder:
         if result.unschedulable:
             self.note_anomaly(TRIGGER_UNSCHEDULABLE)
 
+    def set_outputs_rebalance(self, actions: List[Dict]) -> None:
+        """Rebalance-round outputs: the ordered action list (replacement
+        launches, gated drains, deadline fallbacks) with pool + replacement
+        offering identity — node names replay identically because the
+        machine-name sequence is pinned like provisioning's."""
+        self._outputs["rebalance_actions"] = list(actions)
+
     def set_outputs_action(self, executed, planned=None) -> None:
         """Deprovisioning outputs: the action executed this pass and/or the
         plan parked for the validation TTL (offering triples for

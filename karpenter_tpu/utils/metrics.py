@@ -365,6 +365,31 @@ INTERRUPTION_MESSAGES = Counter(
     help="Interruption queue messages processed, labeled by message kind.",
     registry=REGISTRY,
 )
+RISK_OBSERVATIONS = Counter(
+    "karpenter_tpu_risk_observations_total",
+    help="Realized capacity-pool risk events fed into the interruption-risk "
+         "cache, labeled by kind (interruption: a reclaim landed; rebalance: "
+         "the cloud recommended moving off the pool).",
+    registry=REGISTRY,
+)
+REBALANCE_ACTIONS = Counter(
+    "karpenter_tpu_rebalance_actions_total",
+    help="Proactive rebalance-controller actions, labeled by action: "
+         "replacement-launched (capacity opened before draining), "
+         "drained-after-replacement (replacement Ready, original drained), "
+         "deadline-drain (notice window expired before the replacement was "
+         "Ready; plain cordon-and-drain), immediate-drain (no replacement "
+         "pool available).",
+    registry=REGISTRY,
+)
+SPOT_DIVERSIFICATION = Counter(
+    "karpenter_tpu_spot_diversification_total",
+    help="Spot-pool diversification gate verdicts per unit per round, "
+         "labeled by outcome: respread (over-cap members stripped and "
+         "re-solved with the pool masked) or accepted (cap exceeded but "
+         "enforcement yielded — placement outranks spread).",
+    registry=REGISTRY,
+)
 CLOUDPROVIDER_DURATION = Histogram(
     "karpenter_tpu_cloudprovider_duration_seconds",
     help="Cloud provider API call latency, labeled by method.",

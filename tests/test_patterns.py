@@ -302,7 +302,11 @@ class TestSimilarWarmStart:
                 out.append(make_pod(name="extra", cpu="100m", memory="128Mi"))
             return out
 
-        solver = TPUSolver(portfolio=4)
+        # same generous sub-quality budget as test_transfers_to_similar_batch
+        # (and for the same reason): the 5001-pod encode eats most of the
+        # default 100ms budget, making the transfer-path assertion a
+        # scheduler-noise coin flip — this test pins behavior, not latency
+        solver = TPUSolver(portfolio=4, latency_budget_s=0.8)
         learned = self._learn(solver, split_batch(), provs)
         assert learned.G >= 2  # labels split the same shape into two groups
         res = solver.solve_pods(split_batch(extra=1), provs)

@@ -260,17 +260,21 @@ class TestHTTPSpecifics:
         seeder.delete(m)
 
     def test_eventual_consistency_window(self, catalog):
-        svc = CloudHTTPService(catalog, consistency_lag_s=0.2).start()
+        # lag sized generously: the delete->list "still visible" assertion
+        # must land inside the window even if the interpreter stalls for a
+        # few hundred ms under full-suite load — this pins the consistency
+        # semantics, not the latency
+        svc = CloudHTTPService(catalog, consistency_lag_s=1.0).start()
         try:
             p = HTTPCloudProvider(svc.endpoint)
             m = p.create(_machine())
             with pytest.raises(MachineNotFoundError):
                 p.get(m.status.provider_id)  # lag: not yet visible
-            time.sleep(0.3)
+            time.sleep(1.3)
             assert p.get(m.status.provider_id).status.provider_id == m.status.provider_id
             p.delete(m)
             assert p.list()  # still visible within the lag
-            time.sleep(0.3)
+            time.sleep(1.3)
             assert p.list() == []
         finally:
             svc.stop()

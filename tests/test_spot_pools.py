@@ -1,0 +1,935 @@
+"""ISSUE 7: risk-aware spot capacity pools.
+
+Covers the risk cache (decayed evidence -> probability estimates), the
+risk-priced solver objective, the spot-pool diversification gate, the
+interruption->provisioning fast path (rounds-to-replacement == 1), the
+10k-message interruption-storm property test, proactive rebalance
+(replacement-before-drain) with byte-identical offline replay, the
+``--override risk.<it>/<zone>/<ct>=p`` counterfactual, and the delta==full
+digest contract under risk-priced offerings + diversification annotations.
+"""
+
+import dataclasses
+import json
+import math
+import random
+
+import pytest
+
+from karpenter_tpu.api import (
+    ObjectMeta,
+    Pod,
+    Provisioner,
+    Requirement,
+    Resources,
+)
+from karpenter_tpu.api import labels as wk
+from karpenter_tpu.api.settings import Settings
+from karpenter_tpu.cloudprovider import FakeCloudProvider, generate_catalog
+from karpenter_tpu.cloudprovider.pricing import CapacityPoolProvider
+from karpenter_tpu.cloudprovider.types import (
+    instance_type_from_wire,
+    instance_type_to_wire,
+    offering_to_wire,
+)
+from karpenter_tpu.controllers import (
+    FakeQueue,
+    InterruptionController,
+    ProvisioningController,
+    TerminationController,
+)
+from karpenter_tpu.solver import EncodeSession, encode
+from karpenter_tpu.solver.solver import GreedySolver, problem_digest
+from karpenter_tpu.state import Cluster
+from karpenter_tpu.utils import metrics
+from karpenter_tpu.utils.cache import FakeClock
+from karpenter_tpu.utils.decisions import DECISIONS
+from karpenter_tpu.utils.faults import InterruptionSchedule, PriceSpike, ReclaimWave
+from karpenter_tpu.utils.flightrecorder import FLIGHT
+from karpenter_tpu.utils.riskcache import (
+    P_MAX,
+    SPOT_PRIOR,
+    InterruptionRiskCache,
+)
+
+from helpers import make_pod, make_pods, make_provisioner
+
+from karpenter_tpu.replay import OverrideError, apply_overrides, replay_capsule
+
+
+@pytest.fixture(autouse=True)
+def _fresh_rings():
+    DECISIONS.configure(2048)
+    DECISIONS.clear()
+    FLIGHT.configure(32)
+    FLIGHT.clear()
+    yield
+    FLIGHT.configure(32)
+    FLIGHT.clear()
+    DECISIONS.clear()
+
+
+def _roundtrip(capsule):
+    return json.loads(json.dumps(capsule, default=str))
+
+
+def spot_settings(**kw):
+    kw.setdefault("batch_idle_duration", 0)
+    kw.setdefault("batch_max_duration", 0)
+    kw.setdefault("spot_enabled", True)
+    # the generated catalog's spot/on-demand price gaps are pennies, so the
+    # production default penalty (10.0) prices EVERY spot pool out at the
+    # 0.05 prior — tests that exercise risk pricing pick a penalty sized to
+    # the catalog; everything else runs risk-managed but price-neutral
+    kw.setdefault("interruption_penalty_cost", 0.0)
+    return Settings(**kw)
+
+
+def spot_env(n_pods=6, n_types=20, settings=None, provisioner=None, risk=None):
+    """A fully wired spot-management environment: provisioning + termination
+    + interruption/rebalance controller sharing one risk cache and clock."""
+    settings = settings or spot_settings()
+    cluster = Cluster()
+    provider = FakeCloudProvider(catalog=generate_catalog(n_types=n_types))
+    clock = FakeClock(1000.0)
+    risk = risk or InterruptionRiskCache(
+        halflife_s=settings.risk_decay_halflife_s, clock=clock
+    )
+    provider.attach_risk_cache(risk)
+    ctl = ProvisioningController(
+        cluster, provider, solver=GreedySolver(), settings=settings
+    )
+    term = TerminationController(cluster, provider, clock=clock)
+    queue = FakeQueue()
+    intr = InterruptionController(
+        cluster, queue, term,
+        unavailable_offerings=provider.unavailable_offerings,
+        risk_cache=risk, provisioning=ctl, provider=provider,
+        settings=settings, clock=clock,
+    )
+    cluster.add_provisioner(provisioner or make_provisioner())
+    for p in make_pods(n_pods, prefix="sp", cpu="500m", memory="512Mi"):
+        cluster.add_pod(p)
+    return cluster, provider, ctl, term, queue, intr, risk, clock
+
+
+def spot_warning(instance_id):
+    return {
+        "version": "0", "source": "cloud.compute",
+        "detail-type": "Spot Instance Interruption Warning",
+        "detail": {"instance-id": instance_id},
+    }
+
+
+def rebalance_rec(instance_id):
+    return {
+        "version": "0", "source": "cloud.compute",
+        "detail-type": "Instance Rebalance Recommendation",
+        "detail": {"instance-id": instance_id},
+    }
+
+
+def node_pool(node):
+    return (
+        node.meta.labels.get(wk.INSTANCE_TYPE, ""),
+        node.meta.labels.get(wk.ZONE, ""),
+        node.meta.labels.get(wk.CAPACITY_TYPE, ""),
+    )
+
+
+def pod_pools(cluster):
+    """pod name -> capacity pool of its node, bound pods only."""
+    out = {}
+    for p in cluster.pods.values():
+        if p.node_name is not None:
+            node = cluster.nodes.get(p.node_name)
+            if node is not None:
+                out[p.name] = node_pool(node)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# risk cache
+# ---------------------------------------------------------------------------
+
+
+class TestRiskCache:
+    def test_zero_evidence_yields_prior(self):
+        risk = InterruptionRiskCache()
+        assert risk.probability("t", "z", wk.CAPACITY_TYPE_SPOT) == SPOT_PRIOR
+        assert risk.probability("t", "z", wk.CAPACITY_TYPE_ON_DEMAND) == 0.0
+
+    def test_evidence_raises_then_decays_back(self):
+        clock = FakeClock(0.0)
+        risk = InterruptionRiskCache(halflife_s=100.0, clock=clock)
+        for _ in range(3):
+            risk.record_interruption("t", "z", "spot")
+        hot = risk.probability("t", "z", "spot")
+        assert hot > SPOT_PRIOR
+        clock.step(1000.0)  # ten halflives: evidence ~ gone
+        cooled = risk.probability("t", "z", "spot")
+        assert SPOT_PRIOR <= cooled < hot
+        assert cooled == pytest.approx(SPOT_PRIOR, abs=0.01)
+
+    def test_rebalance_weighs_less_than_interruption(self):
+        clock = FakeClock(0.0)
+        a = InterruptionRiskCache(clock=clock)
+        b = InterruptionRiskCache(clock=clock)
+        a.record_interruption("t", "z", "spot")
+        b.record_rebalance("t", "z", "spot")
+        assert a.probability("t", "z", "spot") > b.probability("t", "z", "spot")
+        assert b.probability("t", "z", "spot") > SPOT_PRIOR
+
+    def test_saturates_below_pmax(self):
+        risk = InterruptionRiskCache()
+        for _ in range(500):
+            risk.record_interruption("t", "z", "spot")
+        assert SPOT_PRIOR < risk.probability("t", "z", "spot") <= P_MAX
+
+    def test_pin_overrides_evidence_and_prior(self):
+        risk = InterruptionRiskCache()
+        risk.record_interruption("t", "z", "spot")
+        risk.pin_probability("t", "z", "spot", 0.42)
+        assert risk.probability("t", "z", "spot") == 0.42
+        # pools are independent: the pin does not leak
+        assert risk.probability("t2", "z", "spot") == SPOT_PRIOR
+
+    def test_observation_counter_and_version(self):
+        risk = InterruptionRiskCache()
+        v0 = risk.version
+        risk.record_interruption("t", "z", "spot")
+        risk.record_rebalance("t", "z", "spot")
+        assert risk.observations("t", "z", "spot") == 2
+        assert risk.observations("other", "z", "spot") == 0
+        assert risk.version > v0
+
+    def test_pool_provider_version_covers_both_inputs(self):
+        provider = FakeCloudProvider(catalog=generate_catalog(n_types=4))
+        risk = InterruptionRiskCache()
+        pools = CapacityPoolProvider(provider.pricing, risk)
+        v0 = pools.version
+        risk.record_interruption("t", "z", "spot")
+        assert pools.version > v0
+        v1 = pools.version
+        provider.pricing.set_spot_price(provider.catalog[0].name, "zone-a", 0.001)
+        assert pools.version > v1
+        q = pools.quote(provider.catalog[0].name, "zone-a", "spot")
+        assert q.interruption_probability == SPOT_PRIOR
+        assert q.risk_cost(10.0) == pytest.approx(SPOT_PRIOR * 10.0)
+
+
+# ---------------------------------------------------------------------------
+# risk-priced solving
+# ---------------------------------------------------------------------------
+
+
+class TestRiskPricedSolving:
+    def _one_type_env(self, spot_enabled, risk_pin=None):
+        """Provisioner pinned to one instance type so the option surface is
+        exactly its offerings; optionally pin one pool's risk estimate."""
+        cluster = Cluster()
+        provider = FakeCloudProvider(catalog=generate_catalog(n_types=6))
+        it = provider.catalog[0]
+        risk = InterruptionRiskCache()
+        provider.attach_risk_cache(risk)
+        if risk_pin is not None:
+            pool, p = risk_pin
+            risk.pin_probability(*pool, p)
+        settings = spot_settings(spot_enabled=spot_enabled,
+                                 interruption_penalty_cost=10.0,
+                                 spot_diversification_max_frac=1.0)
+        ctl = ProvisioningController(
+            cluster, provider, solver=GreedySolver(), settings=settings
+        )
+        prov = make_provisioner(
+            requirements=[Requirement.in_values(wk.INSTANCE_TYPE, [it.name])]
+        )
+        cluster.add_provisioner(prov)
+        cluster.add_pod(make_pod(name="rp-0", cpu="500m", memory="512Mi"))
+        return cluster, provider, ctl, it
+
+    def _cheapest_spot_pool(self, provider, it):
+        o = min(
+            (o for o in it.offerings if o.capacity_type == wk.CAPACITY_TYPE_SPOT),
+            key=lambda o: o.price,
+        )
+        return (it.name, o.zone, o.capacity_type)
+
+    def test_risky_cheap_pool_loses_to_stable(self):
+        # risk-neutral control: the cheapest spot pool wins
+        cluster, provider, ctl, it = self._one_type_env(spot_enabled=False)
+        cheapest = self._cheapest_spot_pool(provider, it)
+        ctl.reconcile()
+        assert pod_pools(cluster)["rp-0"] == cheapest
+        # risk-priced: the same pool pinned risky (p * penalty dwarfs the
+        # price gap) must lose to the next-best risk-adjusted offering
+        cluster, provider, ctl, it = self._one_type_env(
+            spot_enabled=True, risk_pin=(cheapest, 0.8)
+        )
+        result = ctl.reconcile()
+        assert not cluster.pending_pods()
+        chosen = pod_pools(cluster)["rp-0"]
+        assert chosen != cheapest
+        # the result's price stays the REAL price, not the risk-adjusted one
+        spec = result.solve.new_nodes[0]
+        assert spec.option.price == provider.pricing.price(
+            spec.option.instance_type.name, spec.option.zone,
+            spec.option.capacity_type,
+        )
+        assert spec.option.effective_price >= spec.option.price
+
+    def test_risk_neutral_options_and_digest_unchanged(self):
+        """spot_enabled=False is byte-identical to the pre-risk world even
+        with a risk cache attached: penalty 0 zeroes every risk_cost and the
+        probability column never reaches the solve arrays."""
+        pods = make_pods(4, prefix="rn", cpu="250m", memory="512Mi")
+        prov = make_provisioner()
+        cat = generate_catalog(n_types=6)
+        base = problem_digest(encode(pods, [(prov, cat)]))
+        risky = [
+            it.with_offerings([
+                dataclasses.replace(o, interruption_probability=0.3)
+                for o in it.offerings
+            ])
+            for it in cat
+        ]
+        # probabilities present but penalty 0: same digest
+        assert problem_digest(encode(pods, [(prov, risky)])) == base
+        # penalty on: the objective actually moves
+        assert problem_digest(
+            encode(pods, [(prov, risky)], risk_penalty=10.0)
+        ) != base
+
+    def test_offering_wire_sparse_and_lossless(self):
+        o = generate_catalog(n_types=1)[0].offerings[0]
+        assert "interruptionProbability" not in offering_to_wire(o)
+        risky = dataclasses.replace(o, interruption_probability=0.25)
+        wire = offering_to_wire(risky)
+        assert wire["interruptionProbability"] == 0.25
+        it = generate_catalog(n_types=1)[0]
+        it = it.with_offerings([
+            dataclasses.replace(x, interruption_probability=0.125)
+            for x in it.offerings
+        ])
+        rebuilt = instance_type_from_wire(
+            json.loads(json.dumps(instance_type_to_wire(it)))
+        )
+        assert [x.interruption_probability for x in rebuilt.offerings] == [
+            0.125 for _ in it.offerings
+        ]
+
+
+# ---------------------------------------------------------------------------
+# diversification gate
+# ---------------------------------------------------------------------------
+
+
+class TestDiversification:
+    def _pinned_provisioner(self, provider, zones=None, spot_only=False):
+        it = provider.catalog[0]
+        reqs = [Requirement.in_values(wk.INSTANCE_TYPE, [it.name])]
+        if zones:
+            reqs.append(Requirement.in_values(wk.ZONE, zones))
+        if spot_only:
+            reqs.append(
+                Requirement.in_values(wk.CAPACITY_TYPE, [wk.CAPACITY_TYPE_SPOT])
+            )
+        return make_provisioner(requirements=reqs)
+
+    def test_group_respreads_across_pools(self):
+        settings = spot_settings(spot_diversification_max_frac=0.5)
+        cluster = Cluster()
+        provider = FakeCloudProvider(catalog=generate_catalog(n_types=6))
+        provider.attach_risk_cache(InterruptionRiskCache())
+        ctl = ProvisioningController(
+            cluster, provider, solver=GreedySolver(), settings=settings
+        )
+        cluster.add_provisioner(self._pinned_provisioner(provider))
+        for p in make_pods(8, prefix="dv", cpu="500m", memory="512Mi"):
+            cluster.add_pod(p)
+        ctl.reconcile()
+        assert not cluster.pending_pods()
+        pools = pod_pools(cluster)
+        cap = math.ceil(0.5 * 8)
+        by_pool = {}
+        for name, pool in pools.items():
+            if pool[2] == wk.CAPACITY_TYPE_SPOT:
+                by_pool.setdefault(pool, []).append(name)
+        accepted = [
+            r for r in DECISIONS.query(kind="diversification", limit=100)
+            if r.outcome == "accepted"
+        ]
+        if not accepted:  # enforcement held: the cap is a hard invariant
+            assert all(len(v) <= cap for v in by_pool.values()), by_pool
+        # the gate actually engaged (the pinned type makes one pool cheapest)
+        assert DECISIONS.query(kind="diversification", limit=100)
+
+    def test_annotation_none_opts_out(self):
+        settings = spot_settings(spot_diversification_max_frac=0.5)
+        cluster = Cluster()
+        provider = FakeCloudProvider(catalog=generate_catalog(n_types=6))
+        provider.attach_risk_cache(InterruptionRiskCache())
+        ctl = ProvisioningController(
+            cluster, provider, solver=GreedySolver(), settings=settings
+        )
+        cluster.add_provisioner(self._pinned_provisioner(provider))
+        for p in make_pods(8, prefix="oo", cpu="500m", memory="512Mi"):
+            p.meta.annotations[wk.SPOT_DIVERSIFICATION] = "none"
+            cluster.add_pod(p)
+        ctl.reconcile()
+        assert not cluster.pending_pods()
+        # opted out: no gate verdicts, and the group concentrates freely in
+        # the single cheapest pool (this is the control proving the respread
+        # test isn't vacuous)
+        assert not DECISIONS.query(kind="diversification", limit=100)
+        spot_counts = {}
+        for pool in pod_pools(cluster).values():
+            if pool[2] == wk.CAPACITY_TYPE_SPOT:
+                spot_counts[pool] = spot_counts.get(pool, 0) + 1
+        assert spot_counts and max(spot_counts.values()) == 8
+
+    def test_placement_outranks_spread_single_pool(self):
+        """Only ONE spot pool exists: masking it would strand pods, so the
+        gate yields (accepted verdict) and everything still binds."""
+        settings = spot_settings(spot_diversification_max_frac=0.5)
+        cluster = Cluster()
+        provider = FakeCloudProvider(catalog=generate_catalog(n_types=6))
+        provider.attach_risk_cache(InterruptionRiskCache())
+        ctl = ProvisioningController(
+            cluster, provider, solver=GreedySolver(), settings=settings
+        )
+        cluster.add_provisioner(
+            self._pinned_provisioner(provider, zones=["zone-a"], spot_only=True)
+        )
+        for p in make_pods(8, prefix="fb", cpu="500m", memory="512Mi"):
+            cluster.add_pod(p)
+        result = ctl.reconcile()
+        assert not cluster.pending_pods()
+        assert not result.unschedulable
+        verdicts = DECISIONS.query(kind="diversification", limit=100)
+        assert any(r.outcome == "accepted" for r in verdicts)
+
+    def test_gang_respreads_whole_or_yields(self):
+        """All-or-nothing survives the diversification gate: the gang either
+        binds whole under the cap or binds whole with an accepted verdict —
+        never partially."""
+        settings = spot_settings(spot_diversification_max_frac=0.34)
+        cluster = Cluster()
+        provider = FakeCloudProvider(catalog=generate_catalog(n_types=6))
+        provider.attach_risk_cache(InterruptionRiskCache())
+        ctl = ProvisioningController(
+            cluster, provider, solver=GreedySolver(), settings=settings
+        )
+        cluster.add_provisioner(self._pinned_provisioner(provider))
+        for p in make_pods(6, prefix="gd", cpu="500m", memory="512Mi"):
+            p.meta.annotations[wk.POD_GROUP] = "trainer"
+            p.meta.annotations[wk.POD_GROUP_MIN_MEMBERS] = "6"
+            cluster.add_pod(p)
+        ctl.reconcile()
+        bound = [n for n in pod_pools(cluster) if n.startswith("gd-")]
+        assert len(bound) in (0, 6)  # never partial
+        assert len(bound) == 6  # and on this catalog, it binds
+        accepted = any(
+            r.outcome == "accepted"
+            for r in DECISIONS.query(kind="diversification", limit=100)
+        )
+        if not accepted:
+            counts = {}
+            for name, pool in pod_pools(cluster).items():
+                if name.startswith("gd-") and pool[2] == wk.CAPACITY_TYPE_SPOT:
+                    counts[pool] = counts.get(pool, 0) + 1
+            cap = math.ceil(0.34 * 6)
+            assert all(v <= cap for v in counts.values()), counts
+
+
+# ---------------------------------------------------------------------------
+# interruption -> provisioning fast path (satellite: rounds-to-replacement)
+# ---------------------------------------------------------------------------
+
+
+class TestInterruptionFastPath:
+    def test_rounds_to_replacement_is_one(self):
+        """The synchronous dirty path: with WATCH DELIVERY to the
+        provisioning controller severed (simulating informer latency), a
+        spot interruption still arms the batch window and dirties the
+        drained pods into the delta encoder — ONE reconcile replaces every
+        victim, on the delta path, with no pod-set desync."""
+        cluster, provider, ctl, term, queue, intr, risk, clock = spot_env(n_pods=6)
+        ctl.reconcile()
+        assert not cluster.pending_pods()
+        ctl.reconcile()  # settle the session so the next round can be delta
+        # sever the watch: note_interrupted is now the ONLY channel
+        cluster._watchers.remove(ctl._on_event)
+        node = next(iter(cluster.nodes.values()))
+        victims = [p.name for p in cluster.pods_on_node(node.name)]
+        assert victims
+        queue.send(spot_warning(node.provider_id.rsplit("/", 1)[-1]))
+        intr.reconcile()
+        assert node.name not in cluster.nodes
+        # the fast path armed the window and seeded the pending set
+        assert set(victims) <= ctl._pending_seen
+        assert ctl.batcher.ready()
+        # rounds-to-replacement == 1: a single reconcile rebinds every victim
+        ctl.reconcile()
+        assert not cluster.pending_pods()
+        assert all(cluster.pods[v].node_name is not None for v in victims)
+        # and it was a DELTA round: the dirty set matched the batch exactly
+        assert ctl.encode_session.last_mode == "delta", (
+            ctl.encode_session.last_full_reason
+        )
+
+    def test_reclaim_feeds_risk_cache_and_ice(self):
+        cluster, provider, ctl, term, queue, intr, risk, clock = spot_env(n_pods=4)
+        ctl.reconcile()
+        node = next(iter(cluster.nodes.values()))
+        pool = node_pool(node)
+        queue.send(spot_warning(node.provider_id.rsplit("/", 1)[-1]))
+        intr.reconcile()
+        assert risk.observations(pool[0], pool[1], wk.CAPACITY_TYPE_SPOT) == 1
+        assert risk.probability(
+            pool[0], pool[1], wk.CAPACITY_TYPE_SPOT
+        ) > SPOT_PRIOR
+        assert provider.unavailable_offerings.is_unavailable(
+            pool[0], pool[1], wk.CAPACITY_TYPE_SPOT
+        )
+
+
+# ---------------------------------------------------------------------------
+# interruption storms (satellite: 10k-message property test)
+# ---------------------------------------------------------------------------
+
+
+class TestInterruptionStorm:
+    @pytest.mark.parametrize("seed", range(3))
+    def test_mixed_storm_exactly_once_and_linear_drain(self, seed):
+        """A 10k-message storm of duplicated spot-interruptions, rebalance
+        hints, state-changes, unknown instances and unparseable garbage:
+        every reclaim lands in the risk cache exactly once per instance, no
+        pod is drained twice, and the queue drains in exactly
+        ceil(N / batch) receive rounds (no message is ever re-received)."""
+        rng = random.Random(seed)
+        # proactive rebalance OFF (provider=None): this is the pure storm
+        # path — rebalance messages are risk hints only
+        settings = spot_settings()
+        cluster = Cluster()
+        provider = FakeCloudProvider(catalog=generate_catalog(n_types=20))
+        clock = FakeClock(0.0)
+        risk = InterruptionRiskCache(clock=clock)
+        provider.attach_risk_cache(risk)
+        ctl = ProvisioningController(
+            cluster, provider, solver=GreedySolver(), settings=settings
+        )
+        term = TerminationController(cluster, provider, clock=clock)
+        queue = FakeQueue()
+        intr = InterruptionController(
+            cluster, queue, term,
+            unavailable_offerings=provider.unavailable_offerings,
+            risk_cache=risk, provisioning=ctl, provider=None,
+            settings=settings, clock=clock,
+        )
+        cluster.add_provisioner(make_provisioner())
+        for p in make_pods(12, prefix="storm", cpu="500m", memory="512Mi"):
+            cluster.add_pod(p)
+        ctl.reconcile()
+        assert not cluster.pending_pods()
+
+        nodes = sorted(cluster.nodes.values(), key=lambda n: n.name)
+        spot_nodes = [n for n in nodes if node_pool(n)[2] == wk.CAPACITY_TYPE_SPOT]
+        assert len(spot_nodes) >= 2
+        reclaim_targets = spot_nodes[: max(2, len(spot_nodes) // 2)]
+        rebalance_targets = spot_nodes[len(reclaim_targets):]
+        reclaim_pools = {node_pool(n) for n in reclaim_targets}
+        victims = {
+            p.name for n in reclaim_targets for p in cluster.pods_on_node(n.name)
+            if not p.is_daemonset
+        }
+        iid = lambda n: n.provider_id.rsplit("/", 1)[-1]
+
+        bodies = []
+        for n in reclaim_targets:  # heavy duplication: re-deliveries
+            bodies += [json.dumps(spot_warning(iid(n)))] * 400
+        rebalance_count = {}
+        for n in rebalance_targets:
+            k = rng.randrange(50, 150)
+            rebalance_count[node_pool(n)] = (
+                rebalance_count.get(node_pool(n), 0) + k
+            )
+            bodies += [json.dumps(rebalance_rec(iid(n)))] * k
+        while len(bodies) < 9_000:
+            roll = rng.random()
+            if roll < 0.4:
+                bodies.append("}}} not json")
+            elif roll < 0.7:
+                bodies.append(json.dumps(spot_warning(f"i-ghost{rng.randrange(50)}")))
+            else:
+                bodies.append(json.dumps({
+                    "version": "0", "source": "cloud.compute",
+                    "detail-type": "Instance State-change Notification",
+                    "detail": {"instance-id": f"i-ghost{rng.randrange(50)}",
+                               "state": "running"},
+                }))
+        bodies += ["{broken"] * (10_000 - len(bodies))
+        rng.shuffle(bodies)
+        for b in bodies:
+            queue.send_raw(b)
+
+        # double-drain detector: count each pod's bound->pending transitions
+        evictions = {}
+
+        def watcher(event, obj):
+            if event == "MODIFIED" and isinstance(obj, Pod) and obj.is_pending():
+                evictions[obj.name] = evictions.get(obj.name, 0) + 1
+
+        cluster.watch(watcher)
+        batch, rounds = 200, 0
+        while len(queue):
+            handled = intr.reconcile(max_messages=batch)
+            assert handled > 0
+            rounds += 1
+        assert rounds == math.ceil(10_000 / batch)  # linear drain, no re-receives
+
+        for n in reclaim_targets:
+            assert n.name not in cluster.nodes
+        for n in rebalance_targets:
+            assert n.name in cluster.nodes  # hints never drain
+        # exactly-once risk accounting per reclaimed instance
+        for pool in reclaim_pools:
+            expected = sum(
+                1 for n in reclaim_targets if node_pool(n) == pool
+            ) + rebalance_count.get(pool, 0)
+            assert risk.observations(*pool) == expected, pool
+        # rebalance hints record once per MESSAGE by design (repeat hints
+        # are repeat evidence), duplicates of a reclaim never re-count
+        for pool, k in rebalance_count.items():
+            if pool not in reclaim_pools:
+                assert risk.observations(*pool) == k
+        # no pod drained twice
+        assert set(evictions) == victims
+        assert all(c == 1 for c in evictions.values()), evictions
+        # and the cluster recovers
+        ctl.reconcile()
+        assert not cluster.pending_pods()
+
+
+# ---------------------------------------------------------------------------
+# proactive rebalance (replacement-before-drain) + offline replay
+# ---------------------------------------------------------------------------
+
+
+class TestProactiveRebalance:
+    def test_replacement_launched_before_drain_then_gated(self):
+        cluster, provider, ctl, term, queue, intr, risk, clock = spot_env(n_pods=4)
+        ctl.reconcile()
+        node = next(
+            n for n in cluster.nodes.values()
+            if node_pool(n)[2] == wk.CAPACITY_TYPE_SPOT
+        )
+        queue.send(rebalance_rec(node.provider_id.rsplit("/", 1)[-1]))
+        n_before = len(cluster.nodes)
+        intr.reconcile()
+        # replacement opened, original NOT yet drained
+        assert node.name in cluster.nodes
+        assert len(cluster.nodes) == n_before + 1
+        pending = intr._rebalances[node.name]
+        repl = cluster.nodes[pending.replacement]
+        assert node_pool(repl) != node_pool(node)  # different pool
+        # replacement is Ready: the next pass drains the original
+        intr.reconcile()
+        assert node.name not in cluster.nodes
+        assert pending.replacement in cluster.nodes
+        assert not intr._rebalances
+        outcomes = [r.outcome for r in DECISIONS.query(kind="rebalance", limit=10)]
+        assert "replacement-launched" in outcomes
+        assert "drained-after-replacement" in outcomes
+        # victims re-solve next provisioning round
+        ctl.reconcile()
+        assert not cluster.pending_pods()
+
+    def test_deadline_fallback_inside_notice_window(self):
+        cluster, provider, ctl, term, queue, intr, risk, clock = spot_env(n_pods=4)
+        ctl.reconcile()
+        node = next(
+            n for n in cluster.nodes.values()
+            if node_pool(n)[2] == wk.CAPACITY_TYPE_SPOT
+        )
+        queue.send(rebalance_rec(node.provider_id.rsplit("/", 1)[-1]))
+        intr.reconcile()
+        pending = intr._rebalances[node.name]
+        cluster.nodes[pending.replacement].ready = False  # stuck replacement
+        clock.step(121.0)  # past the 2-minute notice window
+        intr.reconcile()
+        assert node.name not in cluster.nodes  # plain cordon-and-drain ran
+        outcomes = [r.outcome for r in DECISIONS.query(kind="rebalance", limit=10)]
+        assert "deadline-drain" in outcomes
+
+    def test_reclaim_wins_race_with_pending_rebalance(self):
+        cluster, provider, ctl, term, queue, intr, risk, clock = spot_env(n_pods=4)
+        ctl.reconcile()
+        node = next(
+            n for n in cluster.nodes.values()
+            if node_pool(n)[2] == wk.CAPACITY_TYPE_SPOT
+        )
+        iid = node.provider_id.rsplit("/", 1)[-1]
+        queue.send(rebalance_rec(iid))
+        intr.reconcile()
+        assert node.name in intr._rebalances
+        queue.send(spot_warning(iid))  # the 2-minute warning lands anyway
+        intr.reconcile()
+        assert node.name not in cluster.nodes
+        assert node.name not in intr._rebalances
+
+    def test_rebalance_round_replays_byte_identical(self):
+        cluster, provider, ctl, term, queue, intr, risk, clock = spot_env(n_pods=4)
+        ctl.reconcile()
+        node = next(
+            n for n in cluster.nodes.values()
+            if node_pool(n)[2] == wk.CAPACITY_TYPE_SPOT
+        )
+        queue.send(rebalance_rec(node.provider_id.rsplit("/", 1)[-1]))
+        intr.reconcile()
+        capsule = _roundtrip(FLIGHT.latest("rebalance"))
+        actions = capsule["outputs"]["rebalance_actions"]
+        assert [a["action"] for a in actions] == ["replacement-launched"]
+        report = replay_capsule(capsule)
+        assert report["diffs"]["rebalance_actions_match"] is True, report["diffs"]
+        assert report["match"] is True
+        # the gated-drain pass is its own capsule and replays too
+        intr.reconcile()
+        capsule2 = _roundtrip(FLIGHT.latest("rebalance"))
+        actions2 = capsule2["outputs"]["rebalance_actions"]
+        assert [a["action"] for a in actions2] == ["drained-after-replacement"]
+        report2 = replay_capsule(capsule2)
+        assert report2["diffs"]["rebalance_actions_match"] is True, report2["diffs"]
+        assert report2["match"] is True
+
+    def test_rebalance_replay_risk_counterfactual(self):
+        """--override risk...: repinning every pool risky-but-equal leaves
+        the action sequence intact (counterfactual verdict, not divergence);
+        the override rewrites the capsule catalog's probabilities."""
+        cluster, provider, ctl, term, queue, intr, risk, clock = spot_env(n_pods=4)
+        ctl.reconcile()
+        node = next(
+            n for n in cluster.nodes.values()
+            if node_pool(n)[2] == wk.CAPACITY_TYPE_SPOT
+        )
+        queue.send(rebalance_rec(node.provider_id.rsplit("/", 1)[-1]))
+        intr.reconcile()
+        capsule = _roundtrip(FLIGHT.latest("rebalance"))
+        over = apply_overrides(
+            json.loads(json.dumps(capsule)), ["risk.*/*/spot=0.5"]
+        )
+        probs = {
+            o.get("interruptionProbability", 0.0)
+            for types in over["inputs"]["instance_types"].values()
+            for it in types
+            for o in it["offerings"]
+            if o["capacityType"] == wk.CAPACITY_TYPE_SPOT
+        }
+        assert probs == {0.5}
+        report = replay_capsule(capsule, overrides=["risk.*/*/spot=0.5"])
+        assert report["counterfactual"] is True
+        assert report["replayed"]["rebalance_actions"]
+
+
+# ---------------------------------------------------------------------------
+# replay --override risk on provisioning rounds
+# ---------------------------------------------------------------------------
+
+
+class TestRiskOverrideReplay:
+    def _spot_capsule(self):
+        """A genuinely risk-priced round: one pinned instance type and a
+        penalty sized so spot wins at the 0.05 prior (0.2 * 0.05 = 0.01 is
+        under the type's spot/on-demand gap) but loses at p=0.9."""
+        provider = FakeCloudProvider(catalog=generate_catalog(n_types=6))
+        prov = make_provisioner(
+            requirements=[
+                Requirement.in_values(wk.INSTANCE_TYPE, [provider.catalog[0].name])
+            ]
+        )
+        cluster = Cluster()
+        provider2 = provider  # keep the pinned catalog's provider
+        settings = spot_settings(interruption_penalty_cost=0.2)
+        risk = InterruptionRiskCache(halflife_s=settings.risk_decay_halflife_s)
+        provider2.attach_risk_cache(risk)
+        ctl = ProvisioningController(
+            cluster, provider2, solver=GreedySolver(), settings=settings
+        )
+        cluster.add_provisioner(prov)
+        for p in make_pods(4, prefix="sp", cpu="500m", memory="512Mi"):
+            cluster.add_pod(p)
+        ctl.reconcile()
+        assert not cluster.pending_pods()
+        capsule = _roundtrip(FLIGHT.latest("provisioning"))
+        return capsule, pod_pools(cluster)
+
+    def test_spot_round_replays_byte_identical(self):
+        """The risk-priced solve replays exactly: probabilities ride the
+        recorded catalog and spot_enabled settings re-prime the solver's
+        penalty through the digest tap."""
+        capsule, _ = self._spot_capsule()
+        assert capsule["inputs"]["settings"]["spot_enabled"] is True
+        report = replay_capsule(capsule, solver="greedy")
+        assert report["diffs"]["digests_match"] is True, report["diffs"]
+        assert report["match"] is True
+
+    def test_risk_override_diverts_spot_placement(self):
+        capsule, pools = self._spot_capsule()
+        spot_pods = [
+            name for name, pool in pools.items()
+            if pool[2] == wk.CAPACITY_TYPE_SPOT
+        ]
+        assert spot_pods  # generated spot prices make spot win somewhere
+        report = replay_capsule(
+            capsule, overrides=["risk.*/*/spot=0.9"], solver="greedy"
+        )
+        assert report["counterfactual"] is True
+        # p=0.9 * penalty 10 dwarfs every sub-$1 price: spot loses everywhere
+        for name in spot_pods:
+            placed = report["replayed"]["placements"].get(name)
+            assert placed is not None  # still schedules...
+            assert placed["capacity_type"] == wk.CAPACITY_TYPE_ON_DEMAND
+
+    def test_bad_risk_overrides_rejected(self):
+        capsule, _ = self._spot_capsule()
+        for bad in (
+            "risk.a/b=0.5",            # not <it>/<zone>/<ct>
+            "risk.*/*/spot=1.5",       # out of [0, 1]
+            "risk.*/*/spot=high",      # not a float
+            "risk.ghost/nowhere/spot=0.5",  # matches nothing
+        ):
+            with pytest.raises(OverrideError):
+                apply_overrides(json.loads(json.dumps(capsule)), [bad])
+
+
+# ---------------------------------------------------------------------------
+# delta == full under risk pricing + diversification annotations
+# ---------------------------------------------------------------------------
+
+
+class TestDeltaFullRiskEquivalence:
+    SHAPES = [("100m", "128Mi"), ("250m", "512Mi"), ("1", "2Gi")]
+
+    def _rand_pod(self, rng, serial):
+        cpu, mem = rng.choice(self.SHAPES)
+        p = make_pod(name=f"rk-{serial}", cpu=cpu, memory=mem)
+        roll = rng.random()
+        if roll < 0.25:
+            p.meta.annotations[wk.SPOT_DIVERSIFICATION] = rng.choice(
+                ["0.25", "0.5", "none"]
+            )
+        return p
+
+    @staticmethod
+    def _flip_risk(rng, types):
+        ti = rng.randrange(len(types))
+        it = types[ti]
+        oi = rng.randrange(len(it.offerings))
+        types[ti] = it.with_offerings([
+            dataclasses.replace(
+                o, interruption_probability=rng.choice([0.0, 0.05, 0.3, 0.8])
+            )
+            if k == oi else o
+            for k, o in enumerate(it.offerings)
+        ])
+
+    @pytest.mark.parametrize("seed", range(4))
+    def test_random_mutations_with_risk_axis(self, seed):
+        """The PR3 contract survives the risk axis: any sequence of pod
+        churn, probability flips and availability flips delta-encodes to the
+        digest a from-scratch risk-priced encode produces."""
+        rng = random.Random(seed)
+        types = list(generate_catalog(n_types=6))
+        # seed probabilities onto the catalog like the provider stamping does
+        for _ in range(6):
+            self._flip_risk(rng, types)
+        prov = Provisioner(meta=ObjectMeta(name="default"))
+        prov.meta.resource_version = 1
+        pods = [self._rand_pod(rng, i) for i in range(30)]
+        session = EncodeSession(full_resync_every=0)
+        session.encode(pods, [(prov, list(types))], risk_penalty=10.0)
+        serial = 30
+
+        for step in range(10):
+            op = rng.randrange(4)
+            if op == 0 and pods:
+                victim = pods.pop(rng.randrange(len(pods)))
+                session.pod_event("DELETED", victim)
+            elif op == 1:
+                for _ in range(rng.randrange(1, 3)):
+                    serial += 1
+                    p = self._rand_pod(rng, serial)
+                    pods.append(p)
+                    session.pod_event("ADDED", p)
+            elif op == 2:
+                self._flip_risk(rng, types)
+            else:
+                ti = rng.randrange(len(types))
+                it = types[ti]
+                oi = rng.randrange(len(it.offerings))
+                types[ti] = it.with_offerings([
+                    dataclasses.replace(o, available=not o.available)
+                    if k == oi else o
+                    for k, o in enumerate(it.offerings)
+                ])
+            delta = session.encode(
+                pods, [(prov, list(types))], risk_penalty=10.0
+            )
+            oracle = encode(
+                session.ordered_pods(), [(prov, list(types))], risk_penalty=10.0
+            )
+            assert problem_digest(delta) == problem_digest(oracle), (
+                f"seed={seed} step={step} op={op} mode={session.last_mode} "
+                f"reason={session.last_full_reason}"
+            )
+
+    def test_penalty_flip_mid_session_stays_equivalent(self):
+        types = list(generate_catalog(n_types=6))
+        rng = random.Random(0)
+        for _ in range(4):
+            self._flip_risk(rng, types)
+        prov = Provisioner(meta=ObjectMeta(name="default"))
+        pods = [self._rand_pod(rng, i) for i in range(20)]
+        session = EncodeSession(full_resync_every=0)
+        session.encode(pods, [(prov, list(types))], risk_penalty=0.0)
+        for penalty in (10.0, 0.0, 25.0):
+            delta = session.encode(
+                pods, [(prov, list(types))], risk_penalty=penalty
+            )
+            oracle = encode(
+                session.ordered_pods(), [(prov, list(types))],
+                risk_penalty=penalty,
+            )
+            assert problem_digest(delta) == problem_digest(oracle), penalty
+
+
+# ---------------------------------------------------------------------------
+# scripted interruption schedules (utils/faults)
+# ---------------------------------------------------------------------------
+
+
+class TestInterruptionSchedule:
+    def test_waves_spikes_and_deterministic_victims(self):
+        sched = InterruptionSchedule(
+            waves=[
+                ReclaimWave(round_no=1, pool=("t1", "*", "spot"), fraction=0.5),
+                ReclaimWave(round_no=2, pool=("*", "*", "spot")),
+            ],
+            spikes=[PriceSpike(round_no=1, instance_type="t1", zone="z", factor=2.0)],
+        )
+        assert sched.last_round() == 2
+        assert not sched.waves_for(0)
+        [w] = sched.waves_for(1)
+        [s] = sched.spikes_for(1)
+        assert s.factor == 2.0
+        nodes = [
+            (("t1", "za", "spot"), "n-3"),
+            (("t1", "zb", "spot"), "n-1"),
+            (("t2", "za", "spot"), "n-2"),
+            (("t1", "za", "on-demand"), "n-4"),
+        ]
+        # fraction 0.5 of the 2 matching (t1/*/spot) nodes, name-sorted
+        assert InterruptionSchedule.victims(w, nodes) == ["n-1"]
+        [w2] = sched.waves_for(2)
+        assert InterruptionSchedule.victims(w2, nodes) == ["n-1", "n-2", "n-3"]
+        assert len(sched.log) == 3  # every fired event recorded
