@@ -321,6 +321,240 @@ def bench_delta_reconcile(n_pods=50_000, churn=0.01, rounds=8, n_types=400):
     }
 
 
+def bench_cell_decompose(
+    n_pods=500_000, n_cells=20, rounds=6, n_types=60, churn_cells=1,
+    flat_compare=None, flat_ref_pods=None,
+):
+    """Sharded-control-plane scenario (ISSUE 8 acceptance): ``n_pods``
+    deployment-shaped pods partitioned into ``n_cells`` single-feasible
+    cells (disjoint provisioner label surfaces), steady-state churn
+    localized to ``churn_cells`` cells per round. The sharded round feeds
+    the churn through the CellRouter, touches ONLY the dirty cells (the
+    same clean-cell reuse the controller's sharded path takes — a cell
+    with no routed events provably re-encodes to its previous digest, so
+    its cached solve stands), delta-encodes those, and re-solves only the
+    ones whose digest moved. The flat reference (default: on
+    below 100k pods, off at the 500k synthetic where a flat solve per round
+    is the very cost being escaped) delta-encodes and solves the ONE
+    O(cluster) problem every round.
+
+    Equivalence is asserted every round at digest level: each cell's delta
+    encode == a from-scratch full encode of that cell's canonical pod
+    order; and, when the flat reference runs, decomposed total cost ==
+    flat cost under a deterministic solver on the final round.
+
+    ``flat_ref_pods`` (the ISSUE 8 acceptance comparison) additionally
+    times a SEPARATE flat single-session cluster of that size under the
+    same per-round churn — "the current 50k flat number" the sharded 500k
+    round p50 must stay within 2x of."""
+    import statistics as _st
+
+    from karpenter_tpu.api import ObjectMeta, Pod, Provisioner, Resources
+    from karpenter_tpu.cloudprovider import generate_catalog
+    from karpenter_tpu.solver import EncodeSession, TPUSolver, encode
+    from karpenter_tpu.solver.solver import GreedySolver, problem_digest
+    from karpenter_tpu.state.cells import CellRouter
+
+    if flat_compare is None:
+        flat_compare = n_pods < 100_000
+    catalog = generate_catalog(n_types=n_types)
+    provs = []
+    for c in range(n_cells):
+        p = Provisioner(
+            meta=ObjectMeta(name=f"cell-{c:02d}"),
+            labels={"bench.pool": f"p{c}"},
+        )
+        p.meta.resource_version = c + 1
+        provs.append(p)
+    entries = {p.name: (p, catalog) for p in provs}
+    cpus = ["100m", "250m", "500m", "1", "2", "4"]
+    mems = ["256Mi", "512Mi", "1Gi", "2Gi", "4Gi", "8Gi"]
+    n_deploys = 12  # per cell
+
+    def mkpod(cell, name, shape):
+        return Pod(
+            meta=ObjectMeta(name=name),
+            requests=Resources(cpu=cpus[shape % 6], memory=mems[(shape // 2) % 6]),
+            node_selector={"bench.pool": f"p{cell}"},
+        )
+
+    per_cell = n_pods // n_cells
+    per_dep = per_cell // n_deploys + 1
+    pods = {}
+    for c in range(n_cells):
+        n = 0
+        for d in range(n_deploys):
+            for i in range(per_dep):
+                if n >= per_cell:
+                    break
+                name = f"c{c}-d{d}-{i}"
+                pods[name] = mkpod(c, name, d)
+                n += 1
+
+    router = CellRouter()
+    for name in pods:
+        router.pod_event("ADDED", pods[name])
+    solver = TPUSolver(portfolio=8)
+    # seed: first (full) encode + solve of every cell, untimed warmup
+    plan = router.plan_round(list(pods.values()), provs)
+    for key, cell_pods in plan.cells:
+        problem = router.session(key).encode(cell_pods, [entries[key[0]]])
+        router.mark_clean(key)
+        solver.solve(problem)
+
+    flat_session = flat_problem = None
+    flat_prov_list = [entries[p.name] for p in provs]
+    if flat_compare:
+        flat_session = EncodeSession()
+        flat_solver = TPUSolver(portfolio=8)
+        flat_problem = flat_session.encode(list(pods.values()), flat_prov_list)
+        flat_solver.solve(flat_problem)
+
+    n_churn = max(per_cell // 100, 1)
+    serial = 0
+    sharded_times, flat_times, resolved_counts = [], [], []
+    digests_equal = True
+    for r in range(rounds):
+        churned = [(r * churn_cells + j) % n_cells for j in range(churn_cells)]
+        removed, added = [], []
+        for c in churned:
+            down, up = r % n_deploys, (r + 5) % n_deploys
+            victims = [n for n in pods if n.startswith(f"c{c}-d{down}-")][:n_churn]
+            for n in victims:
+                removed.append(pods.pop(n))
+            for i in range(n_churn):
+                name = f"c{c}-up{serial}-{i}"
+                pods[name] = mkpod(c, name, up)
+                added.append(pods[name])
+            serial += n_churn
+
+        t0 = time.perf_counter()
+        for p in removed:
+            router.pod_event("DELETED", p)
+        for p in added:
+            router.pod_event("ADDED", p)
+        plan = router.plan_round(pods.values(), provs)
+        touched = []
+        for key, cell_pods in plan.cells:
+            if key not in plan.dirty:
+                # clean cell: no routed events, so its problem provably
+                # re-encodes to its previous digest — the cached solve
+                # stands (the controller's clean-cell reuse, exactly)
+                continue
+            problem = router.session(key).encode(cell_pods, [entries[key[0]]])
+            router.mark_clean(key)
+            solver.solve(problem)
+            touched.append((key, problem))
+        sharded_times.append(time.perf_counter() - t0)
+        resolved_counts.append(len(touched))
+        # per-cell delta == full digest contract, every churned cell
+        for key, problem in touched:
+            session = router.session(key)
+            oracle = encode(session.ordered_pods(), [entries[key[0]]])
+            if problem_digest(problem) != problem_digest(oracle):
+                digests_equal = False
+
+        if flat_compare:
+            t0 = time.perf_counter()
+            for p in removed:
+                flat_session.pod_event("DELETED", p)
+            for p in added:
+                flat_session.pod_event("ADDED", p)
+            flat_problem = flat_session.encode(list(pods.values()), flat_prov_list)
+            flat_solver.solve(flat_problem)
+            flat_times.append(time.perf_counter() - t0)
+
+    out = {
+        "pods": n_pods,
+        "cells": n_cells,
+        "rounds": rounds,
+        "churn_per_round": 2 * n_churn * churn_cells,
+        "sharded_round_p50_ms": round(_st.median(sharded_times) * 1e3, 2),
+        "cells_resolved_p50": _st.median(resolved_counts),
+        "digests_equal": bool(digests_equal),
+    }
+    if flat_compare:
+        f = _st.median(flat_times)
+        out["flat_round_p50_ms"] = round(f * 1e3, 2)
+        out["speedup_vs_flat"] = (
+            round(f / _st.median(sharded_times), 1)
+            if _st.median(sharded_times) > 0 else 0.0
+        )
+        # answer-level equivalence under a DETERMINISTIC solver (the racing
+        # portfolio can legitimately pick different same-cost plans): the
+        # union of per-cell solves prices identically to the flat solve
+        greedy = GreedySolver()
+        cell_total = 0.0
+        for key, cell_pods in router.plan_round(list(pods.values()), provs).cells:
+            oracle = encode(
+                router.session(key).ordered_pods(), [entries[key[0]]]
+            )
+            cell_total += float(greedy.solve(oracle).cost)
+        flat_oracle = encode(flat_session.ordered_pods(), flat_prov_list)
+        flat_cost = float(greedy.solve(flat_oracle).cost)
+        out["cost_cells"] = round(cell_total, 3)
+        out["cost_flat"] = round(flat_cost, 3)
+        out["cost_equal"] = bool(abs(cell_total - flat_cost) < 1e-6)
+    if flat_ref_pods:
+        # acceptance reference: a flat single-session cluster at
+        # ``flat_ref_pods`` scale, same per-round churn volume, delta
+        # encode + solve timed per round
+        ref_pods = {}
+        for d in range(n_deploys):
+            for i in range(flat_ref_pods // n_deploys + 1):
+                if len(ref_pods) >= flat_ref_pods:
+                    break
+                name = f"ref-d{d}-{i}"
+                ref_pods[name] = Pod(
+                    meta=ObjectMeta(name=name),
+                    requests=Resources(
+                        cpu=cpus[d % 6], memory=mems[(d // 2) % 6]
+                    ),
+                )
+        ref_prov = Provisioner(meta=ObjectMeta(name="flat-ref"))
+        ref_prov.meta.resource_version = 1
+        ref_entry = [(ref_prov, catalog)]
+        ref_session = EncodeSession()
+        ref_solver = TPUSolver(portfolio=8)
+        ref_solver.solve(ref_session.encode(list(ref_pods.values()), ref_entry))
+        ref_times = []
+        ref_churn = 2 * n_churn * churn_cells  # same churn volume per round
+        ref_serial = 0
+        for r in range(rounds):
+            down, up = r % n_deploys, (r + 5) % n_deploys
+            victims = [
+                n for n in ref_pods if n.startswith(f"ref-d{down}-")
+            ][: ref_churn // 2]
+            removed = [ref_pods.pop(n) for n in victims]
+            added = []
+            for i in range(ref_churn // 2):
+                name = f"ref-up{ref_serial}-{i}"
+                ref_pods[name] = Pod(
+                    meta=ObjectMeta(name=name),
+                    requests=Resources(
+                        cpu=cpus[up % 6], memory=mems[(up // 2) % 6]
+                    ),
+                )
+                added.append(ref_pods[name])
+            ref_serial += ref_churn // 2
+            t0 = time.perf_counter()
+            for p in removed:
+                ref_session.pod_event("DELETED", p)
+            for p in added:
+                ref_session.pod_event("ADDED", p)
+            ref_solver.solve(
+                ref_session.encode(list(ref_pods.values()), ref_entry)
+            )
+            ref_times.append(time.perf_counter() - t0)
+        ref_p50 = _st.median(ref_times)
+        out["flat_ref_pods"] = flat_ref_pods
+        out["flat_ref_round_p50_ms"] = round(ref_p50 * 1e3, 2)
+        out["within_2x_flat_ref"] = bool(
+            _st.median(sharded_times) <= 2 * ref_p50
+        )
+    return out
+
+
 def _sweep_fixture(workers, n_candidates=160, pods_per_cand=40, fleet_nodes=200):
     """Consolidation-sweep fixture: (n_candidates-1) spot nodes whose pods
     deterministically force a replacement (their 1-vCPU pods fit nowhere in
@@ -1577,6 +1811,12 @@ def _run_details(dry_run: bool = False) -> dict:
             details["spot_churn"] = bench_spot_churn(n_pods=24, waves=2)
         except Exception as e:
             details["spot_churn"] = {"error": f"{type(e).__name__}: {e}"}
+        try:
+            details["cell_decompose"] = bench_cell_decompose(
+                n_pods=2_000, n_cells=4, rounds=3, n_types=12
+            )
+        except Exception as e:
+            details["cell_decompose"] = {"error": f"{type(e).__name__}: {e}"}
         return details
     for name, make in CONFIGS:
         try:
@@ -1596,6 +1836,10 @@ def _run_details(dry_run: bool = False) -> dict:
         ("flightrecorder_overhead", bench_flightrecorder_overhead),
         ("gang_preemption", bench_gang_preemption),
         ("spot_churn", bench_spot_churn),
+        # the 500k synthetic: sharded rounds only (a flat 500k solve per
+        # round is the O(cluster) cost the cells exist to escape), with a
+        # 50k flat reference cluster timed for the acceptance comparison
+        ("cell_decompose", lambda: bench_cell_decompose(flat_ref_pods=50_000)),
     ):
         try:
             details[key] = fn()
@@ -1662,6 +1906,7 @@ def main(argv=None):
     flightrec = details.get("flightrecorder_overhead", {})
     gangs = details.get("gang_preemption", {})
     spot = details.get("spot_churn", {})
+    cells = details.get("cell_decompose", {})
     summary = {
         "metric": line["metric"],
         "value": line["value"],
@@ -1688,6 +1933,13 @@ def main(argv=None):
         "spot_reclaims_survived": spot.get("reclaims_survived"),
         "spot_unschedulable_p100": spot.get("unschedulable_p100"),
         "spot_cost_vs_ondemand_frac": spot.get("cost_vs_ondemand_frac"),
+        # sharded control plane (ISSUE 8): steady-state sharded round p50 at
+        # the scenario's pod count, per-cell delta==full digest equivalence,
+        # and the acceptance comparison against the 50k flat solve number
+        "cell_pods": cells.get("pods"),
+        "cell_round_p50_ms": cells.get("sharded_round_p50_ms"),
+        "cell_digests_equal": cells.get("digests_equal"),
+        "cell_within_2x_flat50k": cells.get("within_2x_flat_ref"),
         "summary": True,
     }
     # the summary is the parse target: STRICT JSON, no NaN/Infinity tokens —

@@ -16,6 +16,12 @@ floor:
   >= 2 spot pools) must end every settle window with ZERO pending pods,
   every victim replaced within the 2-reconcile budget, and mean fleet cost
   <= COST_BAND x the on-demand-only lower bound.
+* ``cell_decompose`` (ISSUE 8): every cell's delta encode must stay
+  digest-identical to a from-scratch full encode of that cell's canonical
+  inputs, the union of per-cell solves must price identically to the flat
+  solve under a deterministic solver, and the sharded steady-state round
+  must stay >= MIN_CELL_SPEEDUP x faster than the flat round at the same
+  scale (churn is cell-local; the flat path re-solves O(cluster) anyway).
 
 Usage:  python hack/check_bench_regression.py [--full]
         (--full runs the acceptance-scale 50k/160 configuration)
@@ -38,6 +44,11 @@ MIN_DELTA_SPEEDUP = 3.0
 #: spot_churn: mean fleet cost must stay within this factor of the
 #: on-demand-only lower bound (the ISSUE-7 acceptance band)
 COST_BAND = 1.5
+#: cell_decompose: sharded steady-state round vs the flat round at the same
+#: scale (cell-local churn means the sharded path re-solves a couple of
+#: cells while flat re-solves the cluster; 2x is a deliberately loose floor
+#: so box noise can't flap the gate)
+MIN_CELL_SPEEDUP = 2.0
 
 
 def run_checks(full: bool = False) -> list:
@@ -49,13 +60,21 @@ def run_checks(full: bool = False) -> list:
         delta = bench.bench_delta_reconcile()
         sweep = bench.bench_sweep_parallel()
         churn = bench.bench_spot_churn()
+        # the 50k tier-adjacent run, flat reference included (the 500k
+        # synthetic lives in the main bench, where no flat solve rides along)
+        cells = bench.bench_cell_decompose(
+            n_pods=50_000, n_cells=10, rounds=5, flat_compare=True
+        )
     else:
         delta = bench.bench_delta_reconcile(n_pods=20_000, rounds=5, n_types=100)
         sweep = bench.bench_sweep_parallel(n_candidates=24)
         churn = bench.bench_spot_churn(n_pods=120, waves=3)
+        cells = bench.bench_cell_decompose(
+            n_pods=20_000, n_cells=8, rounds=5, n_types=30, flat_compare=True
+        )
     print(json.dumps({
         "delta_reconcile": delta, "consolidation_sweep": sweep,
-        "spot_churn": churn,
+        "spot_churn": churn, "cell_decompose": cells,
     }))
 
     if delta.get("encode_speedup", 0.0) < MIN_DELTA_SPEEDUP:
@@ -106,6 +125,22 @@ def run_checks(full: bool = False) -> list:
         failures.append(
             f"spot_churn mean cost {frac}x the on-demand-only lower bound "
             f"(band {COST_BAND}x)"
+        )
+    # -- cell_decompose gate (ISSUE 8) --------------------------------------
+    if not cells.get("digests_equal", False):
+        failures.append(
+            "cell_decompose: a cell's delta encode diverged from the "
+            "from-scratch full encode of its canonical inputs (digest)"
+        )
+    if not cells.get("cost_equal", False):
+        failures.append(
+            "cell_decompose: decomposed/flat answers diverged: "
+            f"{cells.get('cost_cells')} vs {cells.get('cost_flat')}"
+        )
+    if cells.get("speedup_vs_flat", 0.0) < MIN_CELL_SPEEDUP:
+        failures.append(
+            f"cell_decompose round speedup {cells.get('speedup_vs_flat')}x "
+            f"< floor {MIN_CELL_SPEEDUP}x"
         )
     return failures
 

@@ -100,6 +100,23 @@ class Settings:
     # halflife of realized-interruption evidence in the risk cache: a pool
     # that stops churning decays back toward its prior at this rate.
     risk_decay_halflife_s: float = 600.0
+    # cell-sharded control plane (state/cells.py + the provisioning sharded
+    # solve path): partition cluster state into cells by (provisioner,
+    # zone/topology domain), run per-cell delta encodes + solves
+    # concurrently, and place the cross-cell residue in a global
+    # arbitration pass. Off by default: flat-mode behavior (and its metric
+    # series) stays byte-identical.
+    cell_sharding_enabled: bool = False
+    # worker threads the per-cell solves fan out across (each cell gets its
+    # own solver clone + EncodeSession either way). 0 sizes from the host's
+    # CPU count; 1 forces serial cell solves (identical answers, the PR3
+    # serial-equality discipline).
+    cell_shard_workers: int = 0
+    # degenerate-partition guardrail: a round where any single cell holds
+    # more than this many pods falls back to the flat single-session solve
+    # (one giant cell pays sharding overhead for no decomposition win).
+    # 0 disables the guardrail.
+    cell_max_pods: int = 0
 
     def validate(self) -> None:
         if not self.cluster_name:
@@ -146,6 +163,14 @@ class Settings:
             )
         if self.risk_decay_halflife_s <= 0:
             raise ValueError("riskDecayHalflifeS must be > 0")
+        if self.cell_shard_workers < 0:
+            raise ValueError(
+                "cellShardWorkers must be >= 0 (0 = auto-size from CPU count)"
+            )
+        if self.cell_max_pods < 0:
+            raise ValueError(
+                "cellMaxPods must be >= 0 (0 disables the guardrail)"
+            )
 
     # -- config system (reference: karpenter-global-settings ConfigMap,
     # settings.go:40-93; env/flag ingestion in the operator bootstrap) -------

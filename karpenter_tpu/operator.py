@@ -93,11 +93,6 @@ class Operator:
             settings.flight_recorder_capacity,
             dump_dir=settings.flight_recorder_dump_dir or None,
         )
-        # runtime-health gauges: process RSS always; tracemalloc top
-        # allocators only when the (costly) profiling setting asks for it
-        from .utils import runtimehealth
-
-        runtimehealth.install(memory_profiling=settings.memory_profiling_enabled)
         # risk-aware spot capacity pools: the risk cache feeds offering
         # interruption probabilities (provider stamping), the solver's risk
         # penalty, and the rebalance controller's pool choices
@@ -113,6 +108,20 @@ class Operator:
         solver = solver or TPUSolver()
         provisioning = ProvisioningController(
             cluster, provider, solver=solver, settings=settings, recorder=recorder
+        )
+        # runtime-health gauges: process RSS always; tracemalloc top
+        # allocators only when the (costly) profiling setting asks for it.
+        # The {cell}-aware memory scrape installs ONLY under cell sharding —
+        # flat-mode metric series stay byte-identical (no dashboard breakage)
+        from .utils import runtimehealth
+
+        runtimehealth.install(
+            memory_profiling=settings.memory_profiling_enabled,
+            cell_bytes=(
+                provisioning.cell_memory_bytes
+                if settings.cell_sharding_enabled
+                else None
+            ),
         )
         termination = TerminationController(cluster, provider, recorder=recorder, clock=clock)
         deprovisioning = DeprovisioningController(
@@ -209,6 +218,9 @@ class Operator:
             # adopted server (the entrypoint starts it before the operator
             # exists): late-bind the events recorder so /debug/events works
             self.http_server.recorder = self.recorder
+        if self.http_server is not None and getattr(self.http_server, "cells", None) is None:
+            # late-bind the sharded-control-plane partition view the same way
+            self.http_server.cells = self.provisioning.cell_status
         try:
             self._run_loop(stop, tick)
         finally:

@@ -44,6 +44,28 @@ def default_workers(setting: int = 0, cap: int = 8) -> int:
     return max(1, min(cap, cpus))
 
 
+def map_all(
+    fn: Callable[[int, T], R],
+    items: Sequence[T],
+    workers: int,
+) -> List[R]:
+    """Evaluate ``fn(i, item)`` for EVERY item and return results in index
+    order — the fan-out primitive for the cell-sharded control plane's
+    per-cell solves (each item is one cell; the index selects a per-cell
+    resource such as a solver clone). Unlike ``first_hit`` there is no
+    early exit: every cell's solve must complete before the round merges.
+
+    ``workers <= 1`` is a plain serial loop (no pool, no threads) with
+    identical results — the serial-equality discipline the PR3 sweep set:
+    parallelism may only change wall-clock, never the answer."""
+    if workers <= 1 or len(items) <= 1:
+        return [fn(i, item) for i, item in enumerate(items)]
+    with ThreadPoolExecutor(max_workers=min(workers, len(items))) as pool:
+        return list(
+            pool.map(lambda t: fn(t[0], t[1]), list(enumerate(items)))
+        )
+
+
 def first_hit(
     fn: Callable[[int, T], Optional[R]],
     items: Sequence[T],

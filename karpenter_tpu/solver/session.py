@@ -279,6 +279,14 @@ class EncodeSession:
             )
             return problem
 
+    def flush_pending(self) -> None:
+        """Apply queued pod ops to the membership records without encoding —
+        the cell router calls this before reading ``ordered_pods`` of a
+        session whose cell had nothing to solve this round (its queued
+        deletes must still land, or the canonical order goes stale)."""
+        with self._lock, ENCODE_LOCK:
+            self._flush_ops()
+
     def ordered_pods(self) -> List[Pod]:
         """The session's canonical pod sequence (arrival order): a full
         ``encode()`` of exactly this list is the delta path's equivalence
@@ -291,6 +299,24 @@ class EncodeSession:
             ]
             out.sort(key=lambda t: t[0])
             return [p for _, p in out]
+
+    def approx_bytes(self) -> int:
+        """Approximate footprint of the session's cached encode state (the
+        numpy matrices dominate) — the per-cell memory signal the sharded
+        control plane exports through runtimehealth."""
+        with self._lock:
+            total = 0
+            for arr in (
+                self._alloc, self._price, self._opt_zone,
+                self._demand, self._compat, self._ex_compat,
+            ):
+                if arr is not None:
+                    total += arr.nbytes
+            for rec in self._nodes.values():
+                total += rec.rem_row.nbytes
+            # rough per-pod bookkeeping overhead (seq + member dict slots)
+            total += 96 * len(self._seq)
+            return total
 
     # -- internals ----------------------------------------------------------
     def _full_reason(self, weight_degate: frozenset) -> Optional[str]:

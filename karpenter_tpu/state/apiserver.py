@@ -37,6 +37,7 @@ from typing import Dict, List, Optional, Tuple
 from ..api.admission import AdmissionError, admit_node_template, admit_provisioner
 from ..api.codec import KIND_OF_TYPE, KINDS, to_wire
 from ..utils.tracing import TRACER
+from .cells import CellIndex
 from .cluster import Cluster
 
 _COLLECTIONS = {
@@ -92,7 +93,14 @@ class ClusterAPIServer:
         # permanently skip the late-delivered lower version. The seq is
         # assigned under the log lock at delivery, so bookmarks never skip;
         # clients judge OBJECT staleness by resourceVersion separately.
-        self._events: List[Tuple[int, int, str, str, Dict]] = []  # (seq, version, event, kind, wire)
+        # (seq, version, event, kind, wire, cells, cur) — ``cells`` is the
+        # tuple of cell streams the event must reach (() = every stream) and
+        # ``cur`` the object's cell AFTER the event, both computed at record
+        # time by the cell index so per-cell watches filter O(1); a stream
+        # other than ``cur`` receives the event as an eviction (DELETED)
+        self._events: List[
+            Tuple[int, int, str, str, Dict, Tuple[str, ...], str]
+        ] = []
         self._seq = 0
         self._log_floor = 0  # highest seq compacted away; continuity above it
         # a pre-populated backing has history the log never saw: watchers
@@ -116,6 +124,10 @@ class ClusterAPIServer:
         self._port = port
         self._server: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
+        # cell classifier + name index behind ?cell= list/watch filtering
+        # (state/cells.py): relist cost proportional to the cell, not the
+        # cluster — the apiserver-side half of the sharded control plane
+        self._cell_index = CellIndex(self.backing)
         self.backing.watch(self._record_event)
 
     # -- event log -----------------------------------------------------------
@@ -123,13 +135,19 @@ class ClusterAPIServer:
         kind = KIND_OF_TYPE.get(type(obj))
         if kind is None:
             return
+        # classified OUTSIDE the log lock (it may read the backing store):
+        # the cells an event reaches are its object's current cell plus the
+        # one it just left, so per-cell informer caches never go stale
+        cells, cur = self._cell_index.event_cells(
+            kind, obj, deleted=(event == "DELETED")
+        )
         with self._events_cv:
             self._seq += 1
             version = obj.meta.resource_version
             if version > self._kind_versions.get(kind, 0):
                 self._kind_versions[kind] = version
             self._events.append(
-                (self._seq, version, event, kind, to_wire(obj))
+                (self._seq, version, event, kind, to_wire(obj), cells, cur)
             )
             if len(self._events) > 100_000:
                 # compaction: a client whose bookmark predates the log start
@@ -138,7 +156,7 @@ class ClusterAPIServer:
                 self._log_floor = self._events[0][0] - 1
             self._events_cv.notify_all()
 
-    def _watch(self, since: int, timeout_s: float) -> Dict:
+    def _watch(self, since: int, timeout_s: float, cell: Optional[str] = None) -> Dict:
         deadline = time.monotonic() + timeout_s
         with self._events_cv:
             while True:
@@ -149,21 +167,60 @@ class ClusterAPIServer:
                     max(0, since - self._events[0][0] + 1) if self._events else 0
                 )
                 if start < len(self._events):
+                    tail = self._events[start:]
+                    if cell is not None:
+                        # per-cell stream: deliver the cell's events plus
+                        # every unclassified event (config kinds, daemonset
+                        # pods). ``bookmark`` advances past the filtered-out
+                        # tail so a quiet cell never rescans the whole log.
+                        tail = [e for e in tail if not e[5] or cell in e[5]]
+                        bookmark = self._events[-1][0]
+                        if not tail:
+                            left = deadline - time.monotonic()
+                            if left <= 0:
+                                return {"events": [], "bookmark": bookmark}
+                            since = bookmark
+                            self._events_cv.wait(timeout=min(left, 0.5))
+                            continue
+                    else:
+                        bookmark = tail[-1][0]
                     return {
+                        "bookmark": bookmark,
                         "events": [
                             {
                                 "seq": s,
                                 "resourceVersion": v,
-                                "event": ev,
+                                # a classified object whose CURRENT cell is
+                                # elsewhere has just left this stream's
+                                # cell: deliver the transition as an
+                                # eviction, or this cell's informer cache
+                                # holds the mover forever (its later events
+                                # are tagged with the new cell only)
+                                "event": (
+                                    "DELETED"
+                                    if cell is not None and cs
+                                    and cur and cur != cell
+                                    else ev
+                                ),
                                 "kind": k,
                                 "object": w,
                             }
-                            for (s, v, ev, k, w) in self._events[start:]
-                        ]
+                            for (s, v, ev, k, w, cs, cur) in tail
+                        ],
                     }
                 left = deadline - time.monotonic()
                 if left <= 0:
-                    return {"events": []}
+                    # the caller has seen (or filtered past) everything in
+                    # the log: hand back the tail seq so a quiet per-cell
+                    # stream's NEXT poll starts past it instead of
+                    # re-filtering the whole shared tail every round-trip
+                    return {
+                        "events": [],
+                        "bookmark": (
+                            self._events[-1][0]
+                            if self._events else self._log_floor
+                        ),
+                    }
                 self._events_cv.wait(timeout=min(left, 0.5))
 
     # -- request handling ----------------------------------------------------
@@ -180,7 +237,7 @@ class ClusterAPIServer:
             if parts == ["watch"]:
                 since = int(query.get("since", "0"))
                 timeout_s = min(float(query.get("timeout", "10")), 30.0)
-                return 200, self._watch(since, timeout_s)
+                return 200, self._watch(since, timeout_s, query.get("cell"))
             if parts == ["version"]:
                 with self.backing._lock:
                     version = self.backing._version
@@ -205,6 +262,17 @@ class ClusterAPIServer:
             coll = self._collection(kind)
             if len(parts) == 2:
                 if method == "GET":
+                    cell = query.get("cell")
+                    if cell is not None and kind in CellIndex.FILTERABLE:
+                        # indexed per-cell list: O(cell) names from the
+                        # maintained index, serialization only for matches
+                        names = sorted(self._cell_index.members(kind, cell))
+                        with self.backing._lock:
+                            items = [
+                                encode(coll[n]) for n in names if n in coll
+                            ]
+                            version = self.backing._version
+                        return 200, {"items": items, "resourceVersion": version}
                     with self.backing._lock:
                         items = [encode(o) for o in coll.values()]
                         version = self.backing._version

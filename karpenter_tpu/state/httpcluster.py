@@ -26,6 +26,7 @@ import json
 import logging
 import threading
 import urllib.error
+import urllib.parse
 import urllib.request
 from typing import Dict, Optional
 
@@ -40,6 +41,7 @@ from ..api.objects import (
     Provisioner,
 )
 from ..utils import tracing
+from .cells import CellIndex
 from ..utils.logging import context_fields, get_logger, kv
 from ..utils.resilience import (
     BreakerSet,
@@ -67,10 +69,18 @@ class HTTPCluster(Cluster):
         watch: bool = True,
         retry_policy: Optional[RetryPolicy] = None,
         breakers: Optional[BreakerSet] = None,
+        cell: Optional[str] = None,
     ):
         super().__init__()
         self.endpoint = endpoint.rstrip("/")
         self.timeout_s = timeout_s
+        # per-cell scope (sharded control plane, state/cells.py): when set,
+        # lists of the partitionable kinds hit the server's indexed
+        # ``?cell=`` endpoint and the watch long-poll subscribes to that
+        # cell's stream — relist and event cost become O(cell), not
+        # O(cluster). Config kinds (provisioners, nodetemplates, PDBs) and
+        # daemonset pods are delivered to every cell.
+        self.cell = cell
         # shared resilience layer (utils/resilience.py): every apiserver call
         # retries transient failures with jittered backoff under a
         # per-endpoint breaker; the watch thread reuses the same policy's
@@ -215,7 +225,10 @@ class HTTPCluster(Cluster):
                     server_v = kind_versions.get(kind, 0)
                     if self._kind_seen.get(kind) == server_v:
                         continue  # no writes since our last list of this kind
-                out = self._call("GET", f"/api/{kind}")
+                path = f"/api/{kind}"
+                if self.cell is not None and kind in CellIndex.FILTERABLE:
+                    path += f"?cell={urllib.parse.quote(self.cell)}"
+                out = self._call("GET", path)
                 decode = KINDS[kind][2]
                 relisted = True
                 with self._lock:
@@ -283,8 +296,13 @@ class HTTPCluster(Cluster):
         failures = 0
         while not self._stop.is_set():
             try:
+                cell_q = (
+                    f"&cell={urllib.parse.quote(self.cell)}"
+                    if self.cell is not None
+                    else ""
+                )
                 out = self._call(
-                    "GET", f"/watch?since={self._bookmark}&timeout=5"
+                    "GET", f"/watch?since={self._bookmark}&timeout=5{cell_q}"
                 )
                 if out.get("gone"):
                     self.relist()  # bookmark rejected: full resync
@@ -309,6 +327,12 @@ class HTTPCluster(Cluster):
                 )
                 with self._lock:
                     self._bookmark = max(self._bookmark, ev["seq"])
+            # the server's bookmark covers the filtered-out tail of a
+            # per-cell stream (and equals the last event seq otherwise):
+            # advancing to it keeps a quiet cell's poll from rescanning the
+            # whole shared event log every round-trip
+            with self._lock:
+                self._bookmark = max(self._bookmark, out.get("bookmark", 0))
 
     def close(self) -> None:
         self._stop.set()

@@ -222,6 +222,14 @@ class CapsuleBuilder:
         if trigger not in self._anomalies:
             self._anomalies.append(trigger)
 
+    def note_cells(self, round_cells: List[Dict]) -> None:
+        """The capsule's cell axis: one entry per sharded solve round with
+        the per-cell summaries (cell id/name, pod count, problem digest,
+        encode mode, cost). Captured from the round's already-merged state
+        under the controller's single solve epoch, so replaying the capsule
+        re-derives the same partition and the same per-cell digests."""
+        self._meta.setdefault("cells", []).append(list(round_cells))
+
     def note_encode_mode(self, mode: str, reason: str) -> None:
         """Record the session's encode mode for the round; a full-encode
         FALLBACK (any reason beyond first-encode/periodic/disabled) is an

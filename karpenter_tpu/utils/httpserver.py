@@ -26,6 +26,12 @@ Debug surface (the pprof-flag analogue, always on and cheap):
   JSON (``Content-Encoding: gzip``) for offline replay via
   ``python -m karpenter_tpu.replay``; ``?dump=1`` additionally writes it
   to the configured ``flight_recorder_dump_dir`` and returns the path.
+* ``/debug/cells`` — the sharded control plane's partition view
+  (state/cells.py): current cells with pending-pod counts, the last sharded
+  round's per-cell summaries (digest, cost, encode mode, marginal price),
+  and — with ``?pod=<name>`` — which cell owns a pod and why (feasible
+  provisioners, zone pin, gang, residue reason). ``{"enabled": false}``
+  while ``cell_sharding_enabled`` is off.
 """
 
 from __future__ import annotations
@@ -54,6 +60,7 @@ class OperatorHTTPServer:
         recorder: Optional[object] = None,
         decisions: Optional[DecisionLog] = None,
         flightrecorder: Optional[FlightRecorder] = None,
+        cells: Optional[Callable[[Optional[str]], dict]] = None,
         host: str = "127.0.0.1",
     ):
         self.registry = registry or REGISTRY
@@ -71,6 +78,10 @@ class OperatorHTTPServer:
         self.recorder = recorder
         self.decisions = decisions or DECISIONS
         self.flightrecorder = flightrecorder or FLIGHT
+        # the sharded control plane's partition view: a callable (pod name or
+        # None) -> payload; like the recorder, the operator late-binds this
+        # when it adopts a server started before the controllers existed
+        self.cells = cells
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -161,6 +172,17 @@ class OperatorHTTPServer:
                             self.send_response(200)
                             self.send_header("Content-Type", "application/json")
                             self.send_header("Content-Encoding", "gzip")
+                elif path == "/debug/cells":
+                    q = parse_qs(query)
+                    fn = outer.cells
+                    payload = (
+                        fn(q.get("pod", [None])[0])
+                        if fn is not None
+                        else {"enabled": False, "cells": []}
+                    )
+                    body = json.dumps(payload, default=str).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
                 elif path == "/debug/events":
                     try:
                         limit = max(0, int(parse_qs(query).get("limit", ["256"])[0]))

@@ -426,6 +426,20 @@ ENCODE_FULL_REASONS = Counter(
          "weight-degate, periodic-resync, relist, provisioner-change, ...).",
     registry=REGISTRY,
 )
+# cell-sharded control plane (state/cells.py + the provisioning sharded path)
+CELLS_TOTAL = Gauge(
+    "karpenter_tpu_cells_total",
+    help="Cells in the current control-plane partition (0 while cell "
+         "sharding is off or before the first sharded round).",
+    registry=REGISTRY,
+)
+CELL_PODS = Gauge(
+    "karpenter_tpu_cell_pods",
+    help="Pending pods routed to each cell in the last sharded round, "
+         "labeled by bounded cell id (small integer index in sorted-key "
+         "order, not the cell name; 'residue' is the cross-cell class).",
+    registry=REGISTRY,
+)
 CONSOLIDATION_SWEEP_CANDIDATES = Counter(
     "karpenter_tpu_consolidation_sweep_candidates_total",
     help="Single-node consolidation what-if simulations evaluated, labeled "

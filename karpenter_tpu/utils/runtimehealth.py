@@ -40,6 +40,16 @@ TOP_ALLOCATORS = 5
 
 _memory_profiling = False
 
+#: cell-aware memory scrape hook (sharded control plane only): a WEAK
+#: reference to a callable returning {cell id: encoder-state bytes}. None —
+#: the flat-mode default — keeps the process_memory_bytes exposition
+#: byte-identical to the single-series shape dashboards already graph; when
+#: set (the operator wires it only under settings.cell_sharding_enabled)
+#: the gauge gains one {cell="<id>"} series per cell carrying that cell's
+#: encoder footprint. Weak so a module global never pins a stopped
+#: operator's controller (and its per-cell encoder matrices) in memory.
+_cell_bytes_ref = None
+
 
 def rss_bytes() -> float:
     """Resident set size of this process, in bytes."""
@@ -82,7 +92,17 @@ def disable_memory_profiling() -> None:
 
 
 def _refresh() -> None:
-    metrics.PROCESS_MEMORY.set(rss_bytes())
+    series = {(): rss_bytes()}
+    fn = _cell_bytes_ref() if _cell_bytes_ref is not None else None
+    if fn is not None:
+        try:
+            for cid, nbytes in fn().items():
+                series[series_key({"cell": str(cid)})] = float(nbytes)
+        except Exception:
+            pass  # a scrape must never fail on the cell hook
+    # full swap (not .set): cells that vanished leave the exposition, and
+    # with no hook this publishes exactly the one unlabeled series PR 7 did
+    metrics.PROCESS_MEMORY.replace_series(series)
     if not _memory_profiling:
         return
     import tracemalloc
@@ -100,14 +120,29 @@ def _refresh() -> None:
 
 
 def install(
-    registry: Optional[Registry] = None, memory_profiling: bool = False
+    registry: Optional[Registry] = None,
+    memory_profiling: bool = False,
+    cell_bytes=None,
 ) -> None:
     """Register the pre-scrape refresher once per registry and apply the
-    profiling setting (idempotent — Operator.new calls this on every build)."""
+    profiling setting (idempotent — Operator.new calls this on every build).
+    ``cell_bytes`` installs the {cell}-aware memory scrape (see
+    ``_cell_bytes_ref``); passing None restores the flat single-series
+    exposition."""
+    global _cell_bytes_ref
     registry = registry or REGISTRY
     if registry not in _installed:
         _installed.add(registry)
         registry.add_refresher(_refresh)
+    if cell_bytes is None:
+        _cell_bytes_ref = None
+    else:
+        try:
+            # weak for the normal bound-method hook: a dead controller's
+            # series simply stop; plain functions fall back to a strong ref
+            _cell_bytes_ref = weakref.WeakMethod(cell_bytes)
+        except TypeError:
+            _cell_bytes_ref = lambda fn=cell_bytes: fn
     if memory_profiling:
         enable_memory_profiling()
     elif _memory_profiling:
