@@ -80,8 +80,12 @@ def config_5k_constrained():
     return pods, [(p, cat) for p in provs], []
 
 
-def config_10k_topology():
-    """10k pods with zone topology spread + hostname anti-affinity mixes."""
+def config_10k_topology(scale=1):
+    """10k pods with zone topology spread + hostname anti-affinity mixes
+    (``scale`` multiplies the service group sizes — the 50k acceptance-scale
+    topology race is ``scale=5``; the scan step count is group-bound, so
+    kernel wall-clock barely moves while the host packer's slot arithmetic
+    grows with the fleet)."""
     from karpenter_tpu.api import ObjectMeta, PodAffinityTerm, Provisioner, TopologySpreadConstraint
     from karpenter_tpu.api import labels as wk
     from karpenter_tpu.cloudprovider import generate_catalog
@@ -96,13 +100,13 @@ def config_10k_topology():
     for i in range(8):
         app = f"svc{i}"
         shapes.append(
-            (app, 1200, ["250m", "500m"][i % 2], ["512Mi", "1Gi"][i % 2],
+            (app, 1200 * scale, ["250m", "500m"][i % 2], ["512Mi", "1Gi"][i % 2],
              {"labels": {"app": app}, "spread": spread(app)})
         )
     for i in range(4):
         app = f"db{i}"
         shapes.append(
-            (app, 100, "1", "4Gi", {"labels": {"app": app}, "affinity": anti(app)})
+            (app, 100 * scale, "1", "4Gi", {"labels": {"app": app}, "affinity": anti(app)})
         )
     pods = _pods(shapes)
     prov = Provisioner(meta=ObjectMeta(name="default"))
@@ -196,20 +200,26 @@ def config_20k_repack():
     return pods, [(prov, cat)], existing
 
 
-def config_50k_full():
-    """The north star: 50k pods x 400 types x 3 AZs, spot-price weighted."""
+def _config_full(n_pods=50_000, n_types=400, seed=11):
+    """The north-star mix at a parameterized scale: deployment-shaped pod
+    groups x ``n_types`` x 3 AZs, spot-price weighted (the cold-solve
+    regression gate runs this reduced; ``config_50k_full`` is the headline)."""
     from karpenter_tpu.api import ObjectMeta, Provisioner
     from karpenter_tpu.api import labels as wk
     from karpenter_tpu.cloudprovider import generate_catalog
 
-    cat = generate_catalog(n_types=400)
-    rng = np.random.default_rng(11)
+    cat = generate_catalog(n_types=n_types)
+    rng = np.random.default_rng(seed)
     shapes = []
-    remaining = 50_000
+    remaining = n_pods
+    # scales to exactly the historical (300, 2500) group-size band at 50k —
+    # the headline problem mix must stay byte-comparable across rounds
+    lo = max(n_pods * 300 // 50_000, 8)
+    hi = max(n_pods * 2500 // 50_000, 16)
     cpus = ["100m", "250m", "500m", "1", "2", "4"]
     mems = ["256Mi", "512Mi", "1Gi", "2Gi", "4Gi", "8Gi"]
     for i in range(40):
-        n = int(rng.integers(300, 2500))
+        n = int(rng.integers(lo, hi))
         n = min(n, remaining - (39 - i))  # keep some for the tail
         remaining -= n
         sel = {}
@@ -224,6 +234,11 @@ def config_50k_full():
     pods = _pods(shapes)
     prov = Provisioner(meta=ObjectMeta(name="default"))
     return pods, [(prov, cat)], []
+
+
+def config_50k_full():
+    """The north star: 50k pods x 400 types x 3 AZs, spot-price weighted."""
+    return _config_full(50_000, 400)
 
 
 CONFIGS = [
@@ -894,13 +909,64 @@ def bench_consolidation(n_nodes=300, pods_per_node=3, max_passes=40):
     }
 
 
+def _race_axes(out, host, host_ms, kernel, kernel_warm_ms):
+    """Per-axis race verdicts: cost (packing quality) and wall-clock (the
+    steady-state dispatch a warm bucket pays, vs the host's solve time).
+    ``winner`` keeps the historical cost-only meaning."""
+    if host and kernel and not kernel.stats.get("fallback"):
+        out["winner"] = "kernel" if kernel.cost < host.cost - 1e-9 else (
+            "host" if host.cost < kernel.cost - 1e-9 else "tie"
+        )
+        out["winner_cost"] = out["winner"]
+        out["winner_wall"] = (
+            "kernel" if kernel_warm_ms < host_ms else (
+                "host" if host_ms < kernel_warm_ms else "tie"
+            )
+        )
+        out["winner_both"] = (
+            "kernel"
+            if out["winner_cost"] == "kernel" and out["winner_wall"] == "kernel"
+            else ("host" if out["winner_cost"] == "host" and out["winner_wall"] == "host" else None)
+        )
+    return out
+
+
+def _race_fresh(problems, host_fn, solver):
+    """Steady-state race measurement on equal terms: each trial solves a
+    FRESH problem (new objects, slightly varied content — no per-problem
+    plan caches, no device-input reuse on either side) with the kernel's
+    bucket executable warm. ``problems[0]`` is the cold trial (compile or
+    disk-load); the verdict medians come from the remaining problems —
+    what a novel batch actually pays on each path."""
+    import statistics as _st
+    import time as _t
+
+    t0 = _t.perf_counter()
+    kernel = solver._solve_kernel(problems[0])
+    cold_ms = (_t.perf_counter() - t0) * 1e3
+    cold_hit = bool(kernel.stats.get("aot_hit"))
+    host_times, kernel_times = [], []
+    host = None
+    for p in problems[1:]:
+        t0 = _t.perf_counter()
+        host = host_fn(p)
+        host_times.append((_t.perf_counter() - t0) * 1e3)
+        t0 = _t.perf_counter()
+        kernel = solver._solve_kernel(p)
+        kernel_times.append((_t.perf_counter() - t0) * 1e3)
+    return (
+        host, _st.median(host_times), kernel, _st.median(kernel_times),
+        cold_ms, cold_hit,
+    )
+
+
 def bench_kernel_race(n_pods=500, n_types=20):
     """Head-to-head solver race in quality mode (budget > device RTT): does
     the TPU kernel's portfolio+lookahead packing beat the host LP's rounding
     on an LP-safe problem when the link latency is affordable? Reports both
-    costs and the winner — the 'TPU contributes beyond the topology configs'
-    proof, independent of the latency-bound headline where a ~100ms tunneled
-    link keeps the host path in front."""
+    axes (cost AND wall-clock) plus cold-vs-warm kernel dispatch timings —
+    with the AOT bucket cache, the warm number is what a steady-state race
+    actually pays."""
     from karpenter_tpu.api import ObjectMeta, Pod, Provisioner, Resources
     from karpenter_tpu.cloudprovider import generate_catalog
     from karpenter_tpu.solver import TPUSolver, best_lower_bound, encode
@@ -908,59 +974,140 @@ def bench_kernel_race(n_pods=500, n_types=20):
 
     # deployment-shaped single-group burst (one deployment scaling out): the
     # kernel's lump packing searches node-size mixes the LP's uniform
-    # rounding cannot express, and reproducibly beats it here
-    pods = _pods([("w", n_pods, "250m", "512Mi", {})])
+    # rounding cannot express, and reproducibly beats it here. Each trial is
+    # a FRESH encode (one extra tiny pod varies the content) so neither side
+    # serves a per-problem cache — the novel-batch steady state.
+    cat = generate_catalog(n_types=n_types)
     prov = Provisioner(meta=ObjectMeta(name="default"))
-    problem = encode(pods, [(prov, generate_catalog(n_types=n_types))])
-    lb = float(best_lower_bound(problem))
-    host = solve_host(problem)
+
+    def fresh(i):
+        # trial problems differ only in pod NAMES: fresh objects, cold
+        # per-problem caches on both paths, numerically identical optimum
+        return encode(_pods([(f"w{i}", n_pods, "250m", "512Mi", {})]), [(prov, cat)])
+
+    problems = [fresh(i) for i in range(4)]
+    lb = float(best_lower_bound(problems[-1]))
     solver = TPUSolver(portfolio=8)
-    kernel = solver._solve_kernel(problem)
+    host, host_ms, kernel, warm_ms, cold_ms, cold_hit = _race_fresh(
+        problems, solve_host, solver
+    )
     out = {
         "lower_bound": round(lb, 4),
         "host_cost": round(float(host.cost), 4) if host else None,
+        "host_ms": round(host_ms, 1),
         "kernel_cost": round(float(kernel.cost), 4) if kernel else None,
+        "kernel_cold_ms": round(cold_ms, 1),
+        "kernel_warm_ms": round(warm_ms, 1),
+        "aot_cold_hit": cold_hit,
     }
-    if host and kernel and not kernel.stats.get("fallback"):
-        out["winner"] = "kernel" if kernel.cost < host.cost - 1e-9 else (
-            "host" if host.cost < kernel.cost - 1e-9 else "tie"
-        )
-    return out
+    return _race_axes(out, host, host_ms, kernel, warm_ms)
 
 
 def bench_kernel_race_topology(n_pods=10_000):
     """Scaled-up quality-budget race on a TOPOLOGY shape (round-4 verdict
     item 3b): zone spread + hostname anti-affinity at 10k pods, where the
     assignment LP is unavailable and the host competitor is the numpy FFD
-    portfolio. Reports both costs and the winner."""
-    import time as _t
-
+    portfolio. Reports both axes plus cold-vs-warm kernel timings."""
     from karpenter_tpu.solver import TPUSolver, best_lower_bound, encode, validate
 
-    pods, provs, _ = config_10k_topology()
-    problem = encode(pods, provs)
+    import dataclasses as _dc
+
+    pods, provs, _ = config_10k_topology(scale=max(n_pods // 10_000, 1))
+
+    def fresh(i):
+        # rename-only variation: fresh objects and cold per-problem caches
+        # each trial, identical constraint structure and optimum
+        renamed = [
+            _dc.replace(p, meta=_dc.replace(p.meta, name=f"{p.meta.name}.r{i}"))
+            for p in pods
+        ]
+        return encode(renamed, provs)
+
+    problems = [fresh(i) for i in range(4)]
+    problem = problems[-1]
     lb = float(best_lower_bound(problem))
     solver = TPUSolver(portfolio=8, latency_budget_s=30.0)
-    t0 = _t.perf_counter()
-    host = solver._solve_host_pack(problem)
-    host_ms = (_t.perf_counter() - t0) * 1e3
-    t0 = _t.perf_counter()
-    kernel = solver._solve_kernel(problem)
-    kernel_ms = (_t.perf_counter() - t0) * 1e3
+    host, host_ms, kernel, warm_ms, cold_ms, cold_hit = _race_fresh(
+        problems, solver._solve_host_pack, solver
+    )
     out = {
-        "pods": n_pods,
+        "pods": len(pods),
         "lower_bound": round(lb, 4),
         "host_cost": round(float(host.cost), 4) if host else None,
         "host_ms": round(host_ms, 1),
         "kernel_cost": round(float(kernel.cost), 4) if kernel else None,
-        "kernel_ms": round(kernel_ms, 1),
+        "kernel_ms": round(cold_ms, 1),  # historical field: first dispatch
+        "kernel_cold_ms": round(cold_ms, 1),
+        "kernel_warm_ms": round(warm_ms, 1),
+        "aot_cold_hit": cold_hit,
         "violations": len(validate(problem, kernel)) + len(validate(problem, host)),
     }
-    if host and kernel and not kernel.stats.get("fallback"):
-        out["winner"] = "kernel" if kernel.cost < host.cost - 1e-9 else (
-            "host" if host.cost < kernel.cost - 1e-9 else "tie"
+    return _race_axes(out, host, host_ms, kernel, warm_ms)
+
+
+def bench_cold_solve(n_pods=20_000, n_types=400, trials=5):
+    """Fresh-batch cold solve in a WARM process (the regression-gate
+    scenario): the operator has been solving for a while — bucket
+    executables resident, similarity warm-starts banked — and a CHANGED
+    batch arrives. Measures the end-to-end ``solve_pods`` (encode + backend
+    race + decode) for three distinct fresh batches, reporting the median
+    and which backend answered. This is ``cold_solve_ms`` from the config
+    benches, isolated and cheap enough to gate on."""
+    import statistics as _st
+
+    from karpenter_tpu.api import ObjectMeta as _OM, Pod as _Pod, Resources as _Res
+    from karpenter_tpu.solver import TPUSolver
+    from karpenter_tpu.solver.solver import _join_warm_threads
+    from karpenter_tpu.utils.gctuning import maintain as _gc_maintain
+
+    pods, provs, existing = _config_full(n_pods, n_types)
+    solver = TPUSolver(portfolio=8)
+    # warm the process the way a running operator is warm: a few solves of
+    # the standing batch (compiles buckets, banks pattern pools), then let
+    # the background pre-compiles settle
+    solver.solve_pods(pods, provs, existing=existing)
+    solver.solve_pods(pods, provs, existing=existing)
+    _join_warm_threads()
+    times, encodes, backends = [], [], []
+    result = None
+    for ci in range(trials):
+        batch = list(pods) + [
+            _Pod(meta=_OM(name=f"cold-gate-{ci}"),
+                 requests=_Res(cpu="100m", memory="128Mi"))
+        ]
+        _gc_maintain()
+        t0 = time.perf_counter()
+        result = solver.solve_pods(batch, provs, existing=existing)
+        times.append(time.perf_counter() - t0)
+        encodes.append(result.stats.get("encode_s", 0.0))
+        backends.append(
+            {0.0: "greedy", 1.0: "kernel", 2.0: "host-lp", 3.0: "host-ffd"}.get(
+                result.stats.get("backend"), "?"
+            )
         )
-    return out
+    # machine factor: the regression gate's 100ms acceptance budget was
+    # calibrated on the driver box (BENCH_r05: 32ms fresh 50k encode =
+    # 0.64us/pod). A slower box scales the budget by its measured fresh
+    # encode rate against that anchor instead of flapping the gate — on
+    # driver-class hardware the factor degrades to 1.0 and the gate is the
+    # literal acceptance number. CAPPED: the factor is measured by the same
+    # code being gated, so an uncapped factor would absorb a real encode
+    # regression; past 8x the gate fails regardless (the delta_reconcile
+    # gate separately pins encode performance as a ratio).
+    enc_ms = _st.median(encodes) * 1e3
+    nominal_enc_ms = 0.00064 * n_pods
+    factor = (
+        min(max(1.0, enc_ms / nominal_enc_ms), 8.0) if nominal_enc_ms > 0 else 1.0
+    )
+    return {
+        "pods": n_pods,
+        "cold_solve_ms": round(_st.median(times) * 1e3, 1),
+        "cold_solve_p100_ms": round(max(times) * 1e3, 1),
+        "encode_fresh_ms": round(enc_ms, 1),
+        "machine_factor": round(factor, 2),
+        "backends": backends,
+        "unschedulable": len(result.unschedulable),
+    }
 
 
 def bench_interruption(sizes=(100, 1000, 5000, 15000)):
@@ -1852,6 +1999,12 @@ def _run_details(dry_run: bool = False) -> dict:
         details["device_rtt_ms"] = round(rtt * 1e3, 1) if rtt != float("inf") else None
     except Exception:
         details["device_rtt_ms"] = None
+    try:
+        from karpenter_tpu.solver.jax_solver import AOT_CACHE
+
+        details["aot_cache"] = AOT_CACHE.stats_dict()
+    except Exception:
+        details["aot_cache"] = None
     return details
 
 
@@ -1898,6 +2051,17 @@ def main(argv=None):
         except (TypeError, ValueError) as e:
             print(json.dumps({"error": f"detail serialization failed: {e}"}))
     sys.stdout.flush()
+    # Settle every background compile BEFORE the final line: a warm thread
+    # finishing after the summary can emit library noise (XLA/absl logs) onto
+    # stderr, and a harness capturing combined output would then tail a
+    # non-JSON line instead of the summary (the BENCH_r0x "parsed": null
+    # failure mode — hack/bench_artifact.py is the robust writer).
+    try:
+        from karpenter_tpu.solver.solver import _join_warm_threads
+
+        _join_warm_threads()
+    except Exception:
+        pass
     # FINAL line — guaranteed last on stdout, short, self-contained, strict
     # JSON. tests/test_bench_summary.py pins this contract.
     delta = details.get("delta_reconcile", {})
@@ -1907,6 +2071,8 @@ def main(argv=None):
     gangs = details.get("gang_preemption", {})
     spot = details.get("spot_churn", {})
     cells = details.get("cell_decompose", {})
+    race_topo = details.get("kernel_race_topology", {})
+    aot = details.get("aot_cache") or {}
     summary = {
         "metric": line["metric"],
         "value": line["value"],
@@ -1940,6 +2106,11 @@ def main(argv=None):
         "cell_round_p50_ms": cells.get("sharded_round_p50_ms"),
         "cell_digests_equal": cells.get("digests_equal"),
         "cell_within_2x_flat50k": cells.get("within_2x_flat_ref"),
+        # AOT kernel-dispatch story (ISSUE 9): cold vs warm kernel timings on
+        # the realistic topology race, and the executable-cache hit totals
+        "kernel_cold_ms": race_topo.get("kernel_cold_ms"),
+        "kernel_warm_ms": race_topo.get("kernel_warm_ms"),
+        "aot_cache_hits": aot.get("hits"),
         "summary": True,
     }
     # the summary is the parse target: STRICT JSON, no NaN/Infinity tokens —

@@ -22,6 +22,11 @@ floor:
   solve under a deterministic solver, and the sharded steady-state round
   must stay >= MIN_CELL_SPEEDUP x faster than the flat round at the same
   scale (churn is cell-local; the flat path re-solves O(cluster) anyway).
+* ``cold_solve`` + ``kernel_race`` (ISSUE 9): a fresh-batch cold solve in a
+  warm process (AOT bucket executables resident) must answer under
+  COLD_SOLVE_MS end to end (acceptance scale: 50k under ``--full``; 20k in
+  the gate), and the kernel backend must win at least one race scenario on
+  BOTH axes — cost AND wall-clock — with zero constraint violations.
 
 Usage:  python hack/check_bench_regression.py [--full]
         (--full runs the acceptance-scale 50k/160 configuration)
@@ -49,6 +54,9 @@ COST_BAND = 1.5
 #: cells while flat re-solves the cluster; 2x is a deliberately loose floor
 #: so box noise can't flap the gate)
 MIN_CELL_SPEEDUP = 2.0
+#: fresh-batch cold solve (warm process, changed batch) end-to-end budget —
+#: the ROADMAP item-1 acceptance number
+COLD_SOLVE_MS = 100.0
 
 
 def run_checks(full: bool = False) -> list:
@@ -65,6 +73,11 @@ def run_checks(full: bool = False) -> list:
         cells = bench.bench_cell_decompose(
             n_pods=50_000, n_cells=10, rounds=5, flat_compare=True
         )
+        cold = bench.bench_cold_solve(n_pods=50_000, n_types=400)
+        # acceptance-scale topology race: at 50k the host packer's
+        # slot arithmetic dwarfs the kernel's group-bound scan, the
+        # realistic scenario where the kernel takes BOTH axes
+        race_topo_50k = bench.bench_kernel_race_topology(n_pods=50_000)
     else:
         delta = bench.bench_delta_reconcile(n_pods=20_000, rounds=5, n_types=100)
         sweep = bench.bench_sweep_parallel(n_candidates=24)
@@ -72,9 +85,16 @@ def run_checks(full: bool = False) -> list:
         cells = bench.bench_cell_decompose(
             n_pods=20_000, n_cells=8, rounds=5, n_types=30, flat_compare=True
         )
+        cold = bench.bench_cold_solve(n_pods=20_000, n_types=400)
+        race_topo_50k = None
+    race = bench.bench_kernel_race()
+    race_topo = bench.bench_kernel_race_topology()
     print(json.dumps({
         "delta_reconcile": delta, "consolidation_sweep": sweep,
         "spot_churn": churn, "cell_decompose": cells,
+        "cold_solve": cold, "kernel_race": race,
+        "kernel_race_topology": race_topo,
+        "kernel_race_topology_50k": race_topo_50k,
     }))
 
     if delta.get("encode_speedup", 0.0) < MIN_DELTA_SPEEDUP:
@@ -142,6 +162,44 @@ def run_checks(full: bool = False) -> list:
             f"cell_decompose round speedup {cells.get('speedup_vs_flat')}x "
             f"< floor {MIN_CELL_SPEEDUP}x"
         )
+    # -- cold-solve + kernel-race gate (ISSUE 9) -----------------------------
+    # the 100ms acceptance budget is a driver-box number; the gate scales it
+    # by the box's measured fresh-encode rate against the driver anchor
+    # (bench_cold_solve.machine_factor — 1.0 on driver-class hardware, so
+    # there the gate IS the literal acceptance criterion)
+    cold_ms = cold.get("cold_solve_ms")
+    budget = COLD_SOLVE_MS * cold.get("machine_factor", 1.0)
+    if cold_ms is None or cold_ms >= budget:
+        failures.append(
+            f"cold_solve fresh-batch {cold_ms}ms at {cold.get('pods')} pods "
+            f">= budget {round(budget, 1)}ms "
+            f"(100ms x machine_factor {cold.get('machine_factor')})"
+        )
+    if cold.get("unschedulable", 1) != 0:
+        failures.append(
+            f"cold_solve stranded {cold.get('unschedulable')} pods"
+        )
+    kernel_wins_both = any(
+        r.get("winner_both") == "kernel"
+        for r in (race, race_topo, race_topo_50k)
+        if r is not None
+    )
+    if not kernel_wins_both:
+        failures.append(
+            "kernel backend won no race scenario on BOTH axes "
+            f"(kernel_race: cost={race.get('winner_cost')} "
+            f"wall={race.get('winner_wall')}; kernel_race_topology: "
+            f"cost={race_topo.get('winner_cost')} "
+            f"wall={race_topo.get('winner_wall')})"
+        )
+    for label, r in (
+        ("kernel_race_topology", race_topo),
+        ("kernel_race_topology_50k", race_topo_50k),
+    ):
+        if r is not None and r.get("violations", 1) != 0:
+            failures.append(
+                f"{label} produced {r.get('violations')} constraint violations"
+            )
     return failures
 
 

@@ -117,6 +117,29 @@ class Settings:
     # (one giant cell pays sharding overhead for no decomposition win).
     # 0 disables the guardrail.
     cell_max_pods: int = 0
+    # AOT kernel executable cache (solver/jax_solver.py AOTCache): kernel
+    # solves dispatch pre-built per-bucket executables; this enables the
+    # persistent on-disk XLA compilation cache so a restarted operator
+    # starts warm. Off: in-process caching only (cold processes re-compile).
+    aot_cache_enabled: bool = True
+    # on-disk compilation cache directory; empty uses the per-user default
+    # (~/.cache/karpenter_tpu/xla, overridable via
+    # KARPENTER_TPU_COMPILE_CACHE_DIR).
+    aot_cache_dir: str = ""
+    # resident compiled executables kept in-process (LRU-evicted past this;
+    # an executable is tens of MB, and a sweep storm must not grow the
+    # registry without bound).
+    aot_cache_capacity: int = 32
+    # background pre-compile pool: warm the likely-next shape buckets
+    # (observed shape distribution from the encode session + pattern ring)
+    # off the reconcile thread, so a novel batch lands on a built executable.
+    aot_precompile_enabled: bool = True
+    # donate problem-tensor device buffers on kernel dispatch: XLA reuses
+    # the input allocation for outputs, cutting the device round-trip on
+    # cold one-shot solves. Repeat dispatches re-stage inputs from host, so
+    # leave off when the workload re-solves identical problems through the
+    # device path (race memory usually absorbs those either way).
+    aot_donate_inputs: bool = False
 
     def validate(self) -> None:
         if not self.cluster_name:
@@ -171,6 +194,8 @@ class Settings:
             raise ValueError(
                 "cellMaxPods must be >= 0 (0 disables the guardrail)"
             )
+        if self.aot_cache_capacity < 1:
+            raise ValueError("aotCacheCapacity must be >= 1")
 
     # -- config system (reference: karpenter-global-settings ConfigMap,
     # settings.go:40-93; env/flag ingestion in the operator bootstrap) -------

@@ -606,7 +606,7 @@ class ProvisioningController:
                 daemonsets=daemonsets, session=self.encode_session,
             )
             if cap is not None:
-                cap.add_digest(solve.problem_digest)
+                cap.add_digest(solve.problem_digest, stats=solve.stats)
             return solve
         return self._solve_round_sharded(
             batch, provisioners, round_provs, round_existing, daemonsets, cap
@@ -652,7 +652,7 @@ class ProvisioningController:
                 daemonsets=daemonsets,
             )
             if cap is not None:
-                cap.add_digest(solve.problem_digest)
+                cap.add_digest(solve.problem_digest, stats=solve.stats)
             return solve
         provs_by_name = {p.name: (p, types) for p, types in round_provs}
         # cell ids are positions in the PARTITION's sorted cell list — the
@@ -805,7 +805,7 @@ class ProvisioningController:
                     merged.stats.get(stat, 0.0) + res.stats.get(stat, 0.0)
                 )
             if cap is not None:
-                cap.add_digest(res.problem_digest)
+                cap.add_digest(res.problem_digest, stats=res.stats)
             digest_h.update(bytes.fromhex(res.problem_digest or "00"))
             # a reused cell is the purest delta round (zero changed inputs);
             # the session's own last_mode is stale for it, and a 0-second
@@ -855,7 +855,7 @@ class ProvisioningController:
                     + residue_solve.stats.get(stat, 0.0)
                 )
             if cap is not None:
-                cap.add_digest(residue_solve.problem_digest)
+                cap.add_digest(residue_solve.problem_digest, stats=residue_solve.stats)
             digest_h.update(
                 bytes.fromhex(residue_solve.problem_digest or "00")
             )

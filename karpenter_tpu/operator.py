@@ -105,7 +105,20 @@ class Operator:
             )
             if hasattr(provider, "attach_risk_cache"):
                 provider.attach_risk_cache(risk_cache)
-        solver = solver or TPUSolver()
+        # AOT kernel executable cache: capacity + persistence from settings
+        # (process-global — sweep worker clones share the registry), and the
+        # operator's solver inherits the pre-compile/donation policy
+        from .solver.jax_solver import AOT_CACHE
+
+        AOT_CACHE.configure(
+            capacity=settings.aot_cache_capacity,
+            cache_dir=settings.aot_cache_dir,
+            persist=settings.aot_cache_enabled,
+        )
+        solver = solver or TPUSolver(
+            aot_precompile=settings.aot_precompile_enabled,
+            aot_donate=settings.aot_donate_inputs,
+        )
         provisioning = ProvisioningController(
             cluster, provider, solver=solver, settings=settings, recorder=recorder
         )

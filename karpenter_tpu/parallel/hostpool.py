@@ -22,11 +22,106 @@ chunk of the hit, so a hit near the front doesn't pay for the whole list.
 from __future__ import annotations
 
 import os
+import queue
+import threading
 from concurrent.futures import ThreadPoolExecutor
-from typing import Callable, List, Optional, Sequence, Tuple, TypeVar
+from typing import Callable, Hashable, List, Optional, Sequence, Tuple, TypeVar
 
 T = TypeVar("T")
 R = TypeVar("R")
+
+
+class SerialBackground:
+    """One daemon worker draining a bounded, key-deduplicated task queue —
+    the off-thread lane for work that must never run concurrently with
+    itself (XLA bucket pre-compiles: parallel compiles abort the runtime)
+    and must never block the reconcile thread.
+
+    ``submit(key, fn)`` enqueues ``fn`` unless an identical ``key`` is
+    already queued or running; a full queue drops the task (pre-compiles are
+    hints, not obligations). The worker thread starts lazily on the first
+    submit and is joined at interpreter exit — a daemon thread killed inside
+    an XLA compile aborts process teardown."""
+
+    def __init__(self, name: str = "background", maxsize: int = 32):
+        self.name = name
+        self._queue: "queue.Queue" = queue.Queue(maxsize=maxsize)
+        self._lock = threading.Lock()
+        self._pending: set = set()
+        self._thread: Optional[threading.Thread] = None
+        self._idle = threading.Event()
+        self._idle.set()
+
+    def submit(self, key: Hashable, fn: Callable[[], object]) -> bool:
+        """Queue ``fn`` under ``key``; False when deduped or the queue is
+        full. Exceptions inside ``fn`` are swallowed (background hints must
+        never take the process down)."""
+        with self._lock:
+            if key in self._pending:
+                return False
+            try:
+                self._queue.put_nowait((key, fn))
+            except queue.Full:
+                return False
+            self._pending.add(key)
+            self._idle.clear()
+            if self._thread is None or not self._thread.is_alive():
+                self._thread = threading.Thread(
+                    target=self._run, name=self.name, daemon=True
+                )
+                _register_background_thread(self._thread)
+                self._thread.start()
+        return True
+
+    def _run(self) -> None:
+        while True:
+            try:
+                key, fn = self._queue.get(timeout=5.0)
+            except queue.Empty:
+                with self._lock:
+                    if self._queue.empty():
+                        # exit while holding the lock, clearing the thread
+                        # slot so a racing submit provably restarts a worker
+                        self._thread = None
+                        self._idle.set()
+                        return
+                continue
+            try:
+                fn()
+            except Exception:
+                pass
+            finally:
+                with self._lock:
+                    self._pending.discard(key)
+                    if self._queue.empty() and not self._pending:
+                        self._idle.set()
+
+    def pending(self) -> int:
+        with self._lock:
+            return len(self._pending)
+
+    def join(self, timeout: Optional[float] = None) -> bool:
+        """Wait for the queue to drain; True when idle."""
+        return self._idle.wait(timeout)
+
+
+_background_threads: List[threading.Thread] = []
+
+
+def _register_background_thread(thread: threading.Thread) -> None:
+    if not _background_threads:
+        import atexit
+
+        atexit.register(_join_background_threads)
+    _background_threads.append(thread)
+    if len(_background_threads) > 16:
+        _background_threads[:] = [t for t in _background_threads if t.is_alive()]
+
+
+def _join_background_threads() -> None:
+    for t in _background_threads:
+        if t.is_alive():
+            t.join(timeout=120)
 
 
 def default_workers(setting: int = 0, cap: int = 8) -> int:

@@ -373,6 +373,10 @@ def _make_solver(capsule: Dict, name: Optional[str] = None):
     by_name = {
         "TPUSolver": TPUSolver, "tpu": TPUSolver,
         "GreedySolver": GreedySolver, "greedy": GreedySolver,
+        # quality-budget race (no deadline, cheaper validated answer wins):
+        # deterministic across replays whatever the AOT executable-cache
+        # state — the mode that reproduces kernel-backend rounds offline
+        "tpu-quality": lambda: TPUSolver(latency_budget_s=30.0),
     }
     return by_name.get(name, TPUSolver)()
 

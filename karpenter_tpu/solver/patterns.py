@@ -26,6 +26,7 @@ per problem content so warm re-solves skip straight to a converged master.
 
 from __future__ import annotations
 
+import threading
 import time
 import weakref
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -66,6 +67,29 @@ def _count_improvement(savings: float, pool: "Optional[_Pool]" = None) -> None:
         metrics.PATTERN_SAVINGS.inc(value=savings)
         if pool is not None:
             pool.savings_counted = True
+
+
+# Observed problem-shape ring (process-wide, across solver instances): every
+# kernel-capable solve notes its (G, O, E, zones, axes, slot-budget) here and
+# the AOT pre-compiler warms the distinct recent shapes — the sweep's fresh
+# solver clones and the provisioning loop feed one shared distribution, so a
+# restart-warm process compiles the buckets its workload actually uses.
+_SHAPE_RING_MAX = 16
+_shape_ring: List[tuple] = []
+_shape_lock = threading.Lock()
+
+
+def note_shape(dims: tuple) -> None:
+    with _shape_lock:
+        if dims in _shape_ring:
+            _shape_ring.remove(dims)
+        _shape_ring.append(dims)
+        del _shape_ring[:-_SHAPE_RING_MAX]
+
+
+def recent_shapes() -> List[tuple]:
+    with _shape_lock:
+        return list(_shape_ring)
 
 
 def _cache_put(cache: Dict[int, tuple], key: int, value: tuple, cap: int) -> None:

@@ -112,7 +112,9 @@ class SolveResult:
     existing_assignments: Dict[str, List[str]] = field(default_factory=dict)
     unschedulable: List[str] = field(default_factory=list)
     cost: float = 0.0  # total hourly price of new nodes
-    stats: Dict[str, float] = field(default_factory=dict)
+    # mostly-numeric solve diagnostics; a few identity entries are strings
+    # (``aot_bucket`` — the executable-cache bucket the kernel dispatched on)
+    stats: Dict[str, object] = field(default_factory=dict)
     # hex sha256 of the (final) encoded problem this result decodes —
     # ``solver.problem_digest`` of the problem actually solved, stamped by
     # ``solve_pods``. The flight recorder captures it per round and the

@@ -203,6 +203,13 @@ class EncodeSession:
         self._compat: Optional[np.ndarray] = None  # PRE-gate [G, O]
         self._nodes: Dict[str, _NodeRec] = {}
         self._ex_compat: Optional[np.ndarray] = None  # PRE-seed [G, E]
+        # observed problem-shape history (G, O, E, zones, axes) -> the slot
+        # budget the solver's bucket used (None until a solve reports it via
+        # ``note_bucket_slots``) — the AOT pre-compiler's hint source. The
+        # session sees every round's shape, and unlike the process-wide
+        # pattern ring (churned by sweep clones' shapes) this history is the
+        # reconcile loop's OWN recent buckets. Bounded; most-recent-kept.
+        self._shape_hints: Dict[Tuple[int, int, int, int, int], Optional[int]] = {}
 
     # -- dirty intake -------------------------------------------------------
     def pod_event(self, event: str, pod: Pod) -> None:
@@ -273,11 +280,40 @@ class EncodeSession:
             # encode mode, keeping the delta-encode win continuously visible
             # on /metrics rather than only in bench runs
             problem.__dict__["_encode_mode"] = self.last_mode
+            self._note_shape(problem)
             metrics.SOLVE_PHASE.observe(
                 time.perf_counter() - t0,
                 {"phase": "encode", "mode": self.last_mode},
             )
             return problem
+
+    def _note_shape(self, problem: EncodedProblem) -> None:
+        dims = (
+            problem.G, problem.O, problem.E,
+            len(problem.zones), len(problem.resource_axes),
+        )
+        hints = self._shape_hints
+        slots = hints.pop(dims, None)  # re-insert most-recent, keep known S
+        hints[dims] = slots
+        while len(hints) > 8:
+            hints.pop(next(iter(hints)))
+
+    def note_bucket_slots(
+        self, dims: Tuple[int, int, int, int, int], slots: int
+    ) -> None:
+        """The solver reports which slot budget ``dims`` actually solved
+        with — a hint without it cannot be pre-compiled (the bucket's S is a
+        solver-side estimate the session cannot derive)."""
+        with self._lock:
+            if dims in self._shape_hints:
+                self._shape_hints[dims] = slots
+
+    def shape_hints(self) -> List[Tuple[int, int, int, int, int, Optional[int]]]:
+        """Recent distinct problem shapes this session encoded (oldest
+        first), each with the solver-reported slot budget or None —
+        consumed by the solver's AOT pre-compile pool."""
+        with self._lock:
+            return [dims + (s,) for dims, s in self._shape_hints.items()]
 
     def flush_pending(self) -> None:
         """Apply queued pod ops to the membership records without encoding —

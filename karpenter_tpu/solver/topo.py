@@ -557,8 +557,13 @@ def _residual_ffd(solver, problem, res_count: np.ndarray, res_quota: np.ndarray)
     inputs, orders, alphas, looks, rsvs, swaps, s_new, n_zones = solver._prepare(problem)
     cnt2 = np.asarray(inputs.count).copy()
     cnt2[:G] = res_count.astype(cnt2.dtype)
+    # n_zones is the PADDED zone axis (bucket lattice); the residual quota
+    # covers only the real zones — padded columns keep their prepared IBIG
+    nz = min(max(len(problem.zones), 1), res_quota.shape[1])
     q2 = np.asarray(inputs.quota).copy()
-    q2[:G, :] = np.clip(res_quota[:, :n_zones], 0, np.iinfo(q2.dtype).max).astype(q2.dtype)
+    q2[:G, :nz] = np.clip(
+        res_quota[:, :nz], 0, np.iinfo(q2.dtype).max
+    ).astype(q2.dtype)
     # existing slots are OFF: with E > 0 the incumbent's existing placements
     # are pinned by the caller — the residual may only open new nodes
     ex_off = np.zeros_like(np.asarray(inputs.ex_valid))

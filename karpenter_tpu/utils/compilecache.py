@@ -17,12 +17,13 @@ from __future__ import annotations
 import os
 
 
-def enable_compilation_cache() -> bool:
+def enable_compilation_cache(path: str = None) -> bool:
     """Point JAX at a persistent on-disk compile cache. Returns True when the
-    cache was enabled."""
+    cache was enabled. ``path`` (the ``aot_cache_dir`` setting) overrides the
+    environment/default resolution."""
     if os.environ.get("KARPENTER_TPU_COMPILE_CACHE", "").lower() in ("off", "0", "false"):
         return False
-    path = os.environ.get("KARPENTER_TPU_COMPILE_CACHE_DIR") or os.path.join(
+    path = path or os.environ.get("KARPENTER_TPU_COMPILE_CACHE_DIR") or os.path.join(
         os.path.expanduser("~"), ".cache", "karpenter_tpu", "xla"
     )
     try:

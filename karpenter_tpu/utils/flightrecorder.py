@@ -110,6 +110,9 @@ class CapsuleBuilder:
         self._inputs: Optional[Dict] = None
         self._outputs: Dict = {}
         self._digests: List[str] = []
+        # per-digest executable-cache record ({bucket, hit} or None for
+        # host-backend / untracked solves), aligned with _digests
+        self._aot: List[Optional[Dict]] = []
         self._batch_order: Optional[List[str]] = None
         self._anomalies: List[str] = []
         self._meta: Dict = {}
@@ -212,11 +215,25 @@ class CapsuleBuilder:
         recorded (possibly delta) encode — PR 3's equivalence contract."""
         self._batch_order = list(names)
 
-    def add_digest(self, digest_hex: str) -> None:
+    def add_digest(self, digest_hex: str, stats: Optional[Dict] = None) -> None:
         """One per solver round (the pool cascade / ICE re-solves may run
-        several); byte-compared against the replayed sequence."""
+        several); byte-compared against the replayed sequence. ``stats`` (the
+        SolveResult's) additionally records the executable-cache bucket the
+        kernel dispatched on and whether it was resident — forensics for the
+        cold-solve story, NEVER part of the replay match verdict: a replaying
+        process may hit or cold-compile the bucket and must produce the same
+        bytes either way."""
         if digest_hex:
             self._digests.append(digest_hex)
+            aot = None
+            if stats is not None and (
+                "aot_bucket" in stats or "aot_hit" in stats
+            ):
+                aot = {
+                    "bucket": stats.get("aot_bucket"),
+                    "hit": bool(stats["aot_hit"]) if "aot_hit" in stats else None,
+                }
+            self._aot.append(aot)
 
     def note_anomaly(self, trigger: str) -> None:
         if trigger not in self._anomalies:
@@ -294,6 +311,14 @@ class CapsuleBuilder:
             "outputs": {
                 **self._outputs,
                 "problem_digests": list(self._digests),
+                # executable-cache forensics (bucket + hit/miss per solve);
+                # absent when no solve carried AOT stats, and excluded from
+                # every replay comparison — cache state is not an input
+                **(
+                    {"aot_solves": list(self._aot)}
+                    if any(a is not None for a in self._aot)
+                    else {}
+                ),
                 "decisions": [r.to_dict() for r in self._decision_tee.records],
                 "error": f"{type(error).__name__}: {error}" if error else None,
             },

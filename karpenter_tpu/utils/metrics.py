@@ -412,6 +412,16 @@ PATTERN_SAVINGS = Counter(
     help="Cumulative $/hr saved by pattern-generated plans over the baseline plan.",
     registry=REGISTRY,
 )
+# AOT executable cache (solver/jax_solver.py AOTCache): bucketed kernel
+# executables served/compiled/evicted — the cold-solve amortization layer
+AOT_CACHE_EVENTS = Counter(
+    "karpenter_tpu_aot_cache_events_total",
+    help="Kernel executable-cache events, labeled by event: hit (dispatch "
+         "served by a resident bucket executable), miss (bucket not "
+         "resident), compile (an executable was built — or loaded from the "
+         "on-disk compilation cache), evict (LRU capacity eviction).",
+    registry=REGISTRY,
+)
 # incremental reconcile encoding (solver/session.py EncodeSession)
 ENCODE_MODE = Counter(
     "karpenter_tpu_encode_mode_total",

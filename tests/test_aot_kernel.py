@@ -1,0 +1,374 @@
+"""ISSUE 9 suite: bucketed shape padding + the AOT executable cache.
+
+Two contracts under test:
+
+* **Bucket-padding equivalence** — a problem solved on a LARGER bucket
+  (every padded axis inflated: groups, options, existing slots, zones, new
+  slots) must produce the same cost AND the same placements as on its
+  natural bucket. Padding is provably inert, so novel group structures can
+  land on an already-compiled executable without changing a single answer.
+* **Executable-cache lifecycle** — LRU capacity eviction, hit/miss/compile
+  accounting, donate-variant separation, per-bucket dispatch EWMA, and the
+  replay independence of cache state (a kernel-backend round replays
+  byte-identical whether the replaying process hits or cold-compiles).
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+import pytest
+
+from karpenter_tpu.api import (
+    ObjectMeta,
+    Node,
+    PodAffinityTerm,
+    Resources,
+    TopologySpreadConstraint,
+)
+from karpenter_tpu.api import labels as wk
+from karpenter_tpu.api.settings import Settings
+from karpenter_tpu.solver import TPUSolver, encode
+from karpenter_tpu.solver import jax_solver as J
+from karpenter_tpu.solver.encode import ExistingNode
+from karpenter_tpu.solver.solver import validate_counts
+
+from helpers import make_pod, make_pods, make_provisioner, setup as _setup
+
+
+# ---------------------------------------------------------------------------
+# padded-bucket == unpadded equivalence (property)
+# ---------------------------------------------------------------------------
+
+
+def _random_problem(seed: int):
+    """Small problems with varied constraint shapes (plain / spread /
+    anti-affinity / existing capacity), all landing on the same natural
+    buckets so the property sweep compiles a handful of executables, not
+    one per seed."""
+    rng = np.random.default_rng(seed)
+    provs = _setup(6)
+    pods = []
+    n_groups = int(rng.integers(1, 5))
+    cpus = ["100m", "250m", "500m", "1"]
+    for gi in range(n_groups):
+        n = int(rng.integers(2, 9))
+        kw = {"cpu": cpus[int(rng.integers(0, len(cpus)))], "labels": {"app": f"a{gi}"}}
+        kind = int(rng.integers(0, 4))
+        if kind == 1:
+            kw["spread"] = [TopologySpreadConstraint(
+                max_skew=1, topology_key=wk.ZONE, label_selector={"app": f"a{gi}"}
+            )]
+        elif kind == 2:
+            kw["affinity"] = [PodAffinityTerm(
+                {"app": f"a{gi}"}, wk.HOSTNAME, anti=True
+            )]
+        elif kind == 3:
+            kw["node_selector"] = {wk.ZONE: ["zone-a", "zone-b"][gi % 2]}
+        pods.extend(make_pods(n, prefix=f"s{seed}g{gi}", **kw))
+    existing = []
+    if seed % 2:
+        bound = make_pod(name=f"s{seed}-bound", labels={"app": "a0"})
+        node = Node(
+            meta=ObjectMeta(name=f"s{seed}-ex", labels={wk.ZONE: "zone-a"}),
+            allocatable=Resources(cpu=8, memory="16Gi", pods=40),
+        )
+        existing = [ExistingNode(
+            node=node, remaining=Resources(cpu=8, memory="16Gi", pods=40),
+            pods=(bound,),
+        )]
+    return encode(pods, provs, existing=existing)
+
+
+def _kernel_raw(solver, problem, bucket=None):
+    """Run the fused kernel through an explicit AOT bucket executable and
+    unpack the raw outputs — the lowest level at which equivalence can be
+    asserted before decode."""
+    import jax
+    import jax.numpy as jnp
+
+    (inputs, orders, alphas, looks, rsvs, swaps, s_new, n_zones) = (
+        solver._prepare(problem, bucket=bucket)
+    )
+    key = J.BucketKey(
+        G=inputs.count.shape[0], O=inputs.price.shape[0],
+        E=inputs.ex_valid.shape[0], S=s_new, Z=n_zones,
+        R=inputs.demand.shape[1], K=orders.shape[0],
+    )
+    exe = J.AOT_CACHE.compile(key)
+    buf = np.asarray(exe(
+        jax.tree.map(jnp.asarray, inputs), jnp.asarray(orders),
+        jnp.asarray(alphas), jnp.asarray(looks), jnp.asarray(rsvs),
+        jnp.asarray(swaps),
+    ))
+    out = J.unpack_solve_fused(
+        buf, orders.shape[0], s_new, inputs.count.shape[0],
+        inputs.ex_valid.shape[0], orders, swaps,
+    )
+    return out
+
+
+def _placement_digest(solver, problem, out):
+    order, unplaced, costs, exhausted, new_opt, new_active, ys = out
+    assert validate_counts(problem, order, new_opt, new_active, ys) == []
+    result = solver._decode(problem, order, new_opt, new_active, ys)
+    new_nodes = sorted(
+        (n.option.instance_type.name, n.option.zone, n.option.capacity_type,
+         tuple(sorted(n.pod_names)))
+        for n in result.new_nodes
+    )
+    ex = sorted((k, tuple(sorted(v))) for k, v in result.existing_assignments.items())
+    return (round(float(result.cost), 9), new_nodes, ex,
+            tuple(sorted(result.unschedulable)), int(unplaced))
+
+
+def _natural_key(solver, problem):
+    (inputs, orders, *_rest, s_new, n_zones) = solver._prepare(problem)
+    return J.BucketKey(
+        G=inputs.count.shape[0], O=inputs.price.shape[0],
+        E=inputs.ex_valid.shape[0], S=s_new, Z=n_zones,
+        R=inputs.demand.shape[1], K=orders.shape[0],
+    )
+
+
+class TestBucketPaddingEquivalence:
+    @pytest.mark.parametrize("seed", range(5))
+    def test_inflated_bucket_solve_identical(self, seed):
+        """Cost and placement digest are invariant to the bucket a problem
+        is padded onto — every padded axis doubled at once."""
+        problem = _random_problem(seed)
+        s = TPUSolver(portfolio=4)
+        natural = _natural_key(s, problem)
+        base = _kernel_raw(s, problem)  # natural bucket
+        inflated = natural._replace(
+            G=natural.G * 2, O=natural.O * 2,
+            E=64 if natural.E == 1 else natural.E * 2,
+            Z=natural.Z * 2, S=natural.S * 2,
+        )
+        big = _kernel_raw(s, problem, bucket=inflated)
+        assert _placement_digest(s, problem, base) == _placement_digest(s, problem, big)
+
+    def test_zone_axis_padding_inert(self):
+        """Zone-spread quotas with the zone axis padded far past the real
+        zones: the padded IBIG columns must not absorb or strand anything."""
+        pods = make_pods(
+            9, prefix="zspread", cpu="250m", labels={"app": "z"},
+            spread=[TopologySpreadConstraint(
+                max_skew=1, topology_key=wk.ZONE, label_selector={"app": "z"}
+            )],
+        )
+        problem = encode(pods, _setup(6))
+        s = TPUSolver(portfolio=4)
+        natural = _natural_key(s, problem)
+        base = _kernel_raw(s, problem)
+        wide = _kernel_raw(s, problem, bucket=natural._replace(Z=natural.Z * 4))
+        assert _placement_digest(s, problem, base) == _placement_digest(s, problem, wide)
+
+
+# ---------------------------------------------------------------------------
+# AOT cache lifecycle (stubbed compiles — no XLA)
+# ---------------------------------------------------------------------------
+
+
+class _StubLowered:
+    def __init__(self, tag):
+        self.tag = tag
+
+    def compile(self):
+        return ("exe", self.tag)
+
+
+class _StubJit:
+    def __init__(self):
+        self.lowered = 0
+
+    def lower(self, *a, **kw):
+        self.lowered += 1
+        return _StubLowered(self.lowered)
+
+
+def _key(**kw):
+    base = dict(G=8, O=8, E=1, S=16, Z=1, R=3, K=4)
+    base.update(kw)
+    return J.BucketKey(**base)
+
+
+@pytest.fixture()
+def stub_cache(monkeypatch):
+    stub = _StubJit()
+    monkeypatch.setattr(J, "_get_jit", lambda donate: stub)
+    cache = J.AOTCache(capacity=2)
+    cache.configure(persist=False)
+    return cache
+
+
+class TestAOTCacheLifecycle:
+    def test_lru_eviction_and_recompile(self, stub_cache):
+        k1, k2, k3 = _key(), _key(G=16), _key(G=32)
+        stub_cache.compile(k1)
+        stub_cache.compile(k2)
+        assert stub_cache.get(k1) is not None  # bumps k1 most-recent
+        stub_cache.compile(k3)  # capacity 2: evicts k2 (LRU), not k1
+        assert stub_cache.stats["evictions"] == 1
+        assert stub_cache.get(k2) is None
+        assert stub_cache.get(k1) is not None
+        assert stub_cache.get(k3) is not None
+        # re-requesting the evicted bucket recompiles (counted)
+        before = stub_cache.stats["compiles"]
+        stub_cache.compile(k2)
+        assert stub_cache.stats["compiles"] == before + 1
+
+    def test_hit_miss_accounting(self, stub_cache):
+        k = _key()
+        assert stub_cache.get(k) is None
+        assert stub_cache.stats["misses"] == 1
+        stub_cache.compile(k)
+        assert stub_cache.get(k) is not None
+        assert stub_cache.stats["hits"] == 1
+        assert stub_cache.ready(k)
+
+    def test_donate_variant_is_a_distinct_entry(self, stub_cache):
+        k = _key()
+        stub_cache.compile(k)
+        assert not stub_cache.ready(k, donate=True)
+        stub_cache.compile(k, donate=True)
+        assert stub_cache.ready(k, donate=True)
+        assert stub_cache.stats["compiles"] == 2
+
+    def test_compile_idempotent(self, stub_cache):
+        k = _key()
+        e1 = stub_cache.compile(k)
+        e2 = stub_cache.compile(k)
+        assert e1 is e2
+        assert stub_cache.stats["compiles"] == 1
+
+    def test_dispatch_ewma_feeds_prediction(self, stub_cache):
+        k = _key()
+        assert stub_cache.predicted_dispatch_s(k) is None
+        stub_cache.compile(k)
+        stub_cache.note_dispatch(k, 0.010)
+        assert stub_cache.predicted_dispatch_s(k) == pytest.approx(0.010)
+        stub_cache.note_dispatch(k, 0.020)
+        p = stub_cache.predicted_dispatch_s(k)
+        assert 0.010 < p < 0.020  # EWMA, not last-sample
+
+    def test_background_warm_drains(self, stub_cache):
+        keys = [_key(), _key(G=16)]
+        queued = stub_cache.warm(keys)
+        assert queued == 2
+        assert stub_cache.wait_idle(timeout=30)
+        # capacity is 2: both resident, no evictions
+        assert stub_cache.ready(keys[0]) and stub_cache.ready(keys[1])
+        # re-warming ready keys queues nothing
+        assert stub_cache.warm(keys) == 0
+
+    def test_capacity_shrink_evicts(self, stub_cache):
+        stub_cache.compile(_key())
+        stub_cache.compile(_key(G=16))
+        stub_cache.configure(capacity=1)
+        assert stub_cache.stats["evictions"] == 1
+        assert len(stub_cache.stats_dict()["buckets"]) == 1
+
+
+class TestSolverAOTIntegration:
+    def test_kernel_stats_carry_bucket_and_hit(self):
+        problem = _random_problem(0)
+        s = TPUSolver(portfolio=4)
+        r1 = s._solve_kernel(problem)
+        assert r1.stats["aot_bucket"].startswith("g")
+        # the property sweep above compiled this bucket already in-process;
+        # whatever the first call saw, a repeat MUST be a hit
+        r2 = s._solve_kernel(problem)
+        assert r2.stats["aot_hit"] == 1.0
+        assert r2.cost == r1.cost
+
+    def test_donated_dispatch_same_answer_and_repeatable(self):
+        pods = make_pods(10, prefix="don", cpu="250m")
+        provs = _setup(6)
+        p_a, p_b = encode(pods, provs), encode(pods, provs)
+        plain = TPUSolver(portfolio=4)
+        donating = TPUSolver(portfolio=4, aot_donate=True)
+        r_plain = plain._solve_kernel(p_a)
+        r1 = donating._solve_kernel(p_b)
+        # donation must not change the answer...
+        assert r1.cost == r_plain.cost
+        # ...and a REPEAT dispatch re-stages consumed buffers cleanly
+        r2 = donating._solve_kernel(p_b)
+        assert r2.cost == r1.cost
+
+    def test_race_admission_uses_bucket_ewma(self):
+        problem = _random_problem(0)
+        s = TPUSolver(portfolio=4, latency_budget_s=0.1)
+        # the admission consults the MESH-RESOLVED variant (conftest gives
+        # this process 8 virtual devices, so the solver resolves a mesh)
+        mesh = s._ensure_mesh()
+        key = s._bucket_key(problem)
+        J.AOT_CACHE.compile(key, mesh=mesh)
+        # a bucket measured fast races even when the process RTT probe is bad
+        J.AOT_CACHE.note_dispatch(key, 0.001, mesh=mesh)
+        type(s)._device_rtt_s = float("inf")
+        try:
+            assert s._race_dispatch_affordable(problem) is True
+            # a bucket measured slower than the budget refuses the race
+            for _ in range(20):
+                J.AOT_CACHE.note_dispatch(key, 10.0, mesh=mesh)
+            assert s._race_dispatch_affordable(problem) is False
+        finally:
+            type(s)._device_rtt_s = None
+
+
+# ---------------------------------------------------------------------------
+# replay byte-identity across cache states
+# ---------------------------------------------------------------------------
+
+
+class TestReplayCacheIndependence:
+    def test_kernel_round_replays_identical_cold_and_warm(self):
+        """A kernel-backend provisioning round must replay byte-identical
+        whether the replaying process cold-compiles the bucket or hits it —
+        executable-cache state is not an input."""
+        from karpenter_tpu.cloudprovider import FakeCloudProvider, generate_catalog
+        from karpenter_tpu.controllers.provisioning import ProvisioningController
+        from karpenter_tpu.replay import replay_capsule
+        from karpenter_tpu.state import Cluster
+        from karpenter_tpu.utils.flightrecorder import FLIGHT
+
+        FLIGHT.configure(8)
+        FLIGHT.clear()
+        try:
+            cluster = Cluster()
+            provider = FakeCloudProvider(catalog=generate_catalog(n_types=20))
+            # quality budget: the race is a deterministic cost comparison
+            # (no wall-clock deadline), so record and replay agree whatever
+            # the machine load or cache state. This shape (one deployment
+            # burst, 20 types) is one the kernel's lump/mixed search
+            # reproducibly wins on cost — the round IS kernel-backend.
+            solver = TPUSolver(portfolio=8, latency_budget_s=30.0)
+            controller = ProvisioningController(
+                cluster, provider, solver=solver,
+                settings=Settings(batch_idle_duration=0, batch_max_duration=0),
+            )
+            cluster.add_provisioner(make_provisioner())
+            for p in make_pods(500, prefix="aotrp", cpu="250m", memory="512Mi"):
+                cluster.add_pod(p)
+            result = controller.reconcile()
+            assert result.bound and not result.unschedulable
+            capsule = json.loads(json.dumps(FLIGHT.latest("provisioning"), default=str))
+            assert capsule["outputs"]["problem_digests"]
+            # the capsule records the executable-cache forensics per solve
+            aot_solves = capsule["outputs"].get("aot_solves")
+            assert aot_solves is not None and len(aot_solves) == len(
+                capsule["outputs"]["problem_digests"]
+            )
+
+            J.AOT_CACHE.clear()  # replay 1: bucket cold — compiles inline
+            cold = replay_capsule(capsule, solver="tpu-quality")
+            warm = replay_capsule(capsule, solver="tpu-quality")  # replay 2: hit
+            assert cold["match"] is True
+            assert warm["match"] is True
+            assert cold["replayed"]["problem_digests"] == warm["replayed"]["problem_digests"]
+            assert cold["replayed"].get("placements") == warm["replayed"].get("placements")
+        finally:
+            FLIGHT.configure(32)
+            FLIGHT.clear()

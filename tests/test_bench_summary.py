@@ -15,6 +15,9 @@ import subprocess
 import sys
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(ROOT, "hack"))
+
+import bench_artifact  # noqa: E402  (hack/bench_artifact.py)
 
 
 def test_dry_run_last_stdout_line_is_json_summary():
@@ -35,6 +38,80 @@ def test_dry_run_last_stdout_line_is_json_summary():
     assert "flightrecorder_overhead_pct" in summary
     assert "flightrecorder_within_budget" in summary
     assert "decision_overhead_pct" in summary
+    # the ISSUE-9 AOT fields ride the summary
+    assert "kernel_cold_ms" in summary
+    assert "kernel_warm_ms" in summary
+    assert "aot_cache_hits" in summary
     # every stdout line is valid JSON on its own (no partial fragments)
     for ln in lines:
         json.loads(ln)
+    # and the artifact writer round-trips the real output: parsed == summary
+    artifact = bench_artifact.build_artifact(
+        9, "bench --dry-run", proc.returncode, proc.stdout + proc.stderr
+    )
+    assert artifact["parsed"] == summary
+    assert json.loads(json.dumps(artifact))["parsed"] == summary
+
+
+class TestArtifactWriter:
+    """hack/bench_artifact.py round-trip (ISSUE 9 satellite): the parse must
+    survive both historical failure modes — a giant detail line overflowing
+    the tail window, and non-JSON noise trailing the summary on the combined
+    stream (BENCH_r03-r05 ``"parsed": null``)."""
+
+    def _combined(self):
+        detail = json.dumps({"metric": "m", "details": {f"k{i}": i for i in range(2000)}})
+        assert len(detail) > bench_artifact.TAIL_BYTES  # overflows the window
+        summary = json.dumps({"metric": "m", "value": 1.5, "summary": True})
+        noise = "E0000 00:00 xla_teardown.cc:12] device handle released"
+        return detail, summary, noise
+
+    def test_giant_detail_line_plus_trailing_noise(self):
+        detail, summary, noise = self._combined()
+        out = "WARNING: platform experimental\n" + detail + "\n" + summary + "\n" + noise + "\n"
+        artifact = bench_artifact.build_artifact(3, "cmd", 0, out)
+        assert artifact["parsed"] == json.loads(summary)
+        assert len(artifact["tail"]) <= bench_artifact.TAIL_BYTES
+        # the artifact itself round-trips through strict JSON
+        assert json.loads(json.dumps(artifact, allow_nan=False))["parsed"]["summary"] is True
+
+    def test_seed_era_detail_only_output_degrades_to_last_object(self):
+        # no summary line at all (the r01/r02 world): the last parseable
+        # JSON object line is still recovered when it fits...
+        obj = json.dumps({"metric": "m", "value": 2.0})
+        artifact = bench_artifact.build_artifact(1, "cmd", 0, "warn\n" + obj + "\n")
+        assert artifact["parsed"] == json.loads(obj)
+
+    def test_fragment_only_tail_yields_null_not_garbage(self):
+        # a tail-window fragment of a huge line must not parse to nonsense
+        detail, _, _ = self._combined()
+        artifact = bench_artifact.build_artifact(
+            5, "cmd", 0, detail[len(detail) // 2:] + "\n"
+        )
+        assert artifact["parsed"] is None
+
+    def test_nan_token_line_is_rejected_as_non_strict(self):
+        bad = '{"value": NaN, "summary": true}'
+        good = json.dumps({"value": 1.0, "summary": True})
+        artifact = bench_artifact.build_artifact(7, "cmd", 0, good + "\n" + bad + "\n")
+        # the NaN line is skipped; the strict summary above it is recovered
+        assert artifact["parsed"] == json.loads(good)
+
+    def test_end_to_end_subprocess_write(self, tmp_path):
+        fake = tmp_path / "fakebench.py"
+        fake.write_text(
+            "import json, sys\n"
+            "print(json.dumps({'details': {str(i): i for i in range(1500)}}))\n"
+            "print(json.dumps({'value': 3.0, 'summary': True}))\n"
+            "print('trailing teardown noise', file=sys.stderr)\n"
+        )
+        out = tmp_path / "BENCH_rt.json"
+        proc = subprocess.run(
+            [sys.executable, os.path.join(ROOT, "hack", "bench_artifact.py"),
+             "--out", str(out), "--n", "9", "--cmd", f"{sys.executable} {fake}"],
+            capture_output=True, text=True, timeout=60,
+        )
+        assert proc.returncode == 0, proc.stderr
+        artifact = json.loads(out.read_text())
+        assert artifact["n"] == 9 and artifact["rc"] == 0
+        assert artifact["parsed"] == {"value": 3.0, "summary": True}

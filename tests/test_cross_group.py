@@ -186,14 +186,10 @@ class TestReviewRegressions:
         """The async race path unpacks _device_inputs' full tuple; an arity
         mismatch would be swallowed by its blanket except and silently kill
         the TPU race forever (round-4 review finding)."""
-        import threading
-
         pods = make_pods(20, cpu="250m")
         problem = encode(pods, provs)
         s = TPUSolver()
-        done = threading.Thread(target=lambda: None)
-        done.start(); done.join()
-        s._warmed_problems[id(problem)] = (problem, done)
+        s.warm_problem(problem)  # bucket executable resident
         out = s._dispatch_async(problem)
         assert out is not None, "dispatch failed — race path dead"
         buf = out[0]

@@ -271,7 +271,12 @@ class TestSimilarWarmStart:
         # and at 5000 pods the encode alone eats ~60ms of the default 100ms
         # budget — the ~25ms margin left for the transfer path made the
         # assertion a scheduler-noise coin flip on a loaded box
-        solver = TPUSolver(portfolio=4, latency_budget_s=0.8)
+        solver = TPUSolver(portfolio=4, latency_budget_s=0.8, aot_precompile=False)
+        # pin the HOST transfer path: with the AOT bucket cache a suite-warmed
+        # executable can answer inside this budget and legitimately win the
+        # race, which would serve a kernel result instead of the transferred
+        # plan this test exists to exercise
+        solver._dispatch_async = lambda pr: None
         self._learn(solver, pods, provs)
         # fresh batch, one extra pod: new problem object, similar content
         pods2 = make_pods(5000, cpu="250m", memory="512Mi") + [
@@ -306,7 +311,9 @@ class TestSimilarWarmStart:
         # (and for the same reason): the 5001-pod encode eats most of the
         # default 100ms budget, making the transfer-path assertion a
         # scheduler-noise coin flip — this test pins behavior, not latency
-        solver = TPUSolver(portfolio=4, latency_budget_s=0.8)
+        solver = TPUSolver(portfolio=4, latency_budget_s=0.8, aot_precompile=False)
+        # pin the HOST transfer path (see test_transfers_to_similar_batch)
+        solver._dispatch_async = lambda pr: None
         learned = self._learn(solver, split_batch(), provs)
         assert learned.G >= 2  # labels split the same shape into two groups
         res = solver.solve_pods(split_batch(extra=1), provs)

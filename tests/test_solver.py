@@ -265,13 +265,8 @@ class TestRaceBreaker:
     kernel race forever — the breaker goes half-open and re-probes on a clock."""
 
     def _solver_with_warm_done(self, problem):
-        import threading
-
         s = TPUSolver()
-        done = threading.Thread(target=lambda: None)
-        done.start()
-        done.join()
-        s._warmed_problems[id(problem)] = (problem, done)  # warm phase complete
+        s.warm_problem(problem)  # bucket executable resident: warm phase done
         return s
 
     def test_open_breaker_reprobes_after_interval(self, provs, monkeypatch):
@@ -309,10 +304,10 @@ class TestRaceBreaker:
             def __array__(self, *a, **k):
                 raise RuntimeError("decode aborted by test")
 
-        dispatched = (ReadyBuf(), np.zeros((2, 3), np.int32), np.zeros((2, 3), np.int32),
-                      4, 3, None)
         import time as _t
 
+        dispatched = (ReadyBuf(), np.zeros((2, 3), np.int32), np.zeros((2, 3), np.int32),
+                      4, 3, None, s._bucket_key(problem), _t.perf_counter())
         s._poll_dispatch(problem, dispatched, deadline=_t.perf_counter() + 1.0,
                          host_cost=1.0)
         assert s._race_fails == 0  # a device that answers re-closes the breaker
@@ -326,10 +321,11 @@ class TestRaceBreaker:
             def is_ready(self):
                 return False
 
-        dispatched = (NeverReady(), np.zeros((2, 3), np.int32),
-                      np.zeros((2, 3), np.int32), 4, 3, None)
         import time as _t
 
+        dispatched = (NeverReady(), np.zeros((2, 3), np.int32),
+                      np.zeros((2, 3), np.int32), 4, 3, None,
+                      s._bucket_key(problem), _t.perf_counter())
         assert s._poll_dispatch(problem, dispatched,
                                 deadline=_t.perf_counter() + 0.01,
                                 host_cost=1.0) is None
@@ -391,9 +387,10 @@ class TestRaceMissMemory:
             def is_ready(self):
                 return False
 
-        dispatched = (NeverReady(), np.zeros((1, 1)), None, 4, 1, None)
         import time as _t
 
+        dispatched = (NeverReady(), np.zeros((1, 1)), None, 4, 1, None,
+                      s._bucket_key(problem), _t.perf_counter())
         s._poll_dispatch(problem, dispatched, deadline=_t.perf_counter(), host_cost=1.0)
         assert problem.__dict__.get("_race_kernel_lost", False) is False
         assert problem.__dict__["_race_miss_count"] == 1
