@@ -27,6 +27,13 @@ floor:
   COLD_SOLVE_MS end to end (acceptance scale: 50k under ``--full``; 20k in
   the gate), and the kernel backend must win at least one race scenario on
   BOTH axes — cost AND wall-clock — with zero constraint violations.
+* ``soak`` (ISSUE 11): the scaled chaos soak (sustained churn over the
+  real-HTTP stack incl. one operator SIGKILL+restart and one apiserver
+  restart) must finish with ZERO invariant violations — which covers the
+  memory-slope ceiling, pod-ready p99, zero stuck pods, zero duplicate
+  launches, zero orphans — every dumped anomaly capsule must replay
+  byte-identically offline, and the scenario itself must have churned
+  enough (events/s floor, both restart kinds) to mean anything.
 
 Usage:  python hack/check_bench_regression.py [--full]
         (--full runs the acceptance-scale 50k/160 configuration)
@@ -57,6 +64,19 @@ MIN_CELL_SPEEDUP = 2.0
 #: fresh-batch cold solve (warm process, changed batch) end-to-end budget —
 #: the ROADMAP item-1 acceptance number
 COLD_SOLVE_MS = 100.0
+#: soak: absolute floor on achieved churn. The acceptance target is 1k
+#: events/s on driver-class hardware; the scenario box-calibrates its rate
+#: (a sustainable fraction of measured apiserver ingest, capped at 1k) and
+#: the gate requires achieving at least half of THAT plus this absolute
+#: floor — below either, the soak churned too little to mean anything
+#: (vacuousness guard, not the bar)
+SOAK_EVENTS_PER_S_FLOOR = 100.0
+#: soak: memory-slope ceiling (bytes/second), post-warmup, per incarnation.
+#: 512 KiB/s catches the target failure class (unbounded queues/rings run
+#: at MB/s under churn) while clearing the decelerating warmup ramp a
+#: scaled window cannot fully exclude; the hours-long CLI run gates at
+#: 64 KiB/s.
+SOAK_MEM_SLOPE_BPS = 524_288.0
 
 
 def run_checks(full: bool = False) -> list:
@@ -89,13 +109,23 @@ def run_checks(full: bool = False) -> list:
         race_topo_50k = None
     race = bench.bench_kernel_race()
     race_topo = bench.bench_kernel_race_topology()
+    # the chaos soak arm: acceptance-length (>=60 s churn) either way — the
+    # scenario is already the scaled version of the hours-long CLI run; the
+    # budgets are the monitor's defaults (its violations list is the gate).
+    # 75 s (not the bare 60) keeps the post-kill incarnation's memory window
+    # comfortably past the leak detector's warmup + min-span rules.
+    soak = bench.bench_soak(
+        duration_s=75.0 if not full else 90.0,
+        mem_slope_budget_bps=SOAK_MEM_SLOPE_BPS,
+    )
     print(json.dumps({
         "delta_reconcile": delta, "consolidation_sweep": sweep,
         "spot_churn": churn, "cell_decompose": cells,
         "cold_solve": cold, "kernel_race": race,
         "kernel_race_topology": race_topo,
         "kernel_race_topology_50k": race_topo_50k,
-    }))
+        "soak": soak,
+    }, default=str))
 
     if delta.get("encode_speedup", 0.0) < MIN_DELTA_SPEEDUP:
         failures.append(
@@ -200,6 +230,44 @@ def run_checks(full: bool = False) -> list:
             failures.append(
                 f"{label} produced {r.get('violations')} constraint violations"
             )
+    # -- chaos soak gate (ISSUE 11) ------------------------------------------
+    if soak.get("invariant_violations", 1) != 0:
+        failures.append(
+            f"soak tripped {soak.get('invariant_violations')} invariant(s): "
+            f"{soak.get('violations')}"
+        )
+    if not soak.get("replay_all_matched", False):
+        failures.append(
+            "soak anomaly capsules did not all replay byte-identically: "
+            f"{soak.get('replay')}"
+        )
+    if soak.get("mem_slope_bytes_per_s", 1e18) > SOAK_MEM_SLOPE_BPS:
+        failures.append(
+            f"soak memory slope {soak.get('mem_slope_kib_per_s')} KiB/s over "
+            f"the {SOAK_MEM_SLOPE_BPS / 1024:.0f} KiB/s ceiling"
+        )
+    # vacuousness guards: the soak must have actually churned, actually
+    # killed+revived the operator, actually bounced the apiserver, and the
+    # leak detector must have had at least one qualifying window to judge
+    restarts = soak.get("restarts", {})
+    rate_floor = max(SOAK_EVENTS_PER_S_FLOOR, 0.5 * soak.get("rate_hz", 0.0))
+    if soak.get("events_per_s", 0.0) < rate_floor:
+        failures.append(
+            f"soak churned only {soak.get('events_per_s')} events/s "
+            f"(floor {round(rate_floor, 1)} = max({SOAK_EVENTS_PER_S_FLOOR}, "
+            f"half the calibrated {soak.get('rate_hz')}/s target)) — the "
+            "scenario regressed, the gate is vacuous"
+        )
+    if restarts.get("operator_kill", 0) < 1 or restarts.get("apiserver", 0) < 1:
+        failures.append(
+            f"soak exercised too little chaos (restarts={restarts}) — it "
+            "must include >=1 operator SIGKILL and >=1 apiserver restart"
+        )
+    if soak.get("mem_segments", 0) < 1:
+        failures.append(
+            "soak leak detector had no qualifying memory window "
+            "(mem_segments=0) — lengthen the run, the slope arm is vacuous"
+        )
     return failures
 
 

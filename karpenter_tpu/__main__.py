@@ -26,8 +26,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "and Prometheus connect to the pod IP, not loopback)")
     p.add_argument("--leader-elect", action="store_true",
                    help="enable leader election before running loops")
-    p.add_argument("--leader-elect-lease", default="/tmp/karpenter-tpu-leader",
-                   help="lease file path for leader election")
+    p.add_argument("--leader-elect-lease", default=None,
+                   help="lease file path for leader election (default: the "
+                        "leader_election_lease_path setting, so a ConfigMap-"
+                        "configured shared-volume path survives the flag)")
     p.add_argument("--log-level", default="INFO")
     p.add_argument("--log-format", choices=("console", "json"), default="console")
     p.add_argument("--batch-idle-duration", type=float, default=None)
@@ -98,6 +100,7 @@ def main(argv=None) -> int:
             args.cluster_endpoint,
             retry_policy=retry_policy_from_settings(settings),
             breakers=breaker_set_from_settings("apiserver", settings),
+            queue_capacity=settings.watch_queue_capacity,
         )
     op = Operator.new(provider=ctx.provider, settings=ctx.settings, cluster=cluster)
     cluster_api = None
@@ -140,30 +143,44 @@ def main(argv=None) -> int:
             recorder=op.recorder,
         ).start()
 
-    if args.leader_elect:
+    # leader election comes from the CLI flag OR the settings surface
+    # (settings.leader_election_enabled — the ConfigMap/env path HA
+    # deployments use). The lease path: an EXPLICIT --leader-elect-lease
+    # wins, otherwise the setting — the flag's old built-in default must not
+    # shadow a ConfigMap-configured shared-volume path, or every replica
+    # elects on its own node-local /tmp file (split-brain, the exact
+    # duplicate-launch failure the soak audits).
+    leader_elect = args.leader_elect or ctx.settings.leader_election_enabled
+    if leader_elect:
         from .utils.leaderelection import LeaderElector
 
+        lease_path = (
+            args.leader_elect_lease or ctx.settings.leader_election_lease_path
+        )
         # on_lost=stop.set: a deposed leader must stop reconciling, not just
         # flip /readyz — two live reconcilers is split-brain (the reference's
         # controller-runtime exits the process on lost leadership)
         elector = LeaderElector(
-            args.leader_elect_lease,
+            lease_path,
             lease_duration=args.leader_lease_duration,
             renew_interval=args.leader_renew_interval,
             on_lost=stop.set,
         )
-        kv(log, logging.INFO, "waiting for leadership", lease=args.leader_elect_lease)
+        kv(log, logging.INFO, "waiting for leadership", lease=lease_path)
         if not elector.acquire(stop=stop):
             if http_server is not None:
                 http_server.stop()
             return 0  # stopped before becoming leader
         kv(log, logging.INFO, "became leader", identity=elector.identity)
+        # hand the lease to the operator: its ordered close() releases it
+        # BEFORE the port drops, so a SIGTERM'd leader hands over at once
+        op.elector = elector
 
     try:
         op.run(stop, tick=args.tick, http_server=http_server)
     finally:
         if elector is not None:
-            elector.release()
+            elector.release()  # idempotent after op.close() released it
         if cluster_api is not None:
             cluster_api.stop()
         if cluster is not None:

@@ -133,6 +133,10 @@ class Settings:
     # background pre-compile pool: warm the likely-next shape buckets
     # (observed shape distribution from the encode session + pattern ring)
     # off the reconcile thread, so a novel batch lands on a built executable.
+    # Also gates the race path's cold-bucket background builds — false means
+    # NO speculative executable compiles at all (the host path answers novel
+    # shapes; the chaos soak runs this way so compile-arena growth cannot
+    # mask a real leak).
     aot_precompile_enabled: bool = True
     # donate problem-tensor device buffers on kernel dispatch: XLA reuses
     # the input allocation for outputs, cutting the device round-trip on
@@ -140,6 +144,24 @@ class Settings:
     # leave off when the workload re-solves identical problems through the
     # device path (race memory usually absorbs those either way).
     aot_donate_inputs: bool = False
+    # leader election (utils/leaderelection.py): when enabled the operator
+    # blocks on the lease before running reconcile loops and releases it on
+    # clean shutdown, so a standby replica takes over within the lease TTL.
+    # The CLI --leader-elect flag ORs with this setting; the lease path must
+    # point at storage every replica shares (see deploy/render.py HA notes).
+    leader_election_enabled: bool = False
+    leader_election_lease_path: str = "/tmp/karpenter-tpu-leader"
+    # watch-intake backpressure (state/httpcluster.py): bound on the
+    # fetched-but-unapplied informer event queue. Under sustained lag the
+    # applier widens its batch window and coalesces per-object; overflowing
+    # the bound sheds the queue and relists (cost O(cluster), memory O(1))
+    # instead of growing without bound. Surfaced as
+    # karpenter_tpu_backpressure_events_total{action}.
+    watch_queue_capacity: int = 8192
+    # cadence of the machine garbage-collection / orphan-adoption loop
+    # (reference: 5m). Soak/chaos runs shrink it so instances orphaned by an
+    # operator crash are adopted or collected within the run.
+    garbage_collect_interval: float = 300.0
 
     def validate(self) -> None:
         if not self.cluster_name:
@@ -196,6 +218,14 @@ class Settings:
             )
         if self.aot_cache_capacity < 1:
             raise ValueError("aotCacheCapacity must be >= 1")
+        if self.leader_election_enabled and not self.leader_election_lease_path:
+            raise ValueError(
+                "leaderElectionLeasePath is required when leader election is enabled"
+            )
+        if self.watch_queue_capacity < 1:
+            raise ValueError("watchQueueCapacity must be >= 1")
+        if self.garbage_collect_interval <= 0:
+            raise ValueError("garbageCollectInterval must be > 0")
 
     # -- config system (reference: karpenter-global-settings ConfigMap,
     # settings.go:40-93; env/flag ingestion in the operator bootstrap) -------

@@ -199,6 +199,13 @@ class CloudHTTPService:
         # while the first attempt is still in flight (EC2 client-token
         # semantics; see run_instances)
         self._launch_tokens: Dict[str, str] = {}
+        # append-only reservation log: every COMMITTED launch as
+        # (client_token, instance_id, unix_time). Unlike _launch_tokens
+        # (pruned at terminate) this survives the instance, so the chaos
+        # soak's duplicate-launch audit can prove that no client token —
+        # across retries, operator crashes and leader failovers — ever
+        # committed two instances (see launch_audit()).
+        self.launch_log: List[Tuple[str, str, float]] = []
         self.insufficient_capacity_pools: set = set()
         self.request_log: List[str] = []  # endpoint per backend call
         self._counter = 0
@@ -287,6 +294,7 @@ class CloudHTTPService:
                     self.instances[iid] = inst
                     if token:
                         self._launch_tokens[token] = iid
+                    self.launch_log.append((token, iid, time.time()))
                     self._publish()
                 return _instance_to_dict(inst)
             except Exception:
@@ -321,6 +329,27 @@ class CloudHTTPService:
                     # fresh retry with the same token can attempt again
                     if self._launch_tokens.get(token) == _PENDING:
                         self._launch_tokens.pop(token)
+
+    def launch_audit(self) -> Dict:
+        """Duplicate-launch audit over the reservation log: a client token
+        that committed MORE than one instance is a broken idempotency
+        contract — a retry, crash-restart or leader failover launched twice
+        for one logical decision. The chaos soak's invariant monitor calls
+        this at settle and requires ``duplicate_tokens`` empty."""
+        with self._lock:
+            log = list(self.launch_log)
+        by_token: Dict[str, set] = {}
+        for token, iid, _ in log:
+            if token:
+                by_token.setdefault(token, set()).add(iid)
+        return {
+            "launches": len(log),
+            "tokens": len(by_token),
+            "untokened": sum(1 for t, _, _ in log if not t),
+            "duplicate_tokens": {
+                t: sorted(ids) for t, ids in by_token.items() if len(ids) > 1
+            },
+        }
 
     def terminate(self, body: Dict) -> Dict:
         results = []
@@ -370,8 +399,14 @@ class CloudHTTPService:
                             for k in (body or {}).get("overrides", [])
                         ],
                     }
+                elif fault.status == 0:
+                    # connection-level fault (Fault docs: status 0 = no
+                    # response at all): the HTTP layer drops the connection
+                    # without writing a reply, so the client exercises its
+                    # true connection-error classification path, not a 503
+                    return 0, {}
                 else:
-                    return (fault.status or 503), {"error": fault.reason}
+                    return fault.status, {"error": fault.reason}
         if path == "/v1/instance-types":
             return 200, {
                 "catalog_version": len(self.request_log),
@@ -474,6 +509,11 @@ class CloudHTTPService:
                     status, out = service.handle(path, body)
                     if span is not None:
                         span.attrs["status"] = status
+                if status == 0:
+                    # scripted connection-level fault: drop the connection
+                    # with no response (the client sees a socket error)
+                    self.close_connection = True
+                    return
                 payload = json.dumps(out).encode()
                 self.send_response(status)
                 self.send_header("Content-Type", "application/json")

@@ -158,10 +158,14 @@ class DeprovisioningController:
         cap = FLIGHT.begin("deprovisioning")
         self._capsule = cap
         self._planned_this_round = None
+        # quiesce for the whole pass (see provisioning.reconcile): remote
+        # watch events applying between the capsule's pre-execution capture
+        # and the sweep's cluster reads would break offline replay
         try:
-            action = self._reconcile()
-            if cap is not None and cap.captured:
-                cap.set_outputs_action(action, planned=self._planned_this_round)
+            with self.cluster.quiesce():
+                action = self._reconcile()
+                if cap is not None and cap.captured:
+                    cap.set_outputs_action(action, planned=self._planned_this_round)
         except BaseException as e:
             # finish() must ALWAYS run (it releases the builder's thread-
             # local decision tee), whatever escapes the pass

@@ -30,11 +30,17 @@ class GarbageCollectionController:
         provider: CloudProvider,
         recorder: Optional[Recorder] = None,
         clock: Optional[Clock] = None,
+        min_age_s: float = MIN_AGE_SECONDS,
     ):
         self.cluster = cluster
         self.provider = provider
         self.recorder = recorder or Recorder()
         self.clock = clock or Clock()
+        # too-young-to-collect guard (reference: 1 minute): an instance whose
+        # launch RPC just returned may not have its Machine written yet —
+        # crash-recovery tests shrink this to exercise orphan collection
+        # without waiting out the minute
+        self.min_age_s = min_age_s
 
     def reconcile(self) -> dict:
         """One GC pass: adopt linkable instances, collect orphaned ones.
@@ -64,7 +70,7 @@ class GarbageCollectionController:
                 self.recorder.publish("Linked", f"adopted instance {pid}",
                                       object_name=machine.name, object_kind="Machine")
                 continue
-            if age < MIN_AGE_SECONDS:
+            if age < self.min_age_s:
                 continue  # too young: launch may still be registering
             orphans.append(machine)
         # one batched TerminateInstances call for the whole orphan sweep

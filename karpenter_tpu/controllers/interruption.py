@@ -35,6 +35,7 @@ counterfactuals against repriced pool risk.
 
 from __future__ import annotations
 
+import contextlib
 import json
 import threading
 import time
@@ -315,18 +316,23 @@ class InterruptionController:
             ]
         self._round_actions = []
         try:
-            if cap is not None:
-                self._capture_inputs(cap, messages)
-            victims: List[str] = []
-            acted_adv = self._advance_rebalances(victims)
-            handled, acted_msgs = self._process(messages, victims)
-            if acted_adv or acted_msgs:
-                # ONE drain pass for the whole batch (delete_node marks
-                # nodes; the termination finalizer serializes the work)
-                self.termination.reconcile()
-                self._notify_provisioning(victims)
-            if cap is not None and cap.captured:
-                cap.set_outputs_rebalance(self._sorted_actions())
+            # quiesce capsule rounds (see provisioning.reconcile): remote
+            # watch events between input capture and the round's cluster
+            # reads would make the recorded action list irreproducible
+            with (self.cluster.quiesce() if cap is not None
+                  else contextlib.nullcontext()):
+                if cap is not None:
+                    self._capture_inputs(cap, messages)
+                victims: List[str] = []
+                acted_adv = self._advance_rebalances(victims)
+                handled, acted_msgs = self._process(messages, victims)
+                if acted_adv or acted_msgs:
+                    # ONE drain pass for the whole batch (delete_node marks
+                    # nodes; the termination finalizer serializes the work)
+                    self.termination.reconcile()
+                    self._notify_provisioning(victims)
+                if cap is not None and cap.captured:
+                    cap.set_outputs_rebalance(self._sorted_actions())
         except BaseException as e:
             if cap is not None:
                 cap.finish(error=e)
@@ -442,11 +448,14 @@ class InterruptionController:
         if pods:
             self.provisioning.note_interrupted(pods)
 
-    def close(self) -> None:
+    def close(self, wait: bool = False) -> None:
         """Release the worker pool (the operator calls this on shutdown; the
-        watch ref pins this controller, so threads won't die with GC)."""
+        watch ref pins this controller, so threads won't die with GC).
+        ``wait=True`` joins in-flight workers first — the operator's ordered
+        SIGTERM shutdown uses it so no drain mutates state mid-teardown;
+        the retry policy's total deadline bounds how long that can take."""
         if self._pool is not None:
-            self._pool.shutdown(wait=False)
+            self._pool.shutdown(wait=wait)
             self._pool = None
 
     def _instance_id_map(self) -> Dict[str, str]:

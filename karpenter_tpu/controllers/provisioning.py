@@ -63,8 +63,37 @@ class MachineNameSeq:
     def peek(self) -> int:
         return self._n
 
+    def advance_past(self, n: int) -> None:
+        """Never emit a value <= n again. Crash-restart re-adoption: a fresh
+        operator process starts this sequence at 1, but the cluster it
+        relists may already hold ``<prov>-<N>`` machines/nodes from the
+        previous incarnation — re-minting those names silently REPLACES the
+        live objects (a new machine steals an old node's identity, the old
+        instance leaks as an orphan). The controller seeds the sequence past
+        every adopted name before its first launch."""
+        with self._lock:
+            self._n = max(self._n, n + 1)
+
 
 _machine_ids = MachineNameSeq()
+
+
+def seed_machine_names(cluster, seq: Optional[MachineNameSeq] = None) -> int:
+    """Advance the machine-name sequence past every ``...-<N>`` machine or
+    node name the (re)listed cluster already holds. Called at controller
+    construction — after an operator crash the relisted store IS the previous
+    incarnation's state, and name collisions there corrupt identity (see
+    MachineNameSeq.advance_past). Returns the floor applied."""
+    best = 0
+    with cluster._lock:
+        names = list(cluster.machines) + list(cluster.nodes)
+    for name in names:
+        tail = name.rsplit("-", 1)[-1]
+        if tail.isdigit():
+            best = max(best, int(tail))
+    if best:
+        (seq or _machine_ids).advance_past(best)
+    return best
 
 
 class PodBatcher:
@@ -157,7 +186,11 @@ class ProvisioningController:
         if self.settings.spot_enabled:
             self.solver.risk_penalty = self.settings.interruption_penalty_cost
         # machine-name sequence; the replay harness pins a private one to
-        # the recorded capsule's snapshot so launched-node names reproduce
+        # the recorded capsule's snapshot so launched-node names reproduce.
+        # Seed the process-global sequence past names the cluster already
+        # holds: a crash-restarted operator relists its predecessor's
+        # machines, and re-minting their names steals live identities.
+        seed_machine_names(cluster)
         self.machine_ids: Optional[MachineNameSeq] = None
         self._pending_seen: set = set()
         # delta-aware encoder state: watch events below feed its dirty sets,
@@ -214,8 +247,27 @@ class ProvisioningController:
         # batch generation (that would void reset() and busy-loop reconciles).
         if event == "RESYNCED":
             # cache relist (HTTPCluster watch-gone recovery): individual
-            # events may have been skipped — incremental state is suspect
+            # events may have been skipped — incremental state is suspect.
+            # The arrival-dedup set resets too: a DELETE the relist absorbed
+            # (shed-and-relist backpressure, apiserver restart) would leave a
+            # stale name that silently swallows note_arrival for a LATER pod
+            # re-created under the same name — its batch window then never
+            # arms and the pod waits on the slow retry poll.
+            self._pending_seen.clear()
+            # machines another incarnation launched during the outage are in
+            # the relisted cache now; the name floor must move past them
+            seed_machine_names(self.cluster, self.machine_ids)
             self._intake.mark_structural("relist")
+            return
+        if event in ("ADDED", "MODIFIED") and isinstance(obj, (Machine, Node)):
+            # name-floor maintenance for HA standbys: while this replica
+            # waits for leadership its informer streams the LEADER'S
+            # launches — on takeover the sequence must already be past them
+            # or the first launch steals a live machine's name (the boot-time
+            # seed only covered construction-time state)
+            tail = obj.meta.name.rsplit("-", 1)[-1]
+            if tail.isdigit():
+                (self.machine_ids or _machine_ids).advance_past(int(tail))
             return
         if not isinstance(obj, Pod) or obj.is_daemonset:
             return
@@ -258,22 +310,30 @@ class ProvisioningController:
         with span("provisioning.reconcile"):
             # flight-recorder capsule: inputs captured inside _reconcile
             # (before the first solve), outputs + anomaly triggers stamped
-            # here; an idle round that captured nothing is dropped silently
+            # here; an idle round that captured nothing is dropped silently.
+            # The WHOLE round runs under cluster.quiesce(): against an
+            # HTTP-backed cluster, remote watch events landing between the
+            # capsule's input capture and the encoder's reads would make the
+            # recorded digest irreproducible offline (they queue in the
+            # bounded intake instead — the soak's churn proved this race
+            # fires constantly at production event rates).
             cap = FLIGHT.begin("provisioning")
-            if cap is None:
-                return self._reconcile(None)
-            try:
-                result = self._reconcile(cap)
-                if cap.captured:
-                    cap.set_outputs_provisioning(result, self.cluster)
-            except BaseException as e:
-                # finish() must ALWAYS run (it releases the builder's
-                # thread-local decision tee) — including for BaseExceptions
-                # like KeyboardInterrupt that the operator loop survives
-                cap.finish(error=e)
-                raise
-            cap.finish()
-            return result
+            with self.cluster.quiesce():
+                if cap is None:
+                    return self._reconcile(None)
+                try:
+                    result = self._reconcile(cap)
+                    if cap.captured:
+                        cap.set_outputs_provisioning(result, self.cluster)
+                except BaseException as e:
+                    # finish() must ALWAYS run (it releases the builder's
+                    # thread-local decision tee) — including for
+                    # BaseExceptions like KeyboardInterrupt that the
+                    # operator loop survives
+                    cap.finish(error=e)
+                    raise
+                cap.finish()
+                return result
 
     def _reconcile(self, cap=None) -> ProvisioningResult:
         t0 = time.perf_counter()
@@ -1390,8 +1450,25 @@ class ProvisioningController:
         (gang/diversification strips, ICE retries) encode the shrunken batch
         immediately, and an async informer delivering the MODIFIED event a
         beat late would desync the session into a full-encode fallback.
-        The later watch event collapses idempotently in pod_event."""
-        self.cluster.bind_pod(pod_name, node_name)
+        The later watch event collapses idempotently in pod_event.
+
+        A pod DELETED between solve and bind (deploy scale-down racing the
+        round — constant under soak churn) surfaces as a 404/KeyError from
+        the bind: that pod simply no longer needs placing. Swallowing it
+        keeps the round's REMAINING binds and launches; aborting the whole
+        reconcile for one vanished pod cost every sibling its placement and
+        a kit backoff (the chaos soak hit this as a reconcile-error storm)."""
+        try:
+            self.cluster.bind_pod(pod_name, node_name)
+        except KeyError:
+            return  # in-process store: pod gone
+        except RuntimeError as e:
+            if "404" in str(e):
+                # HTTP-mode not-found; retire it from the session too — the
+                # DELETED watch event may have been consumed pre-quiesce
+                self._pending_seen.discard(pod_name)
+                return
+            raise
         pod = self.cluster.pods.get(pod_name)
         if pod is not None:
             self._intake.pod_event("DELETED", pod)

@@ -53,6 +53,11 @@ class Operator:
     # state-observability scrapers (controllers/metricsscraper): periodic
     # cluster-state -> gauge controllers on the operator loop
     scrapers: List[object] = field(default_factory=list)
+    # leader elector (utils/leaderelection.py) adopted from the entrypoint:
+    # close() releases the lease as part of the ordered shutdown so a
+    # SIGTERM'd leader hands over immediately instead of making the standby
+    # wait out the lease TTL (only SIGKILL should cost the TTL)
+    elector: Optional[object] = None
 
     @staticmethod
     def new(
@@ -240,18 +245,74 @@ class Operator:
             self.close()
 
     def close(self) -> None:
-        """Release held resources (HTTP port, interruption worker pool).
-        run() calls this on exit; step()-driven code (tests, simulations)
-        should call it too — the cluster watch pins controllers against GC,
-        so an unclosed worker pool outlives the operator object."""
+        """Ordered shutdown. run() calls this on exit; step()-driven code
+        (tests, simulations) should call it too — the cluster watch pins
+        controllers against GC, so an unclosed worker pool outlives the
+        operator object.
+
+        The ordering is the SIGTERM contract the chaos soak exercises
+        (SIGKILL skips all of it — that's the crash-restart path):
+
+        1. join in-flight controller worker threads (the interruption
+           pool) so no reconcile work mutates state mid-teardown;
+        2. drain ``SerialBackground`` compile work (a worker killed inside
+           an XLA compile can corrupt the on-disk compilation cache a
+           restarted operator would then trust);
+        3. flush pending flight-recorder anomaly dumps — the post-mortem
+           evidence must hit disk before the process is gone;
+        4. release the leader lease so a standby takes over NOW, not after
+           the lease TTL;
+        5. LAST, release the HTTP port — probes stay answerable until the
+           process truly has nothing left to report, and a crashed loop
+           must never keep serving ready probes (or block a supervised
+           restart with EADDRINUSE).
+
+        Every step is individually guarded: a failure in one must not skip
+        the rest (previously only the port release was guarded) — and the
+        whole sequence sits in a try/finally so even a BaseException (a
+        second Ctrl-C landing while a step joins workers) cannot leave a
+        dead loop serving ready probes or holding the port against a
+        supervised restart."""
+        import logging
+
+        from .utils.logging import get_logger, kv
+
+        log = get_logger("operator")
+
+        def step(name, fn):
+            # guarded but NEVER silent: a failure in the step that preserves
+            # post-mortem evidence (flush_dumps) or hands over leadership
+            # (lease release — the standby otherwise waits out the TTL)
+            # must be visible in the logs, or the ordered-shutdown contract
+            # is unverifiable
+            try:
+                fn()
+            except Exception as e:
+                kv(log, logging.WARNING, "shutdown step failed",
+                   step=name, error=f"{type(e).__name__}: {e}")
+
+        def _drain_compiles():
+            from .solver.solver import _join_warm_threads
+
+            _join_warm_threads()
+
+        def _flush_capsules():
+            from .utils.flightrecorder import FLIGHT
+
+            FLIGHT.flush_dumps()
+
         try:
-            # ALWAYS release the port — a crashed loop must not keep serving
-            # ready probes (or block a supervised restart with EADDRINUSE)
+            if self.interruption is not None:
+                step("join-interruption-workers",
+                     lambda: self.interruption.close(wait=True))
+            step("drain-background-compiles", _drain_compiles)
+            step("flush-flightrecorder-dumps", _flush_capsules)
+            if self.elector is not None:
+                step("release-leader-lease", self.elector.release)
+        finally:
+            # ALWAYS release the port, whatever the steps above did
             if getattr(self, "http_server", None) is not None:
                 self.http_server.stop()
-        finally:
-            if self.interruption is not None:
-                self.interruption.close()
 
     def _run_loop(self, stop: threading.Event, tick: float) -> None:
         from .controllers.kit import SingletonController
@@ -308,7 +369,8 @@ class Operator:
         controllers.append(SingletonController("drift", self.drift.reconcile, interval=300.0))
         controllers.append(
             SingletonController(
-                "garbagecollect", self.garbagecollect.reconcile, interval=300.0
+                "garbagecollect", self.garbagecollect.reconcile,
+                interval=self.settings.garbage_collect_interval,
             )
         )
         # idle-window GC maintenance: run the full collection while idle (NOT

@@ -275,10 +275,23 @@ class CapsuleCloudProvider:
     ``ice-failed`` decisions) are pre-seeded into the fake's ICE pools, so
     the same launch fails, the same in-round re-solve runs, and the
     refreshed round-N catalog is the recorded round-0 catalog plus exactly
-    those masks — the same delta the live provider served."""
+    those masks — the same delta the live provider served.
+
+    TRANSIENT launch failures replay too (the chaos soak's RPC fault bursts
+    flushed this out): a recorded round whose launches died on exhausted
+    retries left its pods unschedulable, and a replay that launches them
+    happily is a false DIVERGED. Machine names are minted once per spec
+    (``launch_from_spec``) and the capsule pins the machine sequence, so the
+    recorded ``new_nodes`` name set identifies exactly which creates
+    committed — when the recorded round carried a ``launch-failed``
+    nomination, any create whose machine name is NOT in that set raises
+    ``TransientCloudError`` (unless its pinned pool is ICE-masked, which
+    must keep raising ICE so the re-solve cascade replays unchanged)."""
 
     def __new__(cls, capsule: Dict):
+        from .api import labels as wk
         from .cloudprovider.fake import FakeCloudProvider
+        from .cloudprovider.interface import TransientCloudError
         from .cloudprovider.types import instance_type_from_wire
 
         per_prov: Dict[str, list] = {}
@@ -288,8 +301,40 @@ class CapsuleCloudProvider:
             per_prov[pname] = types
             for it in types:
                 union.setdefault(it.name, it)
+        outputs = capsule.get("outputs", {})
+        committed_names = {
+            n.get("name") for n in (outputs.get("new_nodes") or [])
+            if n.get("name")
+        }
+        had_launch_failures = capsule.get("controller") == "provisioning" and any(
+            d.get("kind") == "nomination" and d.get("outcome") == "launch-failed"
+            for d in outputs.get("decisions", [])
+        )
+
+        def _pinned(machine, key):
+            values = sorted(getattr(machine.requirements.get(key), "values", []) or [])
+            return values[0] if len(values) == 1 else None
 
         class _Provider(FakeCloudProvider):
+            def create(self, machine):
+                if had_launch_failures and machine.meta.name not in committed_names:
+                    # a create the recorded round did NOT commit: reproduce
+                    # its transient failure — unless the pinned pool is
+                    # ICE-masked, where super() must keep raising
+                    # InsufficientCapacityError (the re-solve cascade path)
+                    it = _pinned(machine, wk.INSTANCE_TYPE)
+                    zone = _pinned(machine, wk.ZONE)
+                    ct = _pinned(machine, wk.CAPACITY_TYPE)
+                    masked = (
+                        it is not None and zone is not None
+                        and self.unavailable_offerings.is_unavailable(it, zone, ct or "")
+                    )
+                    if not masked:
+                        raise TransientCloudError(
+                            "recorded launch failure (replayed: this machine "
+                            "name is absent from the capsule's new_nodes)"
+                        )
+                return super().create(machine)
             def get_instance_types(self, provisioner=None):
                 key = provisioner.name if provisioner is not None else None
                 base = per_prov.get(key) if key is not None else list(union.values())
@@ -519,10 +564,30 @@ def replay_capsule(
             if k in recorded
         },
     }
+    # a crashed round (anomaly reconcile-error) committed its capsule from
+    # the EXCEPTION path: inputs + the digests/decisions recorded up to the
+    # crash are real, but the round-result outputs (placements,
+    # unschedulable, actions) were never set. The replay completes the round
+    # the crash cut short, so the verdict compares the recorded PREFIX —
+    # recorded digests must be a byte-identical prefix of the replayed
+    # stream — and skips the absent result sections instead of failing a
+    # completed replay against None.
+    truncated = (
+        recorded.get("error") is not None
+        and "placements" not in recorded
+        and "action" not in recorded
+        and "rebalance_actions" not in recorded
+    )
+    report["truncated_by_error"] = truncated
     diffs: Dict = {}
     if controller_kind == "provisioning":
         rec_digests = recorded.get("problem_digests", [])
-        diffs["digests_match"] = rec_digests == replayed["problem_digests"]
+        if truncated:
+            diffs["digests_match"] = (
+                replayed["problem_digests"][: len(rec_digests)] == rec_digests
+            )
+        else:
+            diffs["digests_match"] = rec_digests == replayed["problem_digests"]
         rec_place = {
             pod: _placement_key(e)
             for pod, e in (recorded.get("placements") or {}).items()
@@ -551,12 +616,18 @@ def replay_capsule(
         rec_keys = _decision_keys(recorded.get("decisions", []))
         rep_keys = _decision_keys(replayed.get("decisions", []))
         diffs["decisions_match"] = rec_keys == rep_keys
-        report["match"] = (
-            diffs["digests_match"]
-            and diffs["placements_match"]
-            and diffs["unschedulable_match"]
-            and diffs["gang_deferred_match"]
-        )
+        if truncated:
+            # only the digest prefix is comparable; result sections and the
+            # decision multiset (a prefix of an unordered set is not
+            # checkable) never existed on the recorded side
+            report["match"] = diffs["digests_match"]
+        else:
+            report["match"] = (
+                diffs["digests_match"]
+                and diffs["placements_match"]
+                and diffs["unschedulable_match"]
+                and diffs["gang_deferred_match"]
+            )
     elif controller_kind == "rebalance":
         # rebalance rounds compare the full ordered action list — pool,
         # replacement offering AND replacement node name (the machine-name
@@ -568,12 +639,12 @@ def replay_capsule(
         rec_keys = _decision_keys(recorded.get("decisions", []))
         rep_keys = _decision_keys(replayed.get("decisions", []))
         diffs["decisions_match"] = rec_keys == rep_keys
-        report["match"] = diffs["rebalance_actions_match"]
+        report["match"] = True if truncated else diffs["rebalance_actions_match"]
     else:
         rec_action = recorded.get("action") or recorded.get("planned")
         rep_action = replayed.get("action") or replayed.get("planned")
         diffs["action_match"] = _actions_equal(rec_action, rep_action)
-        report["match"] = diffs["action_match"]
+        report["match"] = True if truncated else diffs["action_match"]
     report["diffs"] = diffs
     return report
 
@@ -862,6 +933,9 @@ def _print_summary(report: Dict) -> None:
         else ("DIVERGED" if not report["counterfactual"] else "—")
     )
     print(f"{mode} of capsule {report['capsule_id']} ({report['controller']}): {verdict}")
+    if report.get("truncated_by_error"):
+        print("  (recorded round crashed mid-reconcile: verdict compares the "
+              "recorded prefix; result sections below never existed recorded-side)")
     diffs = report.get("diffs", {})
     if report["controller"] == "provisioning":
         rec = report.get("recorded", {})

@@ -1,0 +1,34 @@
+"""Chaos soak subsystem (ROADMAP item 5).
+
+``bench.py`` measures isolated rounds; this package measures the system
+under SUSTAINED load with injected process failures — the harness that can
+falsify every prior PR's machinery at once. Three parts:
+
+* :mod:`~karpenter_tpu.soak.churn` — ``ChurnScript``, the deterministic
+  seedable timeline DSL unifying FaultPlan + InterruptionSchedule under one
+  RNG and one injected clock;
+* :mod:`~karpenter_tpu.soak.harness` — ``SoakHarness``/``run_soak``, driving
+  the full real-HTTP stack (apiserver + cloud services, operator as a
+  separate killable process) through the timeline;
+* :mod:`~karpenter_tpu.soak.monitor` — ``InvariantMonitor``, the
+  continuously-asserted regression oracle (pod-ready p99, loop lag, memory
+  slope, zero stuck pods, zero duplicate launches, zero orphans, and
+  byte-identical offline replay of every dumped anomaly capsule).
+
+Scaled (~60–90 s) entry points: the ``soak`` bench scenario and the
+slow-marked ``tests/test_soak.py``; full length:
+``python -m karpenter_tpu.soak --duration 3600``.
+"""
+
+from .churn import ChurnEvent, ChurnScript
+from .harness import SoakConfig, SoakHarness, run_soak
+from .monitor import InvariantMonitor
+
+__all__ = [
+    "ChurnEvent",
+    "ChurnScript",
+    "InvariantMonitor",
+    "SoakConfig",
+    "SoakHarness",
+    "run_soak",
+]

@@ -1085,8 +1085,15 @@ class TPUSolver(Solver):
         if exe is None:
             # compile off the critical path: the AOT worker serializes XLA
             # compiles process-wide, so a compile storm can't abort the
-            # runtime, and THIS solve's budget is never spent compiling
-            AOT_CACHE.warm([key], donate=self._donate(), mesh=mesh)
+            # runtime, and THIS solve's budget is never spent compiling.
+            # Gated on the SAME policy as the hint-driven prewarm: with
+            # aot_precompile off the operator asked for NO speculative
+            # executable builds — under sustained churn every novel bucket
+            # otherwise queues a tens-of-MB compile (the soak's leak
+            # detector read that ramp as MB/s of growth), and the host path
+            # answers these solves either way.
+            if self.aot_precompile:
+                AOT_CACHE.warm([key], donate=self._donate(), mesh=mesh)
             return None
         if self._race_fails >= 3:
             # the device hasn't answered inside the budget (tunneled,
@@ -1105,7 +1112,8 @@ class TPUSolver(Solver):
                 # earlier exhaustion ladder: that bucket must be resident too
                 exe = AOT_CACHE.get(grown, donate=self._donate(), mesh=mesh)
                 if exe is None:
-                    AOT_CACHE.warm([grown], donate=self._donate(), mesh=mesh)
+                    if self.aot_precompile:  # same speculative-build policy
+                        AOT_CACHE.warm([grown], donate=self._donate(), mesh=mesh)
                     return None
                 key = grown
             t_dispatch = time.perf_counter()

@@ -86,6 +86,15 @@ class ClusterAPIServer:
     def __init__(self, backing: Optional[Cluster] = None, latency_s: float = 0.0, port: int = 0):
         self.backing = backing or Cluster()
         self.latency_s = latency_s
+        # event-log incarnation token: a fresh listener over the SAME backing
+        # store starts a fresh log whose seqs overlap the old one's range —
+        # a stale bookmark that happens to fall WITHIN the new range would
+        # silently skip events (the ahead-of-log case gets "gone" below, but
+        # a long-disconnected client can reconnect after the new log caught
+        # up). Clients compare this token per poll and relist on change.
+        import uuid as _uuid
+
+        self.incarnation = _uuid.uuid4().hex[:12]
         # The watch log is ordered by a SERVER-assigned sequence number, not
         # the store's resource versions: the store bumps versions under its
         # lock but emits outside it, so two handler threads can deliver
@@ -156,11 +165,27 @@ class ClusterAPIServer:
                 self._log_floor = self._events[0][0] - 1
             self._events_cv.notify_all()
 
-    def _watch(self, since: int, timeout_s: float, cell: Optional[str] = None) -> Dict:
+    def _watch(
+        self,
+        since: int,
+        timeout_s: float,
+        cell: Optional[str] = None,
+        limit: int = 0,
+    ) -> Dict:
+        """``limit`` caps events per response (0 = unlimited): a slow
+        consumer resuming after a stall re-polls for the rest instead of
+        receiving (and JSON-decoding) the entire backlog in one body — the
+        server half of the client's bounded-intake backpressure."""
         deadline = time.monotonic() + timeout_s
         with self._events_cv:
             while True:
-                if since < self._log_floor:
+                if since < self._log_floor or since > self._seq:
+                    # behind the compacted log OR AHEAD of it: a bookmark
+                    # larger than every seq this server ever assigned is
+                    # from a previous server incarnation (listener restart
+                    # over the same backing store resets the log) — without
+                    # the "gone" the client would wait forever for seqs
+                    # that restart at 1 and never reach its bookmark
                     return {"gone": True}
                 # seqs are dense and append-only: O(1) offset, no scan
                 start = (
@@ -178,13 +203,21 @@ class ClusterAPIServer:
                         if not tail:
                             left = deadline - time.monotonic()
                             if left <= 0:
-                                return {"events": [], "bookmark": bookmark}
+                                return {"events": [], "bookmark": bookmark,
+                                        "incarnation": self.incarnation}
                             since = bookmark
                             self._events_cv.wait(timeout=min(left, 0.5))
                             continue
                     else:
                         bookmark = tail[-1][0]
+                    if limit > 0 and len(tail) > limit:
+                        # truncated delivery: the bookmark must stop at the
+                        # last DELIVERED event so the next poll resumes with
+                        # the remainder instead of skipping it
+                        tail = tail[:limit]
+                        bookmark = tail[-1][0]
                     return {
+                        "incarnation": self.incarnation,
                         "bookmark": bookmark,
                         "events": [
                             {
@@ -215,6 +248,7 @@ class ClusterAPIServer:
                     # stream's NEXT poll starts past it instead of
                     # re-filtering the whole shared tail every round-trip
                     return {
+                        "incarnation": self.incarnation,
                         "events": [],
                         "bookmark": (
                             self._events[-1][0]
@@ -237,7 +271,10 @@ class ClusterAPIServer:
             if parts == ["watch"]:
                 since = int(query.get("since", "0"))
                 timeout_s = min(float(query.get("timeout", "10")), 30.0)
-                return 200, self._watch(since, timeout_s, query.get("cell"))
+                limit = max(0, int(query.get("limit", "0")))
+                return 200, self._watch(
+                    since, timeout_s, query.get("cell"), limit=limit
+                )
             if parts == ["version"]:
                 with self.backing._lock:
                     version = self.backing._version
@@ -251,6 +288,7 @@ class ClusterAPIServer:
                 return 200, {
                     "resourceVersion": version,
                     "watchSeq": seq,
+                    "incarnation": self.incarnation,
                     "kindVersions": kind_versions,
                 }
             if not parts or parts[0] != "api" or len(parts) < 2:
@@ -424,6 +462,11 @@ class ClusterAPIServer:
             self._server.server_close()
         if self._thread is not None:
             self._thread.join(timeout=5)
+        # detach from the backing store: a soak restarting the listener over
+        # the same backing builds a FRESH incarnation (new event log, so old
+        # client bookmarks get "gone" and relist); the dead incarnation must
+        # not keep accreting events
+        self.backing.unwatch(self._record_event)
 
 
 def main(argv=None) -> int:  # pragma: no cover - exercised by the HA e2e

@@ -14,6 +14,7 @@ informers without the network layer.
 
 from __future__ import annotations
 
+import contextlib
 import threading
 from collections import defaultdict
 from dataclasses import dataclass
@@ -89,6 +90,28 @@ class Cluster:
     def watch(self, fn: WatchFn) -> None:
         with self._lock:
             self._watchers.append(fn)
+
+    def unwatch(self, fn: WatchFn) -> None:
+        """Detach a watch callback (no-op when absent): a stopped apiserver
+        incarnation must stop feeding its dead event log — a chaos soak
+        restarts the listener over the same backing store, and leaked
+        callbacks would accrete one dead log per restart. Equality, NOT
+        identity: every ``obj.method`` access mints a fresh bound-method
+        object, so an ``is`` comparison against the registration can never
+        match — ``==`` compares (receiver, function), which does."""
+        with self._lock:
+            self._watchers = [w for w in self._watchers if w != fn]
+
+    @contextlib.contextmanager
+    def quiesce(self):
+        """Hold the cluster view stable for one reconcile round. The
+        in-process store needs nothing: controllers and writers share one
+        thread of control per round. ``HTTPCluster`` overrides this to pause
+        its remote-event applier — without it, watch events landing between
+        the flight recorder's input capture and the encoder's reads make the
+        recorded problem digest irreproducible from the capsule (the chaos
+        soak caught exactly that race under sustained churn)."""
+        yield
 
     def _put(self, coll: Dict[str, object], obj, name: str) -> None:
         with self._lock:

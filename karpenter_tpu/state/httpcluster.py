@@ -28,7 +28,8 @@ import threading
 import urllib.error
 import urllib.parse
 import urllib.request
-from typing import Dict, Optional
+from collections import deque
+from typing import Deque, Dict, Optional
 
 from ..api.admission import AdmissionError
 from ..api.codec import KINDS, kind_of, to_wire
@@ -40,7 +41,7 @@ from ..api.objects import (
     PodDisruptionBudget,
     Provisioner,
 )
-from ..utils import tracing
+from ..utils import metrics, tracing
 from .cells import CellIndex
 from ..utils.logging import context_fields, get_logger, kv
 from ..utils.resilience import (
@@ -60,6 +61,19 @@ _COLLECTION_ATTR = {
     "poddisruptionbudgets": "pdbs",
 }
 
+#: intake-queue marker: the applier must run a full relist at this point in
+#: the stream (watch-gone recovery, or a shed). Relists run ONLY on the
+#: applier thread so a relist can never interleave with event application —
+#: a stale queued MODIFIED applied after the relist's cache replace would
+#: resurrect a deleted object.
+_RELIST = object()
+
+#: backpressure tuning: internal constants by design — the one exposed
+#: setting is the capacity bound (settings.watch_queue_capacity)
+_WIDEN_HIGH_FRAC = 0.5   # drained batch above this fraction of capacity = lag
+_WIDEN_AFTER = 3         # consecutive lagged drains before widening engages
+_WIDEN_WINDOW_S = 0.2    # widened accumulate window before a coalesced apply
+
 
 class HTTPCluster(Cluster):
     def __init__(
@@ -70,6 +84,7 @@ class HTTPCluster(Cluster):
         retry_policy: Optional[RetryPolicy] = None,
         breakers: Optional[BreakerSet] = None,
         cell: Optional[str] = None,
+        queue_capacity: int = 8192,
     ):
         super().__init__()
         self.endpoint = endpoint.rstrip("/")
@@ -103,10 +118,38 @@ class HTTPCluster(Cluster):
         # kinds whose server-side version hasn't moved since (no writes ->
         # the local cache plus applied watch events is provably current)
         self._kind_seen: Dict[str, int] = {}
+        # server event-log incarnation adopted at relist: a restarted
+        # listener's fresh log can catch up PAST a stale bookmark, which
+        # the seq-range "gone" check alone cannot detect — a changed token
+        # on any poll forces the relist instead of silently skipping the
+        # new log's earlier events
+        self._server_incarnation: Optional[str] = None
         self._stop = threading.Event()
         self._watch_thread: Optional[threading.Thread] = None
+        self._apply_thread: Optional[threading.Thread] = None
+        # -- bounded watch-event intake (backpressure) ----------------------
+        # The watch thread FETCHES (network) and the applier thread APPLIES
+        # (cache + controller callbacks), decoupled by a bounded queue so an
+        # event storm against a busy consumer degrades deterministically
+        # instead of growing memory without bound: under sustained lag the
+        # applier widens its batch window and coalesces to the newest event
+        # per object; an overflowing queue is shed wholesale and the cache
+        # rebuilt by relist (O(cluster) time, O(1) extra memory). Both
+        # surface as karpenter_tpu_backpressure_events_total{action}.
+        self.queue_capacity = max(int(queue_capacity), 1)
+        self._intake: Deque[object] = deque()
+        self._intake_cv = threading.Condition()
+        self._relist_gen = 0     # bumped by the applier after each relist
+        self._lag_streak = 0     # consecutive lagged drains (applier-only)
+        self._widened = False
+        self._quiesced = 0       # reconcile-round holds (see quiesce())
+        self._applying = False   # applier mid-batch (quiesce waits it out)
         self.relist()
         if watch:
+            self._apply_thread = threading.Thread(
+                target=self._apply_loop, daemon=True
+            )
+            self._apply_thread.start()
             self._watch_thread = threading.Thread(
                 target=self._watch_loop, daemon=True
             )
@@ -218,6 +261,11 @@ class HTTPCluster(Cluster):
         version_info = self._call("GET", "/version")
         bookmark = version_info.get("watchSeq", 0)
         kind_versions = version_info.get("kindVersions", None)
+        # adopt the serving incarnation: per-kind versions stay trustworthy
+        # across a listener restart (they come from the surviving store),
+        # and the bookmark below is re-read from THIS incarnation's log
+        with self._lock:
+            self._server_incarnation = version_info.get("incarnation")
         relisted = False
         try:
             for kind, attr in _COLLECTION_ATTR.items():
@@ -292,7 +340,12 @@ class HTTPCluster(Cluster):
         already absorbed the transient window), logging ONCE at WARN when the
         watch first disconnects — not per iteration — then at DEBUG until it
         recovers. A rejected bookmark (server "gone", k8s 410 semantics)
-        falls back to a full relist, which also re-reads the bookmark."""
+        falls back to a full relist, which also re-reads the bookmark.
+
+        This thread only FETCHES: events land on the bounded intake queue
+        and the applier thread applies them (see __init__). ``limit=`` caps
+        each poll at the queue capacity so one response can never exceed the
+        intake bound on its own."""
         failures = 0
         while not self._stop.is_set():
             try:
@@ -302,10 +355,14 @@ class HTTPCluster(Cluster):
                     else ""
                 )
                 out = self._call(
-                    "GET", f"/watch?since={self._bookmark}&timeout=5{cell_q}"
+                    "GET",
+                    f"/watch?since={self._bookmark}&timeout=5"
+                    f"&limit={self.queue_capacity}{cell_q}",
                 )
                 if out.get("gone"):
-                    self.relist()  # bookmark rejected: full resync
+                    # bookmark rejected: full resync, serialized onto the
+                    # applier thread so it cannot interleave with applies
+                    self._request_relist()
                     continue
             except Exception as e:
                 failures += 1
@@ -321,23 +378,209 @@ class HTTPCluster(Cluster):
                 kv(self._log, logging.INFO, "watch reconnected",
                    after_failures=failures)
                 failures = 0
-            for ev in out.get("events", ()):
-                self._apply_wire(
-                    ev["resourceVersion"], ev["event"], ev["kind"], ev["object"]
-                )
-                with self._lock:
-                    self._bookmark = max(self._bookmark, ev["seq"])
-            # the server's bookmark covers the filtered-out tail of a
-            # per-cell stream (and equals the last event seq otherwise):
-            # advancing to it keeps a quiet cell's poll from rescanning the
-            # whole shared event log every round-trip
+            incarnation = out.get("incarnation")
+            if (
+                incarnation is not None
+                and self._server_incarnation is not None
+                and incarnation != self._server_incarnation
+            ):
+                # restarted listener whose fresh log caught up past our
+                # stale bookmark: the seqs LOOK resumable but belong to a
+                # different history — only a relist is safe (it also adopts
+                # the new incarnation)
+                kv(self._log, logging.WARNING,
+                   "apiserver incarnation changed; relisting",
+                   old=self._server_incarnation, new=incarnation)
+                self._request_relist()
+                continue
+            events = out.get("events", ())
+            if events:
+                self._enqueue_events(events)
+            # bookmarks advance at FETCH time, not apply time: shed (the
+            # only path that loses queued events) always relists, which
+            # re-reads the bookmark — so a fetched-then-shed event can
+            # never be silently skipped. The server's bookmark covers the
+            # filtered-out tail of a per-cell stream (and equals the last
+            # event seq otherwise).
             with self._lock:
+                for ev in events:
+                    self._bookmark = max(self._bookmark, ev["seq"])
                 self._bookmark = max(self._bookmark, out.get("bookmark", 0))
+
+    # -- bounded intake + applier (backpressure) ----------------------------
+    def _enqueue_events(self, events) -> None:
+        with self._intake_cv:
+            if len(self._intake) + len(events) > self.queue_capacity:
+                # overflow: the consumer is hopelessly behind — grinding
+                # through the backlog would cost more than a relist and the
+                # queue must not grow without bound. Shed EVERYTHING
+                # (bookmarks already advanced past these events) and let the
+                # applier rebuild the cache from a list.
+                shed = len(self._intake) + len(events)
+                metrics.BACKPRESSURE_EVENTS.inc({"action": "shed"}, value=shed)
+                kv(self._log, logging.WARNING,
+                   "watch intake overflow; shedding queue and relisting",
+                   shed=shed, capacity=self.queue_capacity)
+                self._intake.clear()
+                self._intake.append(_RELIST)
+            else:
+                self._intake.extend(events)
+            self._intake_cv.notify_all()
+
+    def _request_relist(self) -> None:
+        """Enqueue a relist marker and wait until the applier ran it, so the
+        watch thread's next poll reads the refreshed bookmark."""
+        with self._intake_cv:
+            gen = self._relist_gen
+            self._intake.append(_RELIST)
+            self._intake_cv.notify_all()
+            while self._relist_gen == gen and not self._stop.is_set():
+                self._intake_cv.wait(0.5)
+
+    def _apply_loop(self) -> None:
+        """Single consumer of the intake queue: applies remote events (and
+        runs queued relists) in arrival order. Under sustained lag — the
+        drained batch repeatedly above half the queue bound — it WIDENS the
+        apply batch window: waits a short accumulate window, then coalesces
+        the batch to the newest event per object before applying, trading
+        per-event callback latency for bounded work (the per-object version
+        guard makes dropping superseded intermediates safe; every consumer
+        of these callbacks keys on final object state)."""
+        while True:
+            with self._intake_cv:
+                while (
+                    not self._intake or self._quiesced > 0
+                ) and not self._stop.is_set():
+                    self._intake_cv.wait(0.5)
+                if self._stop.is_set() and not self._intake:
+                    return
+            if self._widened:
+                # widened window: let the storm accumulate so one coalesced
+                # apply replaces many tiny ones
+                self._stop.wait(_WIDEN_WINDOW_S)
+            with self._intake_cv:
+                if self._quiesced > 0 and not self._stop.is_set():
+                    continue  # a round began while we slept: hold the batch
+                batch = list(self._intake)
+                self._intake.clear()
+                n_events = sum(1 for item in batch if item is not _RELIST)
+                if n_events >= self.queue_capacity * _WIDEN_HIGH_FRAC:
+                    self._lag_streak += 1
+                    if self._lag_streak >= _WIDEN_AFTER and not self._widened:
+                        self._widened = True
+                        kv(self._log, logging.WARNING,
+                           "sustained watch lag; widening apply batch window",
+                           batch=n_events, capacity=self.queue_capacity)
+                else:
+                    self._lag_streak = 0
+                    self._widened = False
+                self._applying = True
+            try:
+                self._apply_batch(batch)
+            finally:
+                with self._intake_cv:
+                    self._applying = False
+                    self._intake_cv.notify_all()
+
+    def _apply_batch(self, batch) -> None:
+        pending: list = []
+        for item in batch:
+            if item is _RELIST:
+                self._apply_events(pending)
+                pending = []
+                try:
+                    self.relist()
+                except Exception as e:
+                    # The relist must eventually HAPPEN, not just be
+                    # attempted: on the shed path the bookmark already
+                    # advanced past the dropped events, so a failed relist
+                    # with no retry would silently lose them forever (the
+                    # gone/incarnation paths re-request on the next poll;
+                    # shed has no such second chance). Re-enqueue the
+                    # marker — the brief wait keeps a persistently-down
+                    # server from hot-spinning the applier.
+                    kv(self._log, logging.WARNING,
+                       "queued relist failed; will retry",
+                       error=f"{type(e).__name__}: {e}")
+                    with self._intake_cv:
+                        self._intake.append(_RELIST)
+                    self._stop.wait(0.5)
+                # bump the gen either way: a _request_relist waiter must not
+                # deadlock on a relist that cannot succeed yet (the retry
+                # marker above owns eventual completion)
+                with self._intake_cv:
+                    self._relist_gen += 1
+                    self._intake_cv.notify_all()
+            else:
+                pending.append(item)
+        self._apply_events(pending)
+
+    def _apply_events(self, events) -> None:
+        if not events:
+            return
+        if self._widened and len(events) > 1:
+            # coalesce superseded intermediates to the newest event per
+            # (kind, name) — but NEVER across a DELETED edge: a
+            # delete-then-recreate collapsed to the final ADDED would drop
+            # the delete edge that edge-triggered consumers key on (the
+            # provisioning arrival-dedup set would then swallow the new
+            # pod's batch-window arm). A DELETED terminates the object's
+            # merge slot; later events for the name start a fresh one.
+            out: list = []
+            slot: Dict[tuple, int] = {}
+            for ev in events:
+                key = (ev["kind"], ev["object"]["meta"]["name"])
+                if ev["event"] == "DELETED":
+                    out.append(ev)
+                    slot.pop(key, None)
+                    continue
+                idx = slot.get(key)
+                if idx is None:
+                    slot[key] = len(out)
+                    out.append(ev)
+                else:
+                    out[idx] = ev
+            dropped = len(events) - len(out)
+            if dropped:
+                metrics.BACKPRESSURE_EVENTS.inc(
+                    {"action": "widen"}, value=dropped
+                )
+            events = out
+        for ev in events:
+            self._apply_wire(
+                ev["resourceVersion"], ev["event"], ev["kind"], ev["object"]
+            )
+
+    @contextlib.contextmanager
+    def quiesce(self):
+        """Pause remote-event application for one reconcile round: the
+        flight recorder's input capture and the encoder's cluster reads must
+        see ONE view, or a watch event landing between them makes the
+        capsule's recorded digest irreproducible offline (false DIVERGED —
+        the soak's churn hit this constantly). Events keep FETCHING into the
+        bounded intake queue (backpressure still governs overflow); only
+        application waits. Re-entrant; releasing wakes the applier."""
+        with self._intake_cv:
+            self._quiesced += 1
+            # wait out a batch the applier already popped: its events would
+            # otherwise keep landing after this round thinks the view froze
+            while self._applying and not self._stop.is_set():
+                self._intake_cv.wait(0.5)
+        try:
+            yield
+        finally:
+            with self._intake_cv:
+                self._quiesced -= 1
+                self._intake_cv.notify_all()
 
     def close(self) -> None:
         self._stop.set()
+        with self._intake_cv:
+            self._intake_cv.notify_all()
         if self._watch_thread is not None:
             self._watch_thread.join(timeout=6)
+        if self._apply_thread is not None:
+            self._apply_thread.join(timeout=6)
 
     # -- writes (server first, then read-your-writes cache apply) ------------
     class _InFlight:

@@ -55,11 +55,21 @@ class FaultPlan:
     latency scripts without wall-clock delay).
     """
 
-    def __init__(self, sleep: Callable[[float], None] = time.sleep):
+    def __init__(
+        self,
+        sleep: Callable[[float], None] = time.sleep,
+        clock: Optional[Callable[[], float]] = None,
+    ):
         self._scripts: Dict[str, List[Fault]] = {}
         self._lock = threading.Lock()
         self.sleep = sleep
         self.log: List[Tuple[str, Fault]] = []
+        # shared-clock contract (the soak's ChurnScript injects ONE clock
+        # into every fault surface it unifies): when set, ``timeline``
+        # additionally records (clock(), endpoint, fault) so fired faults
+        # line up against the churn timeline on the same axis
+        self.clock = clock
+        self.timeline: List[Tuple[float, str, Fault]] = []
 
     def script(self, endpoint: str, faults: Sequence[Fault]) -> "FaultPlan":
         with self._lock:
@@ -85,6 +95,8 @@ class FaultPlan:
                 if queue:
                     fault = queue.pop(0)
                     self.log.append((endpoint, fault))
+                    if self.clock is not None:
+                        self.timeline.append((self.clock(), endpoint, fault))
                     return fault
         return None
 
@@ -93,6 +105,18 @@ class FaultPlan:
             if endpoint is not None:
                 return len(self._scripts.get(endpoint, []))
             return sum(len(q) for q in self._scripts.values())
+
+    def clear(self, endpoint: Optional[str] = None) -> int:
+        """Drop un-fired faults (one endpoint's queue, or every queue) and
+        return how many were dropped — chaos scenarios end a scripted outage
+        early (e.g. unblock terminate before restarting a killed operator)
+        without constructing a fresh plan. The firing log is untouched."""
+        with self._lock:
+            if endpoint is not None:
+                return len(self._scripts.pop(endpoint, []))
+            dropped = sum(len(q) for q in self._scripts.values())
+            self._scripts.clear()
+            return dropped
 
 
 def raise_for_fault(fault: Optional[Fault], plan: "FaultPlan", endpoint: str) -> None:
@@ -166,19 +190,28 @@ class InterruptionSchedule:
         self,
         waves: Sequence[ReclaimWave] = (),
         spikes: Sequence[PriceSpike] = (),
+        clock: Optional[Callable[[], float]] = None,
     ):
         self.waves = list(waves)
         self.spikes = list(spikes)
         self.log: List[Tuple[int, object]] = []
+        # same shared-clock contract as FaultPlan: events fired through a
+        # ChurnScript-owned schedule stamp the unified timeline
+        self.clock = clock
+        self.timeline: List[Tuple[float, object]] = []
 
     def waves_for(self, round_no: int) -> List[ReclaimWave]:
         out = [w for w in self.waves if w.round_no == round_no]
         self.log.extend((round_no, w) for w in out)
+        if self.clock is not None:
+            self.timeline.extend((self.clock(), w) for w in out)
         return out
 
     def spikes_for(self, round_no: int) -> List[PriceSpike]:
         out = [s for s in self.spikes if s.round_no == round_no]
         self.log.extend((round_no, s) for s in out)
+        if self.clock is not None:
+            self.timeline.extend((self.clock(), s) for s in out)
         return out
 
     @staticmethod

@@ -529,6 +529,12 @@ class FlightRecorder:
                     return c
         return None
 
+    @staticmethod
+    def _dump_path(capsule_id: str, directory: str) -> str:
+        return os.path.join(
+            directory, f"capsule-{_SAFE_ID.sub('-', capsule_id)}.json.gz"
+        )
+
     def dump(
         self,
         capsule_id: str,
@@ -545,15 +551,40 @@ class FlightRecorder:
         if not directory:
             raise OSError("no flight_recorder_dump_dir configured")
         os.makedirs(directory, exist_ok=True)
-        path = os.path.join(
-            directory, f"capsule-{_SAFE_ID.sub('-', capsule_id)}.json.gz"
-        )
+        path = self._dump_path(capsule_id, directory)
         tmp = path + ".tmp"
         with open(tmp, "wb") as f:
             f.write(payload)
         os.replace(tmp, path)
         metrics.FLIGHTRECORDER_DUMPS.inc({"trigger": trigger})
         return path
+
+    def flush_dumps(self) -> List[str]:
+        """Dump every retained anomaly capsule not already on disk — the
+        commit-time auto-dump can fail silently (full disk) or the dump dir
+        may have been configured after the anomaly fired. The operator's
+        shutdown path calls this BEFORE releasing its ports, so a SIGTERM
+        never loses an anomaly capsule the post-mortem
+        (``python -m karpenter_tpu.replay``) would need. Returns the paths
+        written; a still-unwritable disk yields an empty list, never an
+        exception (shutdown must proceed)."""
+        with self._lock:
+            dump_dir = self.dump_dir
+            pending = [
+                c["id"] for c in self._ring
+                if c.get("anomalies")
+                and dump_dir
+                and not os.path.exists(self._dump_path(c["id"], dump_dir))
+            ]
+        written: List[str] = []
+        for capsule_id in pending:
+            try:
+                path = self.dump(capsule_id, trigger="flush")
+            except OSError:
+                continue
+            if path:
+                written.append(path)
+        return written
 
     def clear(self) -> None:
         with self._lock:

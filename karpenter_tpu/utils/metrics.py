@@ -591,6 +591,24 @@ TRACEMALLOC_TOP = Gauge(
          "when settings.memory_profiling_enabled turns tracemalloc on.",
     registry=REGISTRY,
 )
+PROCESS_START_TIME = Gauge(
+    "karpenter_tpu_process_start_time_seconds",
+    help="Unix timestamp the operator process started (set once at "
+         "runtimehealth install). A changed value between scrapes means the "
+         "scrape target restarted — the soak monitor segments its memory-"
+         "slope regression on it so a restart's RSS reset never reads as a "
+         "negative (or masked) leak.",
+    registry=REGISTRY,
+)
+BACKPRESSURE_EVENTS = Counter(
+    "karpenter_tpu_backpressure_events_total",
+    help="Watch-intake backpressure actions by the informer client "
+         "(state/httpcluster.py), labeled by action: 'widen' counts events "
+         "coalesced away by the widened apply batch window under sustained "
+         "lag; 'shed' counts events dropped when the bounded intake queue "
+         "overflowed and the client fell back to shed-and-relist.",
+    registry=REGISTRY,
+)
 
 # -- event stream ------------------------------------------------------------
 EVENTS_TOTAL = Counter(

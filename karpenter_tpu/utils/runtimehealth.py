@@ -22,6 +22,7 @@ property of the loop, not of a scrape.
 from __future__ import annotations
 
 import os
+import time
 import weakref
 from typing import Optional
 
@@ -37,6 +38,11 @@ _PAGESIZE = os.sysconf("SC_PAGE_SIZE") if hasattr(os, "sysconf") else 4096
 
 #: top-N allocation sites exported (bounded label cardinality)
 TOP_ALLOCATORS = 5
+
+#: process start stamp (exported as karpenter_tpu_process_start_time_seconds;
+#: module import time IS process start for the operator's purposes — restart
+#: detection only needs the value to change across incarnations)
+_START_TIME = time.time()
 
 _memory_profiling = False
 
@@ -134,6 +140,7 @@ def install(
     if registry not in _installed:
         _installed.add(registry)
         registry.add_refresher(_refresh)
+    metrics.PROCESS_START_TIME.set(_START_TIME)
     if cell_bytes is None:
         _cell_bytes_ref = None
     else:

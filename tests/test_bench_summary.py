@@ -42,6 +42,13 @@ def test_dry_run_last_stdout_line_is_json_summary():
     assert "kernel_cold_ms" in summary
     assert "kernel_warm_ms" in summary
     assert "aot_cache_hits" in summary
+    # the ISSUE-11 soak fields ride the summary (null in dry-run: the soak
+    # spawns operator processes and only the slow gate runs it for real)
+    for key in ("soak_events_per_s", "soak_invariant_violations",
+                "soak_pod_ready_p99_s", "soak_mem_slope_kib_per_s",
+                "soak_replay_all_matched", "soak_duplicate_launches"):
+        assert key in summary
+        assert summary[key] is None  # dry-run skips the soak
     # every stdout line is valid JSON on its own (no partial fragments)
     for ln in lines:
         json.loads(ln)
@@ -96,6 +103,25 @@ class TestArtifactWriter:
         artifact = bench_artifact.build_artifact(7, "cmd", 0, good + "\n" + bad + "\n")
         # the NaN line is skipped; the strict summary above it is recovered
         assert artifact["parsed"] == json.loads(good)
+
+    def test_soak_summary_fields_round_trip(self):
+        # ISSUE-11 satellite: a summary carrying the soak fields (including
+        # a boolean verdict and a float slope) survives the artifact writer
+        # byte-for-byte — the soak arm's numbers must reach BENCH_r*.json
+        summary = json.dumps({
+            "metric": "m", "summary": True,
+            "soak_events_per_s": 1042.5,
+            "soak_invariant_violations": 0,
+            "soak_pod_ready_p99_s": 3.211,
+            "soak_mem_slope_kib_per_s": 12.4,
+            "soak_replay_all_matched": True,
+            "soak_duplicate_launches": 0,
+        })
+        artifact = bench_artifact.build_artifact(11, "cmd", 0, summary + "\n")
+        assert artifact["parsed"] == json.loads(summary)
+        rt = json.loads(json.dumps(artifact, allow_nan=False))["parsed"]
+        assert rt["soak_replay_all_matched"] is True
+        assert rt["soak_events_per_s"] == 1042.5
 
     def test_end_to_end_subprocess_write(self, tmp_path):
         fake = tmp_path / "fakebench.py"

@@ -1,0 +1,698 @@
+"""Chaos soak subsystem (ISSUE 11): ChurnScript determinism, watch-intake
+backpressure, ordered shutdown, crash-restart re-adoption, HA failover under
+churn, and the scaled end-to-end soak.
+
+Fast tests run tier-1; everything spawning operator processes is
+slow-marked (like the bench regression gate) so tier-1 stays quick."""
+
+import gzip
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+from karpenter_tpu.api import ObjectMeta, Pod, Provisioner, Resources
+from karpenter_tpu.api.codec import KINDS, to_wire
+from karpenter_tpu.cloudprovider import generate_catalog
+from karpenter_tpu.cloudprovider.httpcloud import CloudHTTPService
+from karpenter_tpu.soak import ChurnEvent, ChurnScript, InvariantMonitor
+from karpenter_tpu.soak.monitor import memory_slope_bps, parse_metrics
+from karpenter_tpu.state import Cluster, ClusterAPIServer, HTTPCluster
+from karpenter_tpu.utils import metrics
+from karpenter_tpu.utils.faults import FaultPlan
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _wait(predicate, timeout, step=0.1):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(step)
+    return False
+
+
+# ---------------------------------------------------------------------------
+# ChurnScript: the unified timeline DSL (satellite: single seeded RNG +
+# injected clock across FaultPlan / InterruptionSchedule / the harness)
+# ---------------------------------------------------------------------------
+
+class TestChurnScript:
+    def test_identical_seed_reproduces_identical_timeline(self):
+        a = ChurnScript.generate(seed=42, duration_s=20, rate_hz=300)
+        b = ChurnScript.generate(seed=42, duration_s=20, rate_hz=300)
+        assert a.events == b.events
+        assert a.total_weight() == b.total_weight()
+
+    def test_different_seed_differs(self):
+        a = ChurnScript.generate(seed=1, duration_s=20, rate_hz=300)
+        b = ChurnScript.generate(seed=2, duration_s=20, rate_hz=300)
+        assert a.events != b.events
+
+    def test_generate_includes_required_chaos(self):
+        s = ChurnScript.generate(
+            seed=3, duration_s=30, rate_hz=200,
+            operator_restarts=((0.4, "kill"),), apiserver_restarts=(0.7,),
+        )
+        kinds = {e.kind for e in s.events}
+        assert "operator-restart" in kinds and "apiserver-restart" in kinds
+        assert any(e.kind == "reclaim-wave" for e in s.events)
+        assert any(e.kind == "ice-start" for e in s.events)
+        # weight approximates the rate target: pod churn dominates
+        assert s.total_weight() >= 30 * 200 * 0.8
+
+    def test_due_yields_in_order_exactly_once(self):
+        s = ChurnScript.generate(seed=5, duration_s=10, rate_hz=100)
+        first = list(s.due(now=4.0))
+        assert first and all(e.t <= 4.0 for e in first)
+        assert [e.t for e in first] == sorted(e.t for e in first)
+        assert not list(s.due(now=4.0))  # exactly once
+        rest = list(s.due(now=10.1))
+        assert all(e.t > 4.0 for e in rest)
+        assert len(first) + len(rest) == len(s.events)
+        assert s.pending() == 0
+
+    def test_unknown_kind_rejected(self):
+        with pytest.raises(ValueError):
+            ChurnEvent(t=0.0, kind="meteor-strike")
+
+    def test_builder_api(self):
+        s = ChurnScript(seed=9)
+        s.at(1.0).deploy_up("a", 5)
+        s.at(2.0).ice(("*", "zone-a", "spot"), duration_s=3.0)
+        s.at(4.0).operator_restart(signal="term")
+        kinds = [e.kind for e in s.events]
+        assert kinds == ["deploy-up", "ice-start", "operator-restart", "ice-end"]
+        assert s.events[0].weight == 5
+
+    def test_interruption_schedule_projection_shares_clock(self):
+        s = ChurnScript(seed=1)
+        s.at(2.5).reclaim_wave(pool=("*", "zone-b", "spot"), fraction=0.5)
+        s.at(7.0).price_spike(zone="zone-a", factor=3.0)
+        sched = s.interruption_schedule(round_s=1.0)
+        assert [w.round_no for w in sched.waves] == [2]
+        assert sched.waves[0].pool == ("*", "zone-b", "spot")
+        assert [p.round_no for p in sched.spikes] == [7]
+        # same injected clock: fired events stamp the script's time axis
+        # (bound-method equality: same receiver, same function)
+        assert sched.clock == s.elapsed
+
+    def test_faultplan_shares_script_clock(self):
+        times = iter([10.0, 20.0, 25.0])
+        s = ChurnScript(seed=1, clock=lambda: next(times))
+        s.start()  # t0 = 10.0
+        s.faults.fail("/v1/run-instances", n=2)
+        assert s.faults.next("/v1/run-instances") is not None
+        assert s.faults.next("/v1/run-instances") is not None
+        assert [round(t, 3) for t, _, _ in s.faults.timeline] == [10.0, 15.0]
+
+
+# ---------------------------------------------------------------------------
+# InvariantMonitor: leak detector + metrics parsing + verdicts
+# ---------------------------------------------------------------------------
+
+class TestInvariantMonitor:
+    def test_memory_slope_detects_linear_leak(self):
+        start = 100.0
+        samples = [(float(t), start, 1e8 + t * 500_000.0) for t in range(60)]
+        slope, segments = memory_slope_bps(samples)
+        assert segments == 1
+        assert 400_000 < slope < 600_000
+
+    def test_memory_slope_flat_is_zero(self):
+        samples = [(float(t), 1.0, 1e8 + (t % 2) * 1000) for t in range(60)]
+        slope, segments = memory_slope_bps(samples)
+        assert segments == 1
+        assert abs(slope) < 1000
+
+    def test_restart_rss_reset_not_a_negative_leak(self):
+        # incarnation 1 at high RSS, incarnation 2 restarts low and stays
+        # flat: an unsegmented regression would see a huge negative (or,
+        # reversed, positive) slope across the reset
+        s1 = [(float(t), 1.0, 5e8) for t in range(80)]
+        s2 = [(80.0 + t, 2.0, 1e8) for t in range(80)]
+        slope, segments = memory_slope_bps(s1 + s2)
+        assert segments == 2
+        assert abs(slope) < 1000
+
+    def test_short_post_restart_segment_skipped(self):
+        # 20 s of steeply-climbing warmup right after a restart must not
+        # read as a leak — below the warmup + min qualifying span it is
+        # boot ramp, not a trend
+        s1 = [(float(t), 1.0, 1e8) for t in range(80)]
+        s2 = [(80.0 + t, 2.0, 1e8 + t * 5e6) for t in range(20)]
+        slope, segments = memory_slope_bps(s1 + s2)
+        assert segments == 1
+        assert abs(slope) < 1000
+
+    def test_parse_metrics(self):
+        text = (
+            "# HELP x y\n# TYPE x gauge\n"
+            'x{controller="gc"} 1.5\n'
+            "karpenter_tpu_process_memory_bytes 123456\n"
+            "bad line\n"
+        )
+        out = parse_metrics(text)
+        assert ("x", {"controller": "gc"}, 1.5) in out
+        assert ("karpenter_tpu_process_memory_bytes", {}, 123456.0) in out
+
+    def test_report_flags_each_invariant(self):
+        mon = InvariantMonitor(ready_p99_budget_s=1.0, loop_lag_budget_s=1.0,
+                               mem_slope_budget_bps=100.0)
+        mon.ready_latencies = [5.0] * 10
+        mon.loop_lag_max_s = 9.0
+        mon.mem_samples = [(float(t), 1.0, 1e8 + t * 1e6) for t in range(60)]
+        report = mon.report(
+            pending_end=3,
+            launch_audit={"duplicate_tokens": {"tok": ["i-1", "i-2"]}},
+            orphan_instances=["i-9"],
+            replay={"found": 1, "mismatched": ["c1"], "errors": []},
+        )
+        text = "\n".join(report["violations"])
+        assert not report["ok"]
+        for needle in ("p99", "loop lag", "memory slope", "pending",
+                       "duplicate", "orphaned", "diverged"):
+            assert needle in text, f"missing violation for {needle}"
+
+    def test_report_clean(self):
+        mon = InvariantMonitor()
+        mon.ready_latencies = [0.1] * 50
+        report = mon.report(pending_end=0, launch_audit={}, orphan_instances=[])
+        assert report["ok"] and report["violations"] == []
+
+
+# ---------------------------------------------------------------------------
+# Watch-intake backpressure (HTTPCluster bounded queue)
+# ---------------------------------------------------------------------------
+
+class TestBackpressure:
+    def test_widen_coalesces_to_newest_per_object(self):
+        api = ClusterAPIServer().start()
+        try:
+            client = HTTPCluster(api.endpoint, watch=False, queue_capacity=64)
+            client._widened = True
+            base = metrics.BACKPRESSURE_EVENTS.value({"action": "widen"})
+            pod = Pod(meta=ObjectMeta(name="w-1"),
+                      requests=Resources(cpu="100m", memory="64Mi"))
+            wires = []
+            for v in (5, 6, 7):
+                pod.meta.resource_version = v
+                wires.append({"resourceVersion": v, "event": "MODIFIED",
+                              "kind": "pods", "object": to_wire(pod)})
+            client._apply_events(wires)
+            # two superseded intermediates coalesced away; newest applied
+            assert metrics.BACKPRESSURE_EVENTS.value({"action": "widen"}) - base == 2
+            assert client.pods["w-1"].meta.resource_version == 7
+            client.close()
+        finally:
+            api.stop()
+
+    def test_overflow_sheds_and_relists(self):
+        api = ClusterAPIServer().start()
+        writer = HTTPCluster(api.endpoint, watch=False)
+        client = HTTPCluster(api.endpoint, queue_capacity=8)
+        try:
+            base = metrics.BACKPRESSURE_EVENTS.value({"action": "shed"})
+            # hold the applier so fetched events pile into the bounded queue
+            with client.quiesce():
+                for i in range(40):
+                    writer.add_pod(Pod(
+                        meta=ObjectMeta(name=f"shed-{i}"),
+                        requests=Resources(cpu="50m", memory="32Mi"),
+                    ))
+                assert _wait(
+                    lambda: metrics.BACKPRESSURE_EVENTS.value(
+                        {"action": "shed"}) > base,
+                    timeout=20,
+                ), "intake overflow never shed"
+            # after release the queued relist rebuilds the full cache
+            assert _wait(lambda: len(client.pods) == 40, timeout=20), (
+                f"cache never converged after shed: {len(client.pods)}"
+            )
+        finally:
+            client.close()
+            writer.close()
+            api.stop()
+
+    def test_quiesce_holds_remote_events_until_release(self):
+        api = ClusterAPIServer().start()
+        writer = HTTPCluster(api.endpoint, watch=False)
+        client = HTTPCluster(api.endpoint)
+        try:
+            with client.quiesce():
+                writer.add_pod(Pod(
+                    meta=ObjectMeta(name="q-1"),
+                    requests=Resources(cpu="50m", memory="32Mi"),
+                ))
+                time.sleep(1.0)  # ample time for fetch; apply must NOT run
+                assert "q-1" not in client.pods
+            assert _wait(lambda: "q-1" in client.pods, timeout=10)
+        finally:
+            client.close()
+            writer.close()
+            api.stop()
+
+    def test_apiserver_listener_restart_forces_relist(self):
+        """A fresh apiserver incarnation over the same backing store resets
+        the event log; stale client bookmarks (AHEAD of the new log) must
+        get 'gone' and relist, or the client cache wedges forever."""
+        backing = Cluster()
+        api = ClusterAPIServer(backing=backing).start()
+        port = api._server.server_address[1]
+        client = HTTPCluster(api.endpoint)
+        try:
+            client.add_pod(Pod(meta=ObjectMeta(name="r-1"),
+                               requests=Resources(cpu="50m", memory="32Mi")))
+            assert _wait(lambda: client._bookmark >= 1, timeout=5)
+            api.stop()
+            api = ClusterAPIServer(backing=backing, port=port).start()
+            # a write through the NEW incarnation (small seqs) must reach the
+            # old client despite its large pre-restart bookmark
+            backing.add_pod(Pod(meta=ObjectMeta(name="r-2"),
+                                requests=Resources(cpu="50m", memory="32Mi")))
+            assert _wait(lambda: "r-2" in client.pods, timeout=30), (
+                "client never recovered from the apiserver restart"
+            )
+        finally:
+            client.close()
+            api.stop()
+
+
+# ---------------------------------------------------------------------------
+# Ordered shutdown + flight-recorder flush + launch audit
+# ---------------------------------------------------------------------------
+
+class TestShutdownOrdering:
+    def test_close_releases_lease_and_flushes_before_port(self):
+        from karpenter_tpu.operator import Operator
+
+        order = []
+
+        class FakeElector:
+            def release(self):
+                order.append("lease")
+
+        class FakeServer:
+            recorder = None
+
+            def stop(self):
+                order.append("port")
+
+        op = Operator.new()
+        op.elector = FakeElector()
+        op.http_server = FakeServer()
+        op.close()
+        assert order == ["lease", "port"], order
+
+    def test_close_port_released_even_when_steps_fail(self):
+        from karpenter_tpu.operator import Operator
+
+        stopped = []
+
+        class ExplodingElector:
+            def release(self):
+                raise RuntimeError("lease storage gone")
+
+        class FakeServer:
+            recorder = None
+
+            def stop(self):
+                stopped.append(True)
+
+        op = Operator.new()
+        op.elector = ExplodingElector()
+        op.http_server = FakeServer()
+        op.close()  # must not raise
+        assert stopped == [True]
+
+    def test_flush_dumps_writes_missed_anomaly_capsules(self, tmp_path):
+        from karpenter_tpu.utils.flightrecorder import FlightRecorder
+
+        rec = FlightRecorder(capacity=8)  # no dump dir yet: auto-dump misses
+        rec._commit({"id": "a1", "controller": "provisioning",
+                     "anomalies": ["unschedulable-pods"], "inputs": {},
+                     "outputs": {}}, ["unschedulable-pods"])
+        rec._commit({"id": "ok1", "controller": "provisioning",
+                     "anomalies": [], "inputs": {}, "outputs": {}}, [])
+        rec.dump_dir = str(tmp_path)
+        written = rec.flush_dumps()
+        assert len(written) == 1 and "a1" in written[0]
+        assert rec.flush_dumps() == []  # idempotent: already on disk
+        with gzip.open(written[0]) as f:
+            assert json.load(f)["id"] == "a1"
+
+    def test_launch_audit_flags_duplicate_tokens(self):
+        svc = CloudHTTPService(catalog=generate_catalog(n_types=4))
+        svc.launch_log = [("t1", "i-1", 0.0), ("t1", "i-2", 1.0),
+                          ("t2", "i-3", 2.0), ("", "i-4", 3.0)]
+        audit = svc.launch_audit()
+        assert audit["duplicate_tokens"] == {"t1": ["i-1", "i-2"]}
+        assert audit["tokens"] == 2 and audit["untokened"] == 1
+
+    def test_machine_name_seq_seeded_past_existing(self):
+        from karpenter_tpu.controllers.provisioning import (
+            MachineNameSeq,
+            seed_machine_names,
+        )
+
+        cluster = Cluster()
+        cluster.add_provisioner(Provisioner(meta=ObjectMeta(name="default")))
+        from karpenter_tpu.api.objects import Machine, Node
+
+        cluster.add_machine(Machine(meta=ObjectMeta(name="default-7"),
+                                    provisioner_name="default"))
+        cluster.add_node(Node(meta=ObjectMeta(name="default-12")))
+        seq = MachineNameSeq()
+        assert seed_machine_names(cluster, seq) == 12
+        assert seq.next() == 13
+
+
+# ---------------------------------------------------------------------------
+# Slow: process-level chaos (operator subprocesses)
+# ---------------------------------------------------------------------------
+
+def _operator_env(dump_dir, extra=None):
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env["KARPENTER_TPU_FLIGHT_RECORDER_DUMP_DIR"] = str(dump_dir)
+    env["KARPENTER_TPU_GARBAGE_COLLECT_INTERVAL"] = "2"
+    env.update(extra or {})
+    return env
+
+
+def _spawn_operator(api, cloud, port, log_path, env):
+    log = open(log_path, "w")
+    return subprocess.Popen(
+        [sys.executable, "-m", "karpenter_tpu",
+         "--cluster-endpoint", api.endpoint,
+         "--cloud-endpoint", cloud.endpoint,
+         "--metrics-port", str(port), "--metrics-bind", "127.0.0.1",
+         "--batch-idle-duration", "0.1", "--batch-max-duration", "0.5",
+         "--tick", "0.05"],
+        cwd=ROOT, env=env, stdout=log, stderr=subprocess.STDOUT, text=True,
+    )
+
+
+def _kill(procs):
+    for p in procs:
+        if p and p.poll() is None:
+            p.send_signal(signal.SIGTERM)
+    for p in procs:
+        if p:
+            try:
+                p.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
+def _http_json(url, timeout=3.0):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def _clone_cluster(backing):
+    """Wire-faithful copy of a backing store (the crash-restart digest
+    control starts a never-crashed operator against an identical state)."""
+    clone = Cluster()
+    with backing._lock:
+        snap = {
+            kind: [KINDS[kind][1](o) for o in getattr(backing, attr).values()]
+            for kind, attr in (
+                ("provisioners", "provisioners"), ("nodetemplates", "node_templates"),
+                ("poddisruptionbudgets", "pdbs"), ("nodes", "nodes"),
+                ("machines", "machines"), ("pods", "pods"),
+            )
+        }
+        version = backing._version
+    for kind, wires in snap.items():
+        decode = KINDS[kind][2]
+        coll = {
+            "provisioners": clone.provisioners, "nodetemplates": clone.node_templates,
+            "poddisruptionbudgets": clone.pdbs, "nodes": clone.nodes,
+            "machines": clone.machines, "pods": clone.pods,
+        }[kind]
+        for w in wires:
+            obj = decode(w)
+            coll[obj.meta.name] = obj
+    clone._version = version
+    return clone
+
+
+def _free_port():
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.mark.slow
+class TestCrashRestartReadoption:
+    def test_kill_midflight_then_restart_matches_control(self, tmp_path):
+        """Satellite: SIGKILL an operator holding bound pods, an in-flight
+        launch and a node mid-deletion; the restarted operator must resume
+        termination, adopt/collect the orphaned instance, launch no
+        duplicates, and its first solve digest must equal a never-crashed
+        control operator's over an identical cluster copy."""
+        plan = FaultPlan()
+        cloud = CloudHTTPService(
+            catalog=generate_catalog(n_types=12), fault_plan=plan
+        ).start()
+        api = ClusterAPIServer().start()
+        client = HTTPCluster(api.endpoint)
+        port_a, port_b, port_c = _free_port(), _free_port(), _free_port()
+        a = b = c = None
+        cloud2 = api2 = None
+        try:
+            client.add_provisioner(Provisioner(meta=ObjectMeta(name="default")))
+            a = _spawn_operator(api, cloud, port_a, tmp_path / "op-a.log",
+                                _operator_env(tmp_path / "caps-a"))
+            for i in range(6):
+                client.add_pod(Pod(
+                    meta=ObjectMeta(name=f"base-{i}", owner_kind="ReplicaSet"),
+                    requests=Resources(cpu="250m", memory="256Mi"),
+                ))
+            assert _wait(
+                lambda: all(p.node_name for p in client.pods.values())
+                and len(client.pods) == 6,
+                timeout=120,
+            ), "baseline pods never bound"
+
+            # node mid-deletion: terminate blocked so the finalizer parks
+            plan.fail("/v1/terminate", n=100, status=503)
+            victim = sorted(client.nodes)[0]
+            node = client.nodes[victim]
+            node.meta.deletion_timestamp = time.time()
+            client.update(node)
+            assert _wait(
+                lambda: client.nodes.get(victim) is not None
+                and client.nodes[victim].unschedulable,
+                timeout=60,
+            ), "victim never cordoned (termination not running?)"
+
+            # in-flight launch: create hangs server-side; kill mid-flight
+            calls0 = cloud.request_log.count("/v1/run-instances")
+            plan.latency("/v1/run-instances", seconds=6.0, n=1)
+            client.add_pod(Pod(
+                meta=ObjectMeta(name="midflight-0", owner_kind="ReplicaSet"),
+                requests=Resources(cpu="250m", memory="256Mi"),
+            ))
+            assert _wait(
+                lambda: cloud.request_log.count("/v1/run-instances") > calls0,
+                timeout=60,
+            ), "launch never reached the cloud"
+            a.kill()  # SIGKILL: no ordered shutdown, that's the point
+            a.wait(timeout=15)
+            instances0 = len(cloud.instances)
+            # the server-side launch completes after the client died: an
+            # instance with no Machine — the orphan GC must handle
+            assert _wait(lambda: len(cloud.instances) > instances0, timeout=30)
+            plan.clear("/v1/terminate")
+
+            # copy the quiescent store for the never-crashed control
+            clone = _clone_cluster(api.backing)
+            api2 = ClusterAPIServer(backing=clone).start()
+            cloud2 = CloudHTTPService(catalog=generate_catalog(n_types=12)).start()
+
+            b = _spawn_operator(api, cloud, port_b, tmp_path / "op-b.log",
+                                _operator_env(tmp_path / "caps-b"))
+            c = _spawn_operator(api2, cloud2, port_c, tmp_path / "op-c.log",
+                                _operator_env(tmp_path / "caps-c"))
+
+            # recovery: pending pod binds, mid-deletion node finishes dying
+            assert _wait(
+                lambda: (p := client.pods.get("midflight-0")) is not None
+                and p.node_name is not None,
+                timeout=180,
+            ), "restarted operator never placed the midflight pod"
+            assert _wait(
+                lambda: victim not in client.nodes, timeout=120,
+            ), "termination never resumed on the mid-deletion node"
+
+            # no orphans: every live instance referenced by a machine
+            def orphans():
+                known = {
+                    m.status.provider_id.rsplit("/", 1)[-1]
+                    for m in api.backing.machines.values()
+                    if m.status.provider_id
+                }
+                return [i for i in cloud.instances if i not in known]
+
+            assert _wait(lambda: not orphans(), timeout=90), (
+                f"orphaned instances never adopted/collected: {orphans()}"
+            )
+            # no duplicate machines / no duplicate launches
+            audit = cloud.launch_audit()
+            assert audit["duplicate_tokens"] == {}
+            pids = [m.status.provider_id for m in api.backing.machines.values()
+                    if m.status.provider_id]
+            assert len(pids) == len(set(pids)), f"duplicate provider ids: {pids}"
+
+            # digest control: B's first provisioning capsule vs C's
+            def first_prov_digests(port):
+                caps = _http_json(
+                    f"http://127.0.0.1:{port}/debug/flightrecorder"
+                )["capsules"]
+                prov = [x for x in caps if x["controller"] == "provisioning"]
+                if not prov:
+                    return None
+                oldest = prov[-1]["id"]  # list is newest-first
+                raw = urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/debug/flightrecorder/{oldest}",
+                    timeout=5,
+                ).read()
+                capsule = json.loads(gzip.decompress(raw))
+                return capsule["outputs"]["problem_digests"]
+
+            assert _wait(lambda: first_prov_digests(port_b) is not None, timeout=60)
+            assert _wait(lambda: first_prov_digests(port_c) is not None, timeout=60)
+            db, dc = first_prov_digests(port_b), first_prov_digests(port_c)
+            assert db == dc and db, (
+                f"restarted operator's first solve diverged from the "
+                f"never-crashed control: {db} vs {dc}"
+            )
+        finally:
+            _kill([a, b, c])
+            client.close()
+            api.stop()
+            cloud.stop()
+            if api2 is not None:
+                api2.stop()
+            if cloud2 is not None:
+                cloud2.stop()
+
+
+@pytest.mark.slow
+class TestHAFailoverMidChurn:
+    def test_leader_killed_mid_churn_no_duplicate_launches(self, tmp_path):
+        """Satellite: settings-driven leader election (two operators, one
+        apiserver), leader SIGKILLed while pods stream in; the standby takes
+        over within the lease TTL and the client-token audit shows zero
+        duplicate launches across the failover."""
+        lease = str(tmp_path / "lease")
+        cloud = CloudHTTPService(catalog=generate_catalog(n_types=12)).start()
+        api = ClusterAPIServer().start()
+        client = HTTPCluster(api.endpoint)
+        ports = (_free_port(), _free_port())
+        env = _operator_env(tmp_path, extra={
+            # the SETTINGS path, not the CLI flag — exercises the satellite
+            "KARPENTER_TPU_LEADER_ELECTION_ENABLED": "true",
+            "KARPENTER_TPU_LEADER_ELECTION_LEASE_PATH": lease,
+        })
+        procs = []
+        try:
+            client.add_provisioner(Provisioner(meta=ObjectMeta(name="default")))
+            procs = [
+                _spawn_operator(api, cloud, p, tmp_path / f"ha-{p}.log", env)
+                for p in ports
+            ]
+
+            def leader_states():
+                out = []
+                for p in ports:
+                    try:
+                        with urllib.request.urlopen(
+                            f"http://127.0.0.1:{p}/leaderz", timeout=2
+                        ) as r:
+                            out.append(r.status == 200)
+                    except Exception:
+                        out.append(False)
+                return out
+
+            assert _wait(lambda: sum(leader_states()) == 1, timeout=120), (
+                f"expected exactly one leader, got {leader_states()}"
+            )
+            leader = leader_states().index(True)
+
+            # churn: pods stream in while we kill the leader mid-stream
+            for i in range(10):
+                client.add_pod(Pod(
+                    meta=ObjectMeta(name=f"churn-a-{i}", owner_kind="ReplicaSet"),
+                    requests=Resources(cpu="200m", memory="128Mi"),
+                ))
+                if i == 5:
+                    procs[leader].kill()
+                    procs[leader].wait(timeout=15)
+                time.sleep(0.2)
+            standby = 1 - leader
+            assert _wait(lambda: leader_states()[standby], timeout=60), (
+                "standby never took leadership within the lease TTL"
+            )
+            for i in range(5):
+                client.add_pod(Pod(
+                    meta=ObjectMeta(name=f"churn-b-{i}", owner_kind="ReplicaSet"),
+                    requests=Resources(cpu="200m", memory="128Mi"),
+                ))
+            assert _wait(
+                lambda: all(p.node_name for p in client.pods.values()),
+                timeout=180,
+            ), "pods never all bound after failover"
+
+            audit = cloud.launch_audit()
+            assert audit["duplicate_tokens"] == {}, audit
+            pids = [m.status.provider_id for m in api.backing.machines.values()
+                    if m.status.provider_id]
+            assert len(pids) == len(set(pids)), f"duplicate machines: {pids}"
+        finally:
+            _kill(procs)
+            client.close()
+            api.stop()
+            cloud.stop()
+
+
+@pytest.mark.slow
+class TestScaledSoak:
+    def test_scaled_soak_end_to_end(self):
+        """The acceptance scenario: >=60 s of sustained churn over the real
+        HTTP stack including >=1 apiserver restart and >=1 operator
+        SIGKILL+restart, zero invariant violations, and byte-identical
+        offline replay of every dumped anomaly capsule."""
+        from karpenter_tpu.soak import SoakConfig, run_soak
+
+        report = run_soak(SoakConfig(
+            duration_s=75.0,        # >=60 s criterion, with margin so the
+            #                         post-kill incarnation's memory window
+            #                         clears the leak detector's min-span
+            rate_hz=0.0,            # box-calibrated, capped at the 1k/s
+            rate_target_hz=1000.0,  # acceptance target (driver hardware)
+            seed=11,
+            operator_restarts=((0.25, "kill"),),
+            apiserver_restarts=(0.6,),
+        ))
+        assert report["restarts"]["operator_kill"] >= 1
+        assert report["restarts"]["apiserver"] >= 1
+        assert report["duration_s"] >= 60.0
+        # achieved churn must be meaningful relative to the calibrated
+        # target (the absolute >=1k/s criterion is driver-class hardware)
+        assert report["events_per_s"] >= max(100.0, 0.5 * report["rate_hz"])
+        # the leak detector must have judged at least one qualifying window
+        assert report["mem_segments"] >= 1
+        replay = report["replay"]
+        assert replay["mismatched"] == [] and replay["errors"] == [], replay
+        assert report["ok"], f"invariants tripped: {report['violations']}"
