@@ -117,6 +117,17 @@ class Settings:
     # (one giant cell pays sharding overhead for no decomposition win).
     # 0 disables the guardrail.
     cell_max_pods: int = 0
+    # fleet dispatch (solver stage_fleet + the sharded provisioning round):
+    # group per-cell kernel dispatches by executable bucket and batch each
+    # group into ONE vmapped device call — O(distinct buckets) device calls
+    # per sharded round instead of O(cells). The batched member program is
+    # bit-identical to the per-cell one, so answers never change; flat mode
+    # and host-only backends are unaffected.
+    fleet_dispatch_enabled: bool = True
+    # cap on cells batched into one fleet dispatch; the effective chunk
+    # width is the largest power of two <= this (the compiled batch axis is
+    # pow2-bucketed like every other kernel axis).
+    fleet_max_batch: int = 16
     # AOT kernel executable cache (solver/jax_solver.py AOTCache): kernel
     # solves dispatch pre-built per-bucket executables; this enables the
     # persistent on-disk XLA compilation cache so a restarted operator
@@ -215,6 +226,11 @@ class Settings:
         if self.cell_max_pods < 0:
             raise ValueError(
                 "cellMaxPods must be >= 0 (0 disables the guardrail)"
+            )
+        if self.fleet_max_batch < 2:
+            raise ValueError(
+                "fleetMaxBatch must be >= 2 (a 1-wide fleet is a per-cell "
+                "dispatch; use fleet_dispatch_enabled=false to disable)"
             )
         if self.aot_cache_capacity < 1:
             raise ValueError("aotCacheCapacity must be >= 1")

@@ -788,6 +788,47 @@ class ProvisioningController:
             if key not in plan.dirty and hit is not None and hit[0] == sigs[i]:
                 reused[i] = hit[2]
 
+        # -- fleet dispatch ---------------------------------------------------
+        # Encode every dirty cell FIRST (serial — encodes serialize on
+        # ENCODE_LOCK anyway, and each cell's session/digest is untouched by
+        # the reordering), group the encoded problems by executable bucket,
+        # and fire ONE vmapped device call per distinct bucket before any
+        # per-cell solve runs: the device computes the whole fleet while the
+        # host paths execute, and the round pays O(distinct buckets) device
+        # dispatches instead of O(cells). The batched member program is
+        # bit-identical to the per-cell one, so every downstream contract
+        # (race comparison, flat==sharded, capsule replay) holds unchanged.
+        # Clean-cell reuse stays decided above (reused cells never encode or
+        # dispatch) and the residue arbitration below is untouched.
+        staged: Dict[int, object] = {}
+        fleet_stats = None
+        # the gauge reflects THIS round: a quiet round (nothing to batch)
+        # must read 0, not the previous round's count (the stale-series
+        # class the per-cell lag gauges prune for)
+        metrics.FLEET_ROUND_DISPATCHES.set(0.0)
+        if (
+            self.settings.fleet_dispatch_enabled
+            and len(works) - len(reused) >= 2
+        ):
+            from ..solver.solver import stage_fleet
+
+            for i, (key, cell_pods, cell_provs) in enumerate(works):
+                if i in reused:
+                    continue
+                staged[i] = solvers[i].encode_for_staging(
+                    cell_pods, cell_provs,
+                    existing=ex_by_cell.get(key, []),
+                    daemonsets=daemonsets,
+                    session=router.session(key),
+                )
+            fleet_stats = stage_fleet(
+                [(solvers[i], staged[i]) for i in sorted(staged)],
+                max_batch=self.settings.fleet_max_batch,
+            )
+            metrics.FLEET_ROUND_DISPATCHES.set(
+                float(fleet_stats["dispatches"])
+            )
+
         def one(i, work):
             if i in reused:
                 return reused[i], 0.0, 0.0
@@ -798,6 +839,7 @@ class ProvisioningController:
                 existing=ex_by_cell.get(key, []),
                 daemonsets=daemonsets,
                 session=router.session(key),
+                pre_encoded=staged.get(i),
             )
             return res, t_start - t0, time.perf_counter() - t_start
 
@@ -947,6 +989,11 @@ class ProvisioningController:
         merged.stats["cells"] = float(len(works))
         merged.stats["cells_reused"] = float(len(reused))
         merged.stats["residue_pods"] = float(len(residue_pods))
+        if fleet_stats is not None:
+            merged.stats["fleet_dispatches"] = float(fleet_stats["dispatches"])
+            merged.stats["fleet_cells_batched"] = float(
+                fleet_stats["cells_batched"]
+            )
         router.note_round_modes(modes)
         router.last_round = summaries
         metrics.CELLS_TOTAL.set(float(len(works)))
@@ -975,6 +1022,14 @@ class ProvisioningController:
                 "cells": len(works),
                 "residue_pods": len(residue_pods),
                 "workers": workers,
+                **(
+                    {
+                        "fleet_dispatches": fleet_stats["dispatches"],
+                        "fleet_cells_batched": fleet_stats["cells_batched"],
+                    }
+                    if fleet_stats is not None
+                    else {}
+                ),
             },
         )
         return merged

@@ -1,11 +1,20 @@
 from .hostpool import default_workers, first_hit
-from .mesh import PORTFOLIO_AXIS, make_mesh, round_up_portfolio, shard_portfolio
+from .mesh import (
+    PORTFOLIO_AXIS,
+    fleet_shardings,
+    make_mesh,
+    round_up_portfolio,
+    shard_fleet,
+    shard_portfolio,
+)
 
 __all__ = [
     "PORTFOLIO_AXIS",
     "default_workers",
     "first_hit",
+    "fleet_shardings",
     "make_mesh",
     "round_up_portfolio",
+    "shard_fleet",
     "shard_portfolio",
 ]

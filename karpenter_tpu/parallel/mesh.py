@@ -58,3 +58,31 @@ def round_up_portfolio(k: int, mesh: Optional[Mesh]) -> int:
         return k
     d = mesh.devices.size
     return ((k + d - 1) // d) * d
+
+
+def fleet_shardings(mesh: Mesh, b: int) -> Tuple[NamedSharding, NamedSharding]:
+    """Shardings for a FLEET dispatch (B same-bucket problems stacked along
+    a leading batch axis): when the fleet width divides the device count
+    evenly, the batch axis shards across the mesh — each device solves a
+    contiguous slab of cells, the fleet analogue of the portfolio axis —
+    and both the member arrays ([B, K, ...]) and the problem tensors
+    ([B, ...]) carry it on dim 0. An uneven width replicates (a wrong
+    PartitionSpec would force XLA resharding collectives mid-dispatch).
+
+    Returns ``(member, replicated)`` in the ``_bucket_specs`` sense; for a
+    fleet both roles share the batch-axis placement.
+    """
+    if b % mesh.devices.size == 0:
+        s = NamedSharding(mesh, P(PORTFOLIO_AXIS))
+        return s, s
+    r = NamedSharding(mesh, P())
+    return r, r
+
+
+def shard_fleet(mesh: Mesh, b: int, inputs, *member_arrays):
+    """Place stacked fleet inputs (a PackInputs pytree plus the member
+    arrays, all with leading batch axis ``b``) onto the mesh per
+    ``fleet_shardings``; the fleet staging calls this once per dispatch."""
+    member, _ = fleet_shardings(mesh, b)
+    inputs = jax.tree.map(lambda x: jax.device_put(x, member), inputs)
+    return (inputs,) + tuple(jax.device_put(a, member) for a in member_arrays)

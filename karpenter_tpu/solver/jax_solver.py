@@ -534,6 +534,37 @@ pack_solve_fused = functools.partial(
 )(_pack_solve_fused_impl)
 
 
+def _pack_solve_fleet_impl(
+    inputs: PackInputs,
+    orders: jax.Array,
+    alphas: jax.Array,
+    looks: jax.Array,
+    rsvs: jax.Array,
+    swaps: jax.Array,
+    s_new: int,
+    n_zones: int,
+) -> jax.Array:
+    """Fleet dispatch: B shape-identical problems solved in ONE device call.
+
+    Every argument carries a leading batch axis B (cells stacked by the
+    sharded control plane's fleet staging); the member program is exactly
+    ``_pack_solve_fused_impl`` under ``vmap``, so row ``b`` of the returned
+    [B, L] buffer is bit-for-bit what a B=1 dispatch of problem ``b`` would
+    produce — the batched==serial equivalence the fleet path's digest
+    contract rests on. Padded fleet slots (count all zero, no valid options
+    or existing slots) pack nothing and cost nothing.
+    """
+    member = functools.partial(
+        _pack_solve_fused_impl, s_new=s_new, n_zones=n_zones
+    )
+    return jax.vmap(member)(inputs, orders, alphas, looks, rsvs, swaps)
+
+
+pack_solve_fleet = functools.partial(
+    jax.jit, static_argnames=("s_new", "n_zones")
+)(_pack_solve_fleet_impl)
+
+
 def _bitcast_f32_i32(x: jax.Array) -> jax.Array:
     return lax.bitcast_convert_type(x.astype(jnp.float32), jnp.int32)
 
@@ -600,6 +631,13 @@ def bucket_zones(z: int) -> int:
     return _pow2(max(z, 1), 1)
 
 
+def bucket_fleet(b: int) -> int:
+    """Fleet (batched-cell) axis bucket: pow2 with floor 2, so a sharded
+    round's varying dirty-cell count lands on a handful of fleet widths.
+    B=1 stays 1 — the un-batched executables keep their exact keys."""
+    return 1 if b <= 1 else _pow2(b, 2)
+
+
 class BucketKey(NamedTuple):
     """The padded-dimension tuple one executable serves: problems whose
     dimensions quantize to the same key share a compiled program."""
@@ -611,9 +649,15 @@ class BucketKey(NamedTuple):
     Z: int  # padded zone axis
     R: int  # resource axes
     K: int  # portfolio members
+    # fleet width: B > 1 keys the vmapped multi-problem executable that
+    # solves B stacked same-bucket problems in one device call (the sharded
+    # control plane's fleet dispatch); B == 1 is the classic single-problem
+    # program and keeps the pre-fleet key/label shape.
+    B: int = 1
 
     def label(self) -> str:
-        return f"g{self.G}o{self.O}e{self.E}s{self.S}z{self.Z}r{self.R}k{self.K}"
+        base = f"g{self.G}o{self.O}e{self.E}s{self.S}z{self.Z}r{self.R}k{self.K}"
+        return base if self.B == 1 else f"{base}b{self.B}"
 
 
 def bucket_key(g: int, o: int, e: int, s_new: int, z: int, r: int, k: int) -> BucketKey:
@@ -628,18 +672,27 @@ def _bucket_specs(key: BucketKey, mesh=None):
     ``jit(...).lower(...)`` compiles against, no real arrays needed. With a
     mesh, portfolio-axis arrays carry a PartitionSpec sharding over the
     device axis and problem tensors replicate (the pjit layout
-    ``parallel.shard_portfolio`` produces at dispatch time)."""
-    G, O, E, S, Z, R, K = key
+    ``parallel.shard_portfolio`` produces at dispatch time). Fleet buckets
+    (B > 1) prefix EVERY spec with the batch axis; under a mesh the batch
+    axis is the one sharded across devices (``parallel.fleet_shardings``) —
+    each device solves a slab of cells."""
+    G, O, E, S, Z, R, K = key.G, key.O, key.E, key.S, key.Z, key.R, key.K
+    B = key.B
     member = replicated = None
     if mesh is not None:
         from jax.sharding import NamedSharding, PartitionSpec as P
 
-        from ..parallel.mesh import PORTFOLIO_AXIS
+        from ..parallel.mesh import PORTFOLIO_AXIS, fleet_shardings
 
-        member = NamedSharding(mesh, P(PORTFOLIO_AXIS))
-        replicated = NamedSharding(mesh, P())
+        if B > 1:
+            member, replicated = fleet_shardings(mesh, B)
+        else:
+            member = NamedSharding(mesh, P(PORTFOLIO_AXIS))
+            replicated = NamedSharding(mesh, P())
 
     def spec(shape, dtype, shard):
+        if B > 1:
+            shape = (B,) + tuple(shape)
         if shard is None:
             return jax.ShapeDtypeStruct(shape, dtype)
         return jax.ShapeDtypeStruct(shape, dtype, sharding=shard)
@@ -680,13 +733,17 @@ def _bucket_specs(key: BucketKey, mesh=None):
 _DONATING_JIT = None
 
 
-def _get_jit(donate: bool):
+def _get_jit(donate: bool, fleet: bool = False):
     """The jit wrapper an AOT lowering goes through. The donating variant
     hands the problem tensors' device buffers to XLA for reuse — a cold
     one-shot dispatch then skips the output-allocation copy; callers must
     treat the staged inputs as consumed (the solver drops its device-cache
-    entry after a donated dispatch)."""
+    entry after a donated dispatch). Fleet buckets route to the vmapped
+    multi-problem program; they never donate (the staging stacks fresh
+    host arrays per round and the batch is dispatched exactly once)."""
     global _DONATING_JIT
+    if fleet:
+        return pack_solve_fleet
     if not donate:
         return pack_solve_fused
     if _DONATING_JIT is None:
@@ -820,7 +877,7 @@ class AOTCache:
                 if entry is not None:
                     return entry.exe
                 exe = (
-                    _get_jit(donate)
+                    _get_jit(donate, fleet=key.B > 1)
                     .lower(*specs, s_new=key.S, n_zones=key.Z)
                     .compile()
                 )
@@ -978,3 +1035,48 @@ def make_orders(
     if has_reserve:
         rsvs[::2] = True
     return orders, alphas, looks, rsvs, swaps
+
+
+def fleet_padding(key: BucketKey):
+    """One INERT fleet slot for padding a batch up to its pow2 width.
+
+    The slot is a zero-pod problem on ``key``'s shape — count all zero, no
+    valid options (INF price), no existing slots, IBIG quotas — exactly the
+    padding ``_prepare`` applies within an axis, lifted to a whole batch
+    row. Every scan step places nothing, wants nothing, and opens nothing,
+    so the slot's member costs are 0 and it can never perturb the real
+    rows' results (the vmapped members are independent). Orders are the
+    identity permutation — ``make_orders`` noise draws are irrelevant for a
+    row with no real groups, and a fixed identity keeps the padded row's
+    content deterministic for the AOT bucket.
+    """
+    G, O, E, S, Z, R, K = key.G, key.O, key.E, key.S, key.Z, key.R, key.K
+    inputs = PackInputs(
+        demand=np.zeros((G, R), np.float32),
+        demand_units=np.zeros((G, R), np.float32),
+        count=np.zeros((G,), np.int32),
+        node_cap=np.full((G,), IBIG, np.int32),
+        quota=np.full((G, Z), IBIG, np.int32),
+        colocate=np.zeros((G,), bool),
+        compat=np.zeros((G, O), bool),
+        alloc=np.zeros((O, R), np.float32),
+        price=np.full((O,), INF, np.float32),
+        opt_zone=np.zeros((O,), np.int32),
+        opt_valid=np.zeros((O,), bool),
+        ex_rem=np.zeros((E, R), np.float32),
+        ex_zone=np.zeros((E,), np.int32),
+        ex_compat=np.zeros((G, E), bool),
+        ex_valid=np.zeros((E,), bool),
+        rel_set=np.zeros((G,), np.int32),
+        rel_host_forbid=np.zeros((G,), np.int32),
+        rel_host_need=np.zeros((G,), np.int32),
+        rel_zone_forbid=np.zeros((G,), np.int32),
+        rel_zone_need=np.zeros((G,), np.int32),
+        rel_slot_bits=np.zeros((E,), np.int32),
+        rel_zone_bits=np.zeros((Z,), np.int32),
+    )
+    ident = np.tile(np.arange(G, dtype=np.int32), (K, 1))
+    alphas = np.ones((K,), np.float32)
+    looks = np.zeros((K,), bool)
+    rsvs = np.zeros((K,), bool)
+    return inputs, ident, alphas, looks, rsvs, ident.copy()

@@ -203,13 +203,18 @@ class EncodeSession:
         self._compat: Optional[np.ndarray] = None  # PRE-gate [G, O]
         self._nodes: Dict[str, _NodeRec] = {}
         self._ex_compat: Optional[np.ndarray] = None  # PRE-seed [G, E]
-        # observed problem-shape history (G, O, E, zones, axes) -> the slot
-        # budget the solver's bucket used (None until a solve reports it via
-        # ``note_bucket_slots``) — the AOT pre-compiler's hint source. The
+        # observed problem-shape history (G, O, E, zones, axes) -> (slot
+        # budget, fleet width) the solver's bucket last used (slots None
+        # until a solve reports it via ``note_bucket_slots``) — the AOT
+        # pre-compiler's hint source. The fleet width rides along so the
+        # background worker pre-builds the BATCHED executables the sharded
+        # steady state actually dispatches, not just their B=1 shapes. The
         # session sees every round's shape, and unlike the process-wide
         # pattern ring (churned by sweep clones' shapes) this history is the
         # reconcile loop's OWN recent buckets. Bounded; most-recent-kept.
-        self._shape_hints: Dict[Tuple[int, int, int, int, int], Optional[int]] = {}
+        self._shape_hints: Dict[
+            Tuple[int, int, int, int, int], Tuple[Optional[int], int]
+        ] = {}
 
     # -- dirty intake -------------------------------------------------------
     def pod_event(self, event: str, pod: Pod) -> None:
@@ -293,27 +298,39 @@ class EncodeSession:
             len(problem.zones), len(problem.resource_axes),
         )
         hints = self._shape_hints
-        slots = hints.pop(dims, None)  # re-insert most-recent, keep known S
-        hints[dims] = slots
+        # re-insert most-recent, keep known (S, fleet width)
+        entry = hints.pop(dims, (None, 1))
+        hints[dims] = entry
         while len(hints) > 8:
             hints.pop(next(iter(hints)))
 
     def note_bucket_slots(
-        self, dims: Tuple[int, int, int, int, int], slots: int
+        self, dims: Tuple[int, int, int, int, int], slots: int, fleet: int = 1
     ) -> None:
         """The solver reports which slot budget ``dims`` actually solved
         with — a hint without it cannot be pre-compiled (the bucket's S is a
-        solver-side estimate the session cannot derive)."""
+        solver-side estimate the session cannot derive) — plus the fleet
+        width the dispatch batched at (1 = un-batched), so the hint
+        pre-builds the executable variant the next such round will call."""
         with self._lock:
             if dims in self._shape_hints:
-                self._shape_hints[dims] = slots
+                # an un-batched (fleet=1) round keeps the learned width:
+                # cells solve alone whenever they churn alone, and that
+                # must not stop the pre-compiler building the batched
+                # variant the next multi-cell round dispatches
+                prior = self._shape_hints[dims][1]
+                width = int(fleet) if int(fleet) > 1 else prior
+                self._shape_hints[dims] = (slots, max(width or 1, 1))
 
-    def shape_hints(self) -> List[Tuple[int, int, int, int, int, Optional[int]]]:
+    def shape_hints(
+        self,
+    ) -> List[Tuple[int, int, int, int, int, Optional[int], int]]:
         """Recent distinct problem shapes this session encoded (oldest
-        first), each with the solver-reported slot budget or None —
-        consumed by the solver's AOT pre-compile pool."""
+        first), each with the solver-reported slot budget (or None) and
+        the last fleet width — consumed by the solver's AOT pre-compile
+        pool."""
         with self._lock:
-            return [dims + (s,) for dims, s in self._shape_hints.items()]
+            return [dims + entry for dims, entry in self._shape_hints.items()]
 
     def flush_pending(self) -> None:
         """Apply queued pod ops to the membership records without encoding —

@@ -19,6 +19,7 @@ import dataclasses
 import math
 import threading
 import time
+from collections import OrderedDict
 from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -33,10 +34,12 @@ from .jax_solver import (
     BucketKey,
     PackInputs,
     bucket_existing,
+    bucket_fleet,
     bucket_groups,
     bucket_key,
     bucket_options,
     bucket_zones,
+    fleet_padding,
     make_orders,
     unpack_solve_fused,
 )
@@ -431,6 +434,54 @@ class Solver(abc.ABC):
             slots.pop(0)
         return problem
 
+    def encode_for_staging(
+        self,
+        pods: Sequence[Pod],
+        provisioners: Sequence[Tuple[Provisioner, Sequence[InstanceType]]],
+        existing: Sequence[ExistingNode] = (),
+        daemonsets: Sequence[Pod] = (),
+        session=None,
+        phase_mode: str = "full",
+    ) -> EncodedProblem:
+        """``solve_pods``' encode stage alone: encode (delta-aware through
+        the session) + intern, with the spent encode time stamped on the
+        problem so a later ``solve_pods(..., pre_encoded=problem)`` books it
+        into ``encode_s``. The fleet-dispatch path encodes every dirty cell
+        FIRST, groups the problems by executable bucket, and fires the
+        batched kernel dispatches before any per-cell solve runs — the
+        device computes the whole fleet while the host paths execute."""
+        t0 = time.perf_counter()
+        if session is not None:
+            fresh = session.encode(
+                pods, provisioners, existing, daemonsets,
+                risk_penalty=self.risk_penalty,
+            )
+        else:
+            fresh = encode(
+                pods, provisioners, existing, daemonsets,
+                risk_penalty=self.risk_penalty,
+            )
+            fresh.__dict__["_encode_mode"] = phase_mode
+            _observe_phase(fresh, "encode", time.perf_counter() - t0)
+        problem = self._intern_problem(fresh)
+        problem.__dict__["_encode_mode"] = fresh.__dict__.get(
+            "_encode_mode", "full"
+        )
+        problem.__dict__["_pre_encode_s"] = time.perf_counter() - t0
+        return problem
+
+    def solve_fleet(
+        self, requests: Sequence[dict], max_batch: int = 16
+    ) -> List[SolveResult]:
+        """Solve several independent problems (``requests`` are
+        ``solve_pods`` kwarg dicts) as one fleet: same-bucket kernel
+        dispatches batch into single vmapped device calls, everything else
+        — host race, validation, decode, relax/degate — runs per problem
+        exactly as ``solve_pods`` would. Host-only backends have nothing to
+        batch; the base implementation is the serial loop (and the
+        equality oracle for the batched path)."""
+        return [self.solve_pods(**req) for req in requests]
+
     def solve_pods(
         self,
         pods: Sequence[Pod],
@@ -439,6 +490,7 @@ class Solver(abc.ABC):
         daemonsets: Sequence[Pod] = (),
         session=None,
         phase_mode: str = "full",
+        pre_encoded: Optional[EncodedProblem] = None,
     ) -> SolveResult:
         """``session`` (an EncodeSession) makes the INITIAL encode delta-
         aware: the session patches the previous round's arrays instead of
@@ -450,14 +502,21 @@ class Solver(abc.ABC):
         samples when no session owns the mode: real sessionless rounds are
         "full"; consolidation what-if simulations pass "sim" so hundreds of
         microsecond sweep solves per pass cannot swamp the delta-vs-full
-        comparison the histogram exists for."""
+        comparison the histogram exists for.
+
+        ``pre_encoded`` hands in a problem ``encode_for_staging`` already
+        produced (the fleet-dispatch path encodes before staging); the
+        encode stage is skipped and the staged encode time is credited."""
         from ..utils.tracing import span
 
         t0 = time.perf_counter()
         encode_s = 0.0
         with span("solve", pods=len(pods)):
             with span("solve.encode"):
-                if session is not None:
+                if pre_encoded is not None:
+                    fresh = pre_encoded
+                    encode_s += fresh.__dict__.pop("_pre_encode_s", 0.0)
+                elif session is not None:
                     fresh = session.encode(
                         pods, provisioners, existing, daemonsets,
                         risk_penalty=self.risk_penalty,
@@ -587,6 +646,291 @@ def _tensor_path_unsupported(problem: EncodedProblem) -> Optional[str]:
     return problem.rel_unsupported
 
 
+class _FleetBuffer:
+    """The in-flight [B, L] device buffer one fleet dispatch produced,
+    shared by the B batched cells' solves. The first poller to fetch
+    materializes the host copy under the lock (every later cell's poll is
+    then a dict read, collapsing the round's serial device waits into one);
+    a single OBSERVED ready-transition feeds the fleet bucket's dispatch
+    EWMA — keyed on the B-carrying BucketKey, so a B=8 dispatch can never
+    pollute the B=1 bucket's latency estimate."""
+
+    __slots__ = (
+        "buf", "key", "mesh", "t_dispatch", "width", "abandoned", "_lock",
+        "_host", "_ewma_done",
+    )
+
+    def __init__(self, buf, key: BucketKey, mesh, t_dispatch: float, width: int):
+        self.buf = buf
+        self.key = key  # fleet BucketKey (B > 1)
+        self.mesh = mesh
+        self.t_dispatch = t_dispatch
+        self.width = width  # real cells batched (<= key.B; rest padding)
+        # set when a sibling's poll already gave up at its deadline: this
+        # fleet is measured too slow for the round's budget, so sibling
+        # cells take whatever is ready instantly but never burn their own
+        # deadline waits on it (one wasted wait per fleet, not B)
+        self.abandoned = False
+        self._lock = threading.Lock()
+        self._host: Optional[np.ndarray] = None
+        self._ewma_done = False
+
+    def is_ready(self) -> bool:
+        with self._lock:
+            if self._host is not None:
+                return True
+        try:
+            return self.buf.is_ready()
+        except Exception:
+            return True  # let materialize() surface the real error
+
+    def note_ready(self, observed_at: float) -> None:
+        """Record dispatch->ready latency ONCE per fleet (the first solve
+        whose poll observed the transition); censored observations record
+        nothing, exactly like the single-problem path."""
+        with self._lock:
+            if self._ewma_done:
+                return
+            self._ewma_done = True
+        AOT_CACHE.note_dispatch(
+            self.key, observed_at - self.t_dispatch, donate=False,
+            mesh=self.mesh,
+        )
+
+    def note_miss(self, observed_at: float) -> None:
+        """A poll gave up before the fleet buffer was ready: record the
+        elapsed time as a PESSIMISTIC latency sample (a floor on the true
+        dispatch latency) against the B-keyed bucket, once per fleet. The
+        next round's staging admission then backs off THIS bucket on its
+        own measured evidence — a too-wide fleet on an overloaded device
+        stops batching cleanly, without opening the per-cell race breaker
+        (the B=1 dispatches may be perfectly healthy)."""
+        with self._lock:
+            if self._ewma_done:
+                return
+            self._ewma_done = True
+        AOT_CACHE.note_dispatch(
+            self.key, observed_at - self.t_dispatch, donate=False,
+            mesh=self.mesh,
+        )
+
+    def materialize(self) -> np.ndarray:
+        with self._lock:
+            if self._host is None:
+                self._host = np.asarray(self.buf)
+            return self._host
+
+
+class _FleetDispatch:
+    """One cell's slice of an in-flight fleet dispatch: the shared buffer
+    plus this problem's batch row and unpack metadata. Attached to the
+    encoded problem by ``stage_fleet``; consumed (popped) by ``solve``."""
+
+    __slots__ = ("shared", "row", "orders", "swaps", "s_new", "n_zones")
+
+    def __init__(self, shared, row, orders, swaps, s_new, n_zones):
+        self.shared = shared
+        self.row = row
+        self.orders = orders
+        self.swaps = swaps
+        self.s_new = s_new
+        self.n_zones = n_zones
+
+
+def stage_fleet(
+    entries: Sequence[Tuple["TPUSolver", EncodedProblem]],
+    max_batch: int = 16,
+) -> dict:
+    """Batch same-bucket kernel dispatches into single vmapped device calls.
+
+    ``entries`` pairs each freshly encoded problem with the solver that will
+    solve it (the sharded control plane's per-cell clones — clones share
+    dispatch config, so their bucket keys agree). Problems are grouped by
+    their (B=1) executable bucket; each group is chunked to the largest
+    power of two <= ``max_batch``, padded to its pow2 fleet width with
+    provably inert slots, and dispatched through ONE AOT fleet executable —
+    the round then pays O(distinct buckets) device calls instead of
+    O(cells). Each batched problem carries a ``_fleet_dispatch`` handle its
+    solve consumes in place of its own per-cell async dispatch; everything
+    downstream (host race, comparison, validation, decode) is unchanged,
+    and the vmapped member program is bit-identical to the B=1 program, so
+    batching can never change an answer.
+
+    Problems the per-cell race would not dispatch (tiny, oracle-only
+    constraint shapes, race memory says the kernel loses here, open race
+    breaker) are skipped, as are chunks whose fleet executable is not
+    resident yet — those cells fall back to the classic path unchanged
+    while the background worker brings the fleet bucket up.
+
+    Returns staging stats for the round's capsule/bench accounting:
+    ``dispatches`` (device calls fired), ``cells_batched``, ``eligible``,
+    ``cold_buckets``, and per-dispatch ``buckets`` labels.
+    """
+    from ..utils import metrics
+
+    stats = {
+        "dispatches": 0, "cells_batched": 0, "eligible": 0,
+        "cold_buckets": 0, "buckets": [],
+    }
+    if max_batch < 2 or len(entries) < 2:
+        return stats
+    # largest pow2 chunk width within the cap: chunk size == fleet width, so
+    # the cap bounds the compiled batch axis, not just the real cells
+    width_cap = 1 << (int(max_batch).bit_length() - 1)
+    groups: "OrderedDict[BucketKey, list]" = OrderedDict()
+    for solver, problem in entries:
+        if problem is None or problem.G == 0:
+            continue
+        if not hasattr(solver, "_bucket_key"):
+            continue  # host-only backend (greedy oracle): nothing to batch
+        if problem.O == 0 and problem.E == 0:
+            continue
+        if _tensor_path_unsupported(problem) is not None:
+            continue
+        if solver.latency_budget_s > 1.0:
+            continue  # quality mode solves synchronously; nothing to race
+        if int(problem.count.sum()) < solver.race_min_pods:
+            continue  # tiny problems never race the device (host answers in ms)
+        solver._expire_race_memory(problem)
+        if problem.__dict__.get("_race_kernel_lost", False):
+            continue
+        if problem.__dict__.get("_race_kernel_result") is not None:
+            continue
+        if problem.__dict__.get("_fleet_skip", False):
+            # a previous fleet row for this problem was dropped unconsumed
+            # (a cached topology plan served the solve): re-staging would
+            # re-pay staging + a dispatch nobody polls, every round
+            continue
+        if solver._race_fails >= 3:
+            continue  # open race breaker: per-cell half-open probe owns retries
+        stats["eligible"] += 1
+        groups.setdefault(solver._bucket_key(problem), []).append(
+            (solver, problem)
+        )
+    cleared: set = set()
+    for key, members in groups.items():
+        for base in range(0, len(members), width_cap):
+            chunk = members[base : base + width_cap]
+            if len(chunk) < 2:
+                continue  # a lone cell dispatches per-cell as before
+            B = bucket_fleet(len(chunk))
+            fleet_key = key._replace(B=B)
+            owner = chunk[0][0]
+            mesh = owner._ensure_mesh()
+            # admission on MEASURED fleet latency: the fleet bucket's own
+            # EWMA when it has dispatched, else the B=1 bucket's — read
+            # under the SAME donate variant the per-cell dispatches record
+            # under — else the process RTT probe (the per-cell race's ladder)
+            pred = AOT_CACHE.predicted_dispatch_s(fleet_key, mesh=mesh)
+            if pred is None:
+                pred = AOT_CACHE.predicted_dispatch_s(
+                    key, donate=owner._donate(), mesh=mesh
+                )
+            if pred is None:
+                pred = owner.device_rtt()
+            if pred >= owner.latency_budget_s:
+                continue
+            # get(), not ready(): the lookup IS the fleet's use decision —
+            # a cold fleet bucket counts as a miss and queues a background
+            # build; its cells race per-cell this round
+            exe = AOT_CACHE.get(fleet_key, mesh=mesh)
+            if exe is None:
+                if owner.aot_precompile:
+                    AOT_CACHE.warm([fleet_key], mesh=mesh)
+                stats["cold_buckets"] += 1
+                continue
+            try:
+                staged = _stage_fleet_chunk(
+                    chunk, key, fleet_key, B, mesh, exe, cleared
+                )
+            except Exception:
+                continue  # cells fall back to the per-cell race unchanged
+            if staged:
+                stats["dispatches"] += 1
+                stats["cells_batched"] += len(chunk)
+                stats["buckets"].append(fleet_key.label())
+                metrics.FLEET_DISPATCH.inc({"bucket": fleet_key.label()})
+    return stats
+
+
+def _stage_fleet_chunk(chunk, key, fleet_key, B, mesh, exe, cleared) -> bool:
+    """Stack one chunk's padded tensors along the batch axis, dispatch the
+    fleet executable, and attach per-problem slices. All-or-nothing: handles
+    attach only after the dispatch is in flight."""
+    import jax
+    import jax.numpy as jnp
+
+    rows = []
+    for solver, problem in chunk:
+        prep = solver._prepare(problem, bucket=key)
+        (inputs, orders, alphas, looks, rsvs, swaps, s_new, n_zones) = prep
+        # seed the owner's host cache with the prepared arrays so the host
+        # FFD competitor (topology shapes) never re-pays _prepare; one
+        # clear per owner per staging pass, so a single-solver fleet
+        # (bench, solve_fleet) keeps every staged problem resident
+        with solver._cache_lock:
+            if id(solver) not in cleared:
+                cleared.add(id(solver))
+                solver._host_cache.clear()
+            solver._host_cache[id(problem)] = (
+                problem, inputs, orders, alphas, looks, s_new, n_zones,
+                [None],
+            )
+        rows.append((solver, problem, prep))
+    pad = fleet_padding(key)
+    padded = [r[2][:6] for r in rows] + [pad] * (B - len(rows))
+
+    def stack(i):
+        return np.stack([np.asarray(p[i]) for p in padded])
+
+    inputs_b = PackInputs(
+        *[
+            np.stack([np.asarray(getattr(p[0], f)) for p in padded])
+            for f in PackInputs._fields
+        ]
+    )
+    orders_b, alphas_b, looks_b, rsvs_b, swaps_b = (
+        stack(1), stack(2), stack(3), stack(4), stack(5),
+    )
+    if mesh is not None:
+        from ..parallel import shard_fleet
+
+        (inputs_d, orders_d, alphas_d, looks_d, rsvs_d, swaps_d) = shard_fleet(
+            mesh, B, jax.tree.map(jnp.asarray, inputs_b),
+            jnp.asarray(orders_b), jnp.asarray(alphas_b),
+            jnp.asarray(looks_b), jnp.asarray(rsvs_b), jnp.asarray(swaps_b),
+        )
+    else:
+        inputs_d = jax.tree.map(jnp.asarray, inputs_b)
+        orders_d, alphas_d, looks_d, rsvs_d, swaps_d = (
+            jnp.asarray(orders_b), jnp.asarray(alphas_b),
+            jnp.asarray(looks_b), jnp.asarray(rsvs_b), jnp.asarray(swaps_b),
+        )
+    t_dispatch = time.perf_counter()
+    buf = exe(inputs_d, orders_d, alphas_d, looks_d, rsvs_d, swaps_d)
+    shared = _FleetBuffer(buf, fleet_key, mesh, t_dispatch, len(rows))
+    s_new, n_zones = key.S, key.Z
+    for row, (solver, problem, prep) in enumerate(rows):
+        problem.__dict__["_fleet_dispatch"] = _FleetDispatch(
+            shared, row, prep[1], prep[5], s_new, n_zones
+        )
+        # persistent width stamp (the handle above is popped by solve):
+        # _prewarm reads it to hint the session's shape history with B, so
+        # the background worker pre-builds the executables the sharded
+        # steady state actually calls
+        problem.__dict__["_fleet_b"] = B
+        # round-budget share: the sharded round's latency contract is per
+        # ROUND, but an un-batched round burns a full host-polish budget
+        # per cell — the round SLO silently became O(cells) x budget. The
+        # fleet knows its width up front, so batched cells split one round
+        # budget for the HOST path's adaptive polish (floored in solve();
+        # the LP/FFD feasibility answer is never starved). The kernel
+        # answer is budget-independent and bit-identical either way — at
+        # high fleet widths it increasingly carries the quality.
+        problem.__dict__["_budget_share"] = 1.0 / len(rows)
+    return True
+
+
 class TPUSolver(Solver):
     """Hybrid solver: portfolio packing kernel raced against a host LP fast path.
 
@@ -691,6 +1035,20 @@ class TPUSolver(Solver):
                 self.mesh = make_mesh()
         return self.mesh
 
+    #: problems below this many pods never race the device in latency mode
+    #: (the host paths answer in single-digit ms; a dispatch costs a round
+    #: trip and, cold, a background compile). One definition shared by the
+    #: per-cell race and the fleet staging admission; class-level so tests
+    #: can open the gate cheaply.
+    race_min_pods: int = 450
+
+    #: floor (seconds) on a fleet cell's shared host-polish budget: the
+    #: round-budget share must never starve the host pipeline below its
+    #: base LP + rounding + first ruin-recreate pass, or the wall clock the
+    #: fleet saves is paid for in solution quality. Class-level so tests
+    #: and bench sweeps can tune it for every solver at once.
+    fleet_host_floor_s: float = 0.045
+
     _device_rtt_s: Optional[float] = None  # class-level: one probe per process
 
     @classmethod
@@ -744,6 +1102,21 @@ class TPUSolver(Solver):
         # spent part of the budget already. Popped so a later direct
         # solve(problem) can't see a stale timestamp and zero its budget.
         t_anchor = problem.__dict__.pop("_entry_t", t0)
+        # a fleet handle (stage_fleet batched this problem's kernel dispatch
+        # into a shared vmapped call) is consumed exactly once — popped even
+        # on paths that won't poll it, so a stale handle can never alias a
+        # later solve of the same problem object
+        fleet_slot = problem.__dict__.pop("_fleet_dispatch", None)
+        # fleet cells split one ROUND budget for host-path polish (stamped
+        # by stage_fleet; 1.0 everywhere else). Floored at
+        # ``fleet_host_floor_s`` so the host pipeline always reaches its
+        # base ruin-recreate pass — the share trims the open-ended polish
+        # tail, never the base plan's quality.
+        budget_share = problem.__dict__.pop("_budget_share", 1.0)
+        host_budget_s = max(
+            self.latency_budget_s * budget_share,
+            min(self.latency_budget_s, self.fleet_host_floor_s),
+        )
         if problem.G == 0:
             return SolveResult(stats={"backend": 1.0})
         if problem.O == 0 and problem.E == 0:
@@ -772,7 +1145,7 @@ class TPUSolver(Solver):
         # fresh shape) spawns a background XLA compile that steals CPU from
         # whatever comes next. Consolidation candidate simulations — dozens
         # of fresh few-pod problems per sweep — are the canonical case.
-        tiny = int(problem.count.sum()) < 450
+        tiny = int(problem.count.sum()) < self.race_min_pods
         # A kernel result that WON a race on this problem is deterministic for
         # the unchanged problem: repeat solves compare the cached answer
         # against the (still-improving) host plan instead of re-paying the
@@ -802,25 +1175,40 @@ class TPUSolver(Solver):
             and not kernel_hopeless
             and kernel_cached is None
             and topo_fast is None
-            and self._race_dispatch_affordable(problem)
         ):
-            # Fire the kernel at the device BEFORE the host path runs: the
-            # dispatch is non-blocking, so the TPU computes concurrently with
-            # the host path and the poll below only pays the leftover wait.
-            # Skipped when the MEASURED dispatch latency of this problem's
-            # bucket (EWMA; process RTT probe before the bucket's first
-            # dispatch) exceeds the latency budget — a tunneled chip at
-            # ~120ms can never answer a sub-100ms race; the host path owns
-            # that link, while a bucket measured fast keeps racing even when
-            # some other bucket is slow.
-            dispatched = self._dispatch_async(problem)
+            if fleet_slot is not None:
+                # the kernel for this problem is ALREADY in flight as one
+                # row of a batched fleet dispatch — poll that instead of
+                # firing a per-cell dispatch (the whole point: one device
+                # call per distinct bucket per round, not per cell)
+                dispatched = fleet_slot
+            elif self._race_dispatch_affordable(problem):
+                # Fire the kernel at the device BEFORE the host path runs:
+                # the dispatch is non-blocking, so the TPU computes
+                # concurrently with the host path and the poll below only
+                # pays the leftover wait. Skipped when the MEASURED dispatch
+                # latency of this problem's bucket (EWMA; process RTT probe
+                # before the bucket's first dispatch) exceeds the latency
+                # budget — a tunneled chip at ~120ms can never answer a
+                # sub-100ms race; the host path owns that link, while a
+                # bucket measured fast keeps racing even when some other
+                # bucket is slow.
+                dispatched = self._dispatch_async(problem)
+        if fleet_slot is not None and dispatched is not fleet_slot:
+            # the fleet row is being dropped unconsumed (a cached topology
+            # plan, race memory, or a cached kernel result serves this
+            # solve): remember per problem, so stage_fleet stops paying
+            # staging + a device dispatch nobody polls on every repeat
+            # round of the same interned problem
+            problem.__dict__["_fleet_skip"] = True
         host_result = topo_fast
         if host_result is None:
             try:
                 # the host path may spend budget left after a feasible plan
                 # exists on adaptive polish (pattern CG + ruin-recreate);
-                # quality mode gets a fixed cap, not its multi-second budget
-                host_deadline = t_anchor + min(self.latency_budget_s * 0.85, 0.5)
+                # quality mode gets a fixed cap, not its multi-second
+                # budget, and fleet cells polish on their round-budget share
+                host_deadline = t_anchor + min(host_budget_s * 0.85, 0.5)
                 host_result = solve_host(
                     problem, deadline=host_deadline, spike_s=self.warmup_spike_s
                 )
@@ -845,7 +1233,7 @@ class TPUSolver(Solver):
 
                     improved = topo_improve(
                         problem, self, host_result.cost,
-                        deadline=t_anchor + self.latency_budget_s * 0.85,
+                        deadline=t_anchor + host_budget_s * 0.85,
                         incumbent=host_result,
                     )
                     if improved is not None:
@@ -902,6 +1290,25 @@ class TPUSolver(Solver):
             result = self._fallback.solve(problem)
             result.stats["fallback"] = 1.0
         return result
+
+    def solve_fleet(
+        self, requests: Sequence[dict], max_batch: int = 16
+    ) -> List[SolveResult]:
+        """Multi-problem entry: encode every request first (delta-aware per
+        request's session), batch same-bucket kernel dispatches into single
+        vmapped device calls via ``stage_fleet``, then run each request's
+        solve — which consumes its fleet slice in place of a per-problem
+        dispatch. Answers are identical to the serial ``solve_pods`` loop
+        (the vmapped member program is bit-identical to the B=1 program);
+        only the device-call count and the wall clock change."""
+        staged = [self.encode_for_staging(**req) for req in requests]
+        stage_fleet(
+            [(self, p) for p in staged], max_batch=max_batch
+        )
+        return [
+            self.solve_pods(**req, pre_encoded=p)
+            for req, p in zip(requests, staged)
+        ]
 
     def _solve_host_pack(self, problem: EncodedProblem) -> Optional[SolveResult]:
         """A small portfolio of numpy FFD members (FFD / footprint orderings
@@ -1030,7 +1437,7 @@ class TPUSolver(Solver):
         shape distribution — the likely NEXT buckets a novel batch lands on."""
         if not self.aot_precompile:
             return
-        if self.latency_budget_s <= 1.0 and int(problem.count.sum()) < 450:
+        if self.latency_budget_s <= 1.0 and int(problem.count.sum()) < self.race_min_pods:
             # tiny problems never dispatch the device in latency mode (the
             # host paths answer in single-digit ms) — compiling their
             # buckets would burn background CPU for executables no race
@@ -1041,6 +1448,7 @@ class TPUSolver(Solver):
             from .patterns import note_shape, recent_shapes
 
             key = self._bucket_key(problem)
+            fleet_b = int(problem.__dict__.get("_fleet_b", 1))
             dims = (
                 problem.G, problem.O, problem.E,
                 len(problem.zones), len(problem.resource_axes),
@@ -1049,25 +1457,43 @@ class TPUSolver(Solver):
             if session is not None and hasattr(session, "note_bucket_slots"):
                 # the session records shapes at ENCODE time but cannot derive
                 # the bucket's slot budget (a solver-side estimate): report
-                # it back, so the session's own history — which outlives the
+                # it back — WITH the fleet width this round dispatched at,
+                # so the session's own history — which outlives the
                 # process-wide ring's churn from sweep-clone shapes — stays
-                # pre-compilable
-                session.note_bucket_slots(dims, key.S)
+                # pre-compilable for the executables the sharded steady
+                # state actually calls
+                session.note_bucket_slots(dims, key.S, fleet=fleet_b)
             keys = [key, key._replace(S=min(key.S * 2, self.max_slots))]
+            # fleet variants compile (and are cached) donate-free — the
+            # staging stacks fresh host arrays per dispatch — so they warm
+            # through a separate donate=False call below
+            fleet_keys = [key._replace(B=fleet_b)] if fleet_b > 1 else []
             k = round_up_portfolio(self.portfolio, self._ensure_mesh())
             # the slot budget comes WITH each hint — a hint without one is
             # skipped, never guessed: a wrong-S compile is a multi-second
             # XLA build no solve ever dispatches, and it can LRU-evict
             # genuinely warm entries
-            hints = [(tuple(h[:5]), h[5]) for h in recent_shapes() if len(h) > 5]
+            hints = [
+                (tuple(h[:5]), h[5], 1) for h in recent_shapes() if len(h) > 5
+            ]
             if session is not None and hasattr(session, "shape_hints"):
                 hints.extend(
-                    (tuple(h[:5]), h[5]) for h in session.shape_hints()
+                    (tuple(h[:5]), h[5], h[6] if len(h) > 6 else 1)
+                    for h in session.shape_hints()
                 )
-            for (g, o, e, z, r), s in hints:
+            for (g, o, e, z, r), s, b in hints:
                 if s:
-                    keys.append(bucket_key(g, o, e, s, z, r, k))
+                    hk = bucket_key(g, o, e, s, z, r, k)
+                    keys.append(hk)
+                    if b and b > 1:
+                        # a hint that last solved as a fleet row pre-builds
+                        # the FLEET variant too — a B=1-only warm set would
+                        # leave every sharded round's first batched dispatch
+                        # cold
+                        fleet_keys.append(hk._replace(B=bucket_fleet(b)))
             AOT_CACHE.warm(keys, donate=self._donate(), mesh=self._ensure_mesh())
+            if fleet_keys:
+                AOT_CACHE.warm(fleet_keys, mesh=self._ensure_mesh())
         except Exception:
             pass  # pre-compiles are hints; never fail a solve over them
 
@@ -1163,6 +1589,8 @@ class TPUSolver(Solver):
         when its on-device cost already beats the host result."""
         if dispatched is None:
             return None
+        if isinstance(dispatched, _FleetDispatch):
+            return self._poll_fleet(problem, dispatched, deadline, host_cost)
         buf, orders, swaps, s_new, n_zones, inputs, key, t_dispatch = dispatched
         try:
             # ready-transition tracking: this poll starts AFTER the host path
@@ -1233,6 +1661,76 @@ class TPUSolver(Solver):
             # capsule forensics are always bucket + hit
             result.stats["aot_hit"] = 1.0
             result.stats["aot_bucket"] = key.label()
+            return result
+        except Exception:
+            return None
+
+    def _poll_fleet(
+        self,
+        problem: EncodedProblem,
+        slot: _FleetDispatch,
+        deadline: float,
+        host_cost: float,
+    ) -> Optional[SolveResult]:
+        """Fleet analogue of ``_poll_dispatch``: wait (bounded) on the SHARED
+        batch buffer, slice out this problem's row, and decode it only when
+        its cost beats the host result. The first cell's poll materializes
+        the whole batch; every sibling's poll then costs a dict read — the
+        round pays one device wait total, not one per cell."""
+        shared = slot.shared
+        try:
+            ready_at = None
+            if shared.is_ready():
+                ready_at = 0.0  # censored: ready before we ever looked
+            elif not shared.abandoned:
+                while time.perf_counter() < deadline:
+                    if shared.is_ready():
+                        ready_at = time.perf_counter()
+                        break
+                    time.sleep(0.0005)
+            if ready_at is None:
+                shared.abandoned = True
+                # a fleet miss is BUCKET evidence, not device evidence: the
+                # pessimistic EWMA sample backs the fleet bucket's own
+                # admission off; the per-cell breaker (_race_fails) is left
+                # alone — B=1 dispatches may be perfectly healthy
+                shared.note_miss(time.perf_counter())
+                misses = problem.__dict__.get("_race_miss_count", 0) + 1
+                problem.__dict__["_race_miss_count"] = misses
+                if misses >= 2:
+                    self._mark_kernel_lost(problem)
+                return None
+            self._race_fails = 0  # the device answered: the breaker relaxes
+            problem.__dict__.pop("_race_miss_count", None)
+            if ready_at:
+                # observed transition: ONE honest latency sample per fleet,
+                # recorded against the B-keyed bucket (note_ready dedups)
+                shared.note_ready(ready_at)
+            raw = shared.materialize()[slot.row]
+            k = slot.orders.shape[0]
+            key = shared.key
+            order, unplaced, costs, exhausted, new_opt, new_active, ys = (
+                unpack_solve_fused(
+                    raw, k, slot.s_new, key.G, key.E, slot.orders, slot.swaps
+                )
+            )
+            if unplaced > 0 or costs.min() >= host_cost:
+                self._mark_kernel_lost(problem)
+                return None
+            if validate_counts(problem, order, new_opt, new_active, ys):
+                self._mark_kernel_lost(problem)
+                return None
+            result = self._decode(problem, order, new_opt, new_active, ys)
+            result.stats["backend"] = 1.0
+            idx = int(np.argmin(costs))
+            result.stats["portfolio_phase"] = float(idx >= k)
+            result.stats["portfolio_best"] = float(idx % k)
+            result.stats["validated_counts"] = 1.0
+            # a fleet only ever dispatches off a resident executable, so the
+            # capsule forensics are bucket + hit + the batch width
+            result.stats["aot_hit"] = 1.0
+            result.stats["aot_bucket"] = key.label()
+            result.stats["fleet_b"] = float(key.B)
             return result
         except Exception:
             return None

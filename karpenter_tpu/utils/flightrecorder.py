@@ -233,6 +233,12 @@ class CapsuleBuilder:
                     "bucket": stats.get("aot_bucket"),
                     "hit": bool(stats["aot_hit"]) if "aot_hit" in stats else None,
                 }
+                if "fleet_b" in stats:
+                    # fleet width: this solve's kernel answer came from row
+                    # b of a batched (vmapped) device call shared with
+                    # fleet-1 sibling cells — forensics for the dispatch-
+                    # count story, like bucket/hit never a replay input
+                    aot["fleet"] = int(stats["fleet_b"])
             self._aot.append(aot)
 
     def note_anomaly(self, trigger: str) -> None:

@@ -436,6 +436,22 @@ ENCODE_FULL_REASONS = Counter(
          "weight-degate, periodic-resync, relist, provisioner-change, ...).",
     registry=REGISTRY,
 )
+# fleet dispatch (solver stage_fleet + the provisioning sharded path)
+FLEET_DISPATCH = Counter(
+    "karpenter_tpu_fleet_dispatch_total",
+    help="Batched kernel device calls fired by fleet dispatch, labeled by "
+         "the fleet executable bucket (the B-suffixed shape label); each "
+         "call solved up to B same-bucket cell problems at once.",
+    registry=REGISTRY,
+)
+FLEET_ROUND_DISPATCHES = Gauge(
+    "karpenter_tpu_fleet_round_dispatches",
+    help="Batched device dispatches the last sharded provisioning round "
+         "issued (O(distinct buckets); cells the fleet could not batch — "
+         "tiny, cold bucket, race memory — dispatch per-cell and are not "
+         "counted here).",
+    registry=REGISTRY,
+)
 # cell-sharded control plane (state/cells.py + the provisioning sharded path)
 CELLS_TOTAL = Gauge(
     "karpenter_tpu_cells_total",

@@ -1,0 +1,614 @@
+"""ISSUE 12 suite: fleet dispatch — same-bucket per-cell kernel solves
+batched into one vmapped device call.
+
+The load-bearing contract is EQUIVALENCE: row ``b`` of a fleet dispatch must
+be bit-identical to a B=1 dispatch of problem ``b`` (vmap may never change a
+member's answer), padded fleet slots must be inert, and the sharded
+controller's fleet flow (encode-first + staged handles) must leave every
+digest byte-identical to the per-cell-dispatch flow — pinned by capsule
+replay including the ``--override settings.fleet_dispatch_enabled=false``
+counterfactual. Around that: staging admission policy (tiny/quality/lost
+races skip; cold buckets back off and warm in the background), the B-keyed
+dispatch EWMA (a B=8 sample must not pollute the B=1 estimate), and the
+session shape hints carrying the fleet width to the pre-compiler.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+
+import numpy as np
+import pytest
+
+from karpenter_tpu.api import labels as wk
+from karpenter_tpu.api.settings import Settings
+from karpenter_tpu.solver import EncodeSession, TPUSolver, encode
+from karpenter_tpu.solver import jax_solver as J
+from karpenter_tpu.solver.solver import (
+    GreedySolver,
+    _FleetDispatch,
+    problem_digest,
+    stage_fleet,
+    validate_counts,
+)
+from karpenter_tpu.utils import metrics
+
+from helpers import make_pod, make_pods, make_provisioner, setup as _setup
+
+
+def _mix_problem(seed: int):
+    """Random per-cell problem mixes: plain deployments plus gang groups and
+    spot-diversification groups (both fold into the scheduling signature,
+    so they exercise the encode surfaces the fleet path must not disturb)."""
+    rng = np.random.default_rng(seed)
+    provs = _setup(6)
+    pods = []
+    for gi in range(int(rng.integers(1, 4))):
+        n = int(rng.integers(3, 9))
+        pods.extend(make_pods(
+            n, prefix=f"f{seed}g{gi}",
+            cpu=["100m", "250m", "500m"][int(rng.integers(0, 3))],
+            labels={"app": f"a{gi}"},
+        ))
+    if seed % 2:
+        g = {wk.POD_GROUP: f"ring{seed}", wk.POD_GROUP_MIN_MEMBERS: "3"}
+        pods.extend(make_pods(3, prefix=f"f{seed}gang", labels=dict(g)))
+    if seed % 3 == 0:
+        for i in range(4):
+            p = make_pod(name=f"f{seed}dv{i}", labels={"app": "dv"})
+            p.meta.annotations[wk.SPOT_DIVERSIFICATION] = "0.5"
+            pods.append(p)
+    return encode(pods, provs)
+
+
+def _dispatch_single(solver, problem, key):
+    """B=1 reference: the classic per-cell dispatch through the AOT bucket."""
+    import jax
+    import jax.numpy as jnp
+
+    prep = solver._prepare(problem, bucket=key)
+    exe = J.AOT_CACHE.compile(key, mesh=solver._ensure_mesh())
+    mesh = solver._ensure_mesh()
+    inputs = jax.tree.map(jnp.asarray, prep[0])
+    args = tuple(jnp.asarray(prep[i]) for i in range(1, 6))
+    if mesh is not None:
+        from karpenter_tpu.parallel import shard_portfolio
+
+        inputs, *args = shard_portfolio(mesh, inputs, *args)
+    return np.asarray(exe(inputs, *args)), prep
+
+
+def _dispatch_fleet(solver, problems, key):
+    """Stack ``problems`` (padded to the pow2 fleet width with inert slots)
+    and dispatch the fleet executable once; returns the [B, L] host buffer
+    plus each problem's prep."""
+    import jax
+    import jax.numpy as jnp
+
+    B = J.bucket_fleet(len(problems))
+    mesh = solver._ensure_mesh()
+    preps = [solver._prepare(p, bucket=key) for p in problems]
+    pad = J.fleet_padding(key)
+    padded = [pr[:6] for pr in preps] + [pad] * (B - len(preps))
+    inputs = J.PackInputs(*[
+        np.stack([np.asarray(getattr(p[0], f)) for p in padded])
+        for f in J.PackInputs._fields
+    ])
+    stacks = [np.stack([np.asarray(p[i]) for p in padded]) for i in range(1, 6)]
+    exe = J.AOT_CACHE.compile(key._replace(B=B), mesh=mesh)
+    inputs_d = jax.tree.map(jnp.asarray, inputs)
+    args = tuple(jnp.asarray(s) for s in stacks)
+    if mesh is not None:
+        from karpenter_tpu.parallel import shard_fleet
+
+        inputs_d, *args = shard_fleet(mesh, B, inputs_d, *args)
+    return np.asarray(exe(inputs_d, *args)), preps, B
+
+
+class TestFleetKernelEquivalence:
+    @pytest.mark.parametrize("seed", range(3))
+    def test_batched_rows_bit_identical(self, seed):
+        """Every fleet row == the B=1 dispatch of that problem, to the bit
+        (same executable program under vmap), across random mixes including
+        gang and spot-diversification pods."""
+        problems = [_mix_problem(seed * 10 + i) for i in range(3)]
+        s = TPUSolver(portfolio=4)
+        by_bucket = {}
+        for p in problems:
+            by_bucket.setdefault(s._bucket_key(p), []).append(p)
+        checked = 0
+        for key, group in by_bucket.items():
+            batched, preps, B = _dispatch_fleet(s, group, key)
+            for b, p in enumerate(group):
+                single, _ = _dispatch_single(s, p, key)
+                assert np.array_equal(single, batched[b])
+                checked += 1
+        assert checked == len(problems)
+
+    def test_padded_fleet_slots_inert(self):
+        """Padding rows of a fleet batch pack nothing and cost nothing."""
+        problems = [_mix_problem(40), _mix_problem(41)]
+        s = TPUSolver(portfolio=4)
+        key = s._bucket_key(problems[0])
+        group = [p for p in problems if s._bucket_key(p) == key]
+        group = (group * 3)[:3]  # odd width forces a pow2 padding slot
+        batched, preps, B = _dispatch_fleet(s, group, key)
+        assert B > len(group)  # pow2 padding engaged
+        k = preps[0][1].shape[0]
+        for b in range(len(group), B):
+            row = batched[b]
+            costs = np.frombuffer(
+                row[4 : 4 + 2 * k].tobytes(), dtype=np.float32
+            )
+            assert row[3] == 0  # unplaced
+            assert np.all(costs == 0.0)
+
+    def test_fleet_decode_matches_serial_placements(self):
+        """Decoded placements (node specs + pod names) from a fleet row
+        match the serial dispatch's decode — the placement-digest level of
+        the equivalence contract."""
+        problems = [_mix_problem(50), _mix_problem(51)]
+        s = TPUSolver(portfolio=4)
+        key = s._bucket_key(problems[0])
+        group = [p for p in problems if s._bucket_key(p) == key]
+        if len(group) < 2:
+            pytest.skip("mixes landed on distinct buckets")
+        batched, preps, B = _dispatch_fleet(s, group, key)
+
+        def digest(problem, buf, prep):
+            k = prep[1].shape[0]
+            order, unplaced, costs, exh, new_opt, new_active, ys = (
+                J.unpack_solve_fused(
+                    buf, k, key.S, key.G, key.E, prep[1], prep[5]
+                )
+            )
+            assert validate_counts(problem, order, new_opt, new_active, ys) == []
+            res = s._decode(problem, order, new_opt, new_active, ys)
+            return (
+                round(float(res.cost), 9),
+                sorted(
+                    (n.option.instance_type.name, n.option.zone,
+                     tuple(sorted(n.pod_names)))
+                    for n in res.new_nodes
+                ),
+                sorted(res.unschedulable),
+            )
+
+        for b, p in enumerate(group):
+            single, prep = _dispatch_single(s, p, key)
+            assert digest(p, single, prep) == digest(p, batched[b], prep)
+
+
+class _StubExe:
+    def __init__(self):
+        self.calls = 0
+
+    def __call__(self, inputs, *args):
+        self.calls += 1
+        b = int(np.asarray(inputs.count).shape[0])
+        return np.zeros((b, 8), np.int32)
+
+
+class _StubCache:
+    """AOT-cache stand-in for staging-policy tests: no XLA, scripted
+    residency and latency predictions."""
+
+    def __init__(self, resident=True, pred=None):
+        self.exe = _StubExe()
+        self.resident = resident
+        self.pred = pred
+        self.warmed = []
+
+    def get(self, key, donate=False, mesh=None):
+        return self.exe if self.resident else None
+
+    def warm(self, keys, donate=False, mesh=None):
+        self.warmed.extend(keys)
+        return len(keys)
+
+    def predicted_dispatch_s(self, key, donate=False, mesh=None):
+        return self.pred
+
+    def note_dispatch(self, *a, **kw):
+        pass
+
+
+@pytest.fixture()
+def stub_cache(monkeypatch):
+    import karpenter_tpu.solver.solver as S
+
+    stub = _StubCache()
+    monkeypatch.setattr(S, "AOT_CACHE", stub)
+    return stub
+
+
+def _eligible_problem(i: int = 0):
+    return encode(make_pods(6, prefix=f"st{i}", cpu="250m"), _setup(6))
+
+
+class TestStagingPolicy:
+    def test_same_bucket_chunk_dispatches_once(self, stub_cache):
+        s = TPUSolver(portfolio=4)
+        s.race_min_pods = 0
+        probs = [_eligible_problem(i) for i in range(3)]
+        stats = stage_fleet([(s, p) for p in probs], max_batch=16)
+        assert stats["dispatches"] == 1
+        assert stats["cells_batched"] == 3
+        assert stub_cache.exe.calls == 1
+        for p in probs:
+            slot = p.__dict__.get("_fleet_dispatch")
+            assert isinstance(slot, _FleetDispatch)
+            assert p.__dict__["_fleet_b"] == J.bucket_fleet(3)
+            assert p.__dict__["_budget_share"] == pytest.approx(1 / 3)
+
+    def test_cold_bucket_backs_off_and_warms(self, stub_cache):
+        stub_cache.resident = False
+        s = TPUSolver(portfolio=4)
+        s.race_min_pods = 0
+        probs = [_eligible_problem(i) for i in range(2)]
+        stats = stage_fleet([(s, p) for p in probs], max_batch=16)
+        assert stats["dispatches"] == 0
+        assert stats["cold_buckets"] == 1
+        assert any(k.B > 1 for k in stub_cache.warmed)
+        assert all("_fleet_dispatch" not in p.__dict__ for p in probs)
+
+    def test_slow_bucket_ewma_blocks_admission(self, stub_cache):
+        stub_cache.pred = 10.0  # measured far beyond any latency budget
+        s = TPUSolver(portfolio=4)
+        s.race_min_pods = 0
+        probs = [_eligible_problem(i) for i in range(2)]
+        stats = stage_fleet([(s, p) for p in probs], max_batch=16)
+        assert stats["dispatches"] == 0
+
+    def test_ineligible_problems_skip(self, stub_cache):
+        s = TPUSolver(portfolio=4)  # race_min_pods default: all tiny
+        quality = TPUSolver(portfolio=4, latency_budget_s=30.0)
+        quality.race_min_pods = 0
+        lost = TPUSolver(portfolio=4)
+        lost.race_min_pods = 0
+        p_tiny, p_quality, p_lost = (_eligible_problem(i) for i in range(3))
+        p_lost.__dict__["_race_kernel_lost"] = True
+        p_lost.__dict__["_race_memory_at"] = 1e18  # never expires in-test
+        stats = stage_fleet(
+            [(s, p_tiny), (quality, p_quality), (lost, p_lost)],
+            max_batch=16,
+        )
+        assert stats["eligible"] == 0
+        assert stats["dispatches"] == 0
+
+    def test_dropped_handle_opts_out_of_restaging(self, stub_cache):
+        """A solve that drops its fleet row unconsumed (race memory served
+        it) stamps the problem, and staging stops re-dispatching rows
+        nobody will poll on repeat rounds of the same interned problem."""
+        s = TPUSolver(portfolio=4)
+        s.race_min_pods = 0
+        probs = [_eligible_problem(i) for i in range(2)]
+        stage_fleet([(s, p) for p in probs], max_batch=16)
+        p = probs[0]
+        assert "_fleet_dispatch" in p.__dict__
+        p.__dict__["_race_kernel_lost"] = True
+        p.__dict__["_race_memory_at"] = 1e18
+        s.solve(p)  # drops the handle: kernel known-hopeless for p
+        assert p.__dict__.get("_fleet_skip") is True
+        # even with the race memory gone, the problem stays un-staged
+        p.__dict__.pop("_race_kernel_lost")
+        p.__dict__.pop("_race_memory_at")
+        stats = stage_fleet([(s, q) for q in probs], max_batch=16)
+        assert stats["eligible"] == 1
+
+    def test_host_only_backend_skips(self, stub_cache):
+        g = GreedySolver()
+        probs = [_eligible_problem(i) for i in range(2)]
+        stats = stage_fleet([(g, p) for p in probs], max_batch=16)
+        assert stats["eligible"] == 0
+
+    def test_single_cell_and_disabled_widths(self, stub_cache):
+        s = TPUSolver(portfolio=4)
+        s.race_min_pods = 0
+        probs = [_eligible_problem(i) for i in range(2)]
+        # one cell: nothing to batch
+        assert stage_fleet([(s, probs[0])], max_batch=16)["dispatches"] == 0
+        # max_batch < 2 disables
+        assert (
+            stage_fleet([(s, p) for p in probs], max_batch=1)["dispatches"]
+            == 0
+        )
+
+
+class TestFleetEWMAKeying:
+    def test_b8_sample_never_pollutes_b1(self):
+        """The race-admission EWMA keys on the fleet width: a slow B=8
+        dispatch leaves the B=1 bucket's latency estimate untouched."""
+        cache = J.AOTCache(capacity=8)
+        cache.configure(persist=False)
+        key1 = J.BucketKey(G=8, O=8, E=1, S=16, Z=1, R=3, K=4)
+        key8 = key1._replace(B=8)
+        entry = J._AOTEntry("exe", 0.0)
+        entry8 = J._AOTEntry("exe8", 0.0)
+        with cache._lock:
+            cache._entries[cache._ckey(key1, False, None)] = entry
+            cache._entries[cache._ckey(key8, False, None)] = entry8
+        cache.note_dispatch(key1, 0.002)
+        cache.note_dispatch(key8, 9.0)
+        assert cache.predicted_dispatch_s(key1) == pytest.approx(0.002)
+        assert cache.predicted_dispatch_s(key8) == pytest.approx(9.0)
+
+    def test_fleet_key_label_and_defaults(self):
+        key = J.BucketKey(G=8, O=8, E=1, S=16, Z=1, R=3, K=4)
+        assert key.B == 1
+        assert "b" not in key.label().rsplit("k", 1)[1]
+        assert key._replace(B=4).label().endswith("k4b4")
+        assert J.bucket_fleet(1) == 1
+        assert J.bucket_fleet(2) == 2
+        assert J.bucket_fleet(3) == 4
+        assert J.bucket_fleet(5) == 8
+
+
+class TestSessionFleetHints:
+    def test_hints_carry_fleet_width(self):
+        session = EncodeSession()
+        provs = _setup(6)
+        problem = session.encode(make_pods(5, prefix="sh"), provs)
+        dims = (
+            problem.G, problem.O, problem.E,
+            len(problem.zones), len(problem.resource_axes),
+        )
+        hints = session.shape_hints()
+        assert hints and hints[-1][:5] == dims
+        assert hints[-1][5] is None and hints[-1][6] == 1
+        session.note_bucket_slots(dims, 32, fleet=4)
+        hints = session.shape_hints()
+        assert hints[-1][5] == 32 and hints[-1][6] == 4
+
+    def test_prewarm_queues_fleet_variant(self, monkeypatch):
+        """A problem that last dispatched as a fleet row (and a session hint
+        carrying B) pre-builds the BATCHED executable variant too."""
+        s = TPUSolver(portfolio=4)
+        s.race_min_pods = 0
+        session = EncodeSession()
+        provs = _setup(6)
+        problem = session.encode(make_pods(5, prefix="pw"), provs)
+        problem.__dict__["_fleet_b"] = 4
+        captured = []
+        monkeypatch.setattr(
+            J.AOT_CACHE, "warm",
+            lambda keys, donate=False, mesh=None: captured.extend(keys),
+        )
+        s._prewarm(problem, session)
+        assert any(k.B == 4 for k in captured)
+        dims = (
+            problem.G, problem.O, problem.E,
+            len(problem.zones), len(problem.resource_axes),
+        )
+        # the session hint recorded the width: a LATER prewarm (fresh
+        # problem, no stamp) still pre-builds the fleet variant from it
+        assert session.shape_hints()[-1][6] == 4
+        captured.clear()
+        p2 = session.encode(make_pods(5, prefix="pw"), provs)
+        s._prewarm(p2, session)
+        assert any(k.B == 4 for k in captured)
+
+
+class TestSolveFleetEndToEnd:
+    def test_solve_fleet_matches_serial_solve_pods(self):
+        """The multi-problem entry returns the same costs and placements as
+        the serial loop — only the device-call count changes."""
+        provs = _setup(6)
+
+        def reqs(tag):
+            return [
+                {"pods": make_pods(8 + i, prefix=f"{tag}{i}", cpu="250m",
+                                   labels={"app": f"e{i}"}),
+                 "provisioners": provs}
+                for i in range(3)
+            ]
+
+        fleet = TPUSolver(portfolio=4)
+        fleet.race_min_pods = 0
+        serial = TPUSolver(portfolio=4)
+        serial.race_min_pods = 0
+        # warm both executables so the race is warm-vs-warm in both arms
+        sample = encode(reqs("w")[0]["pods"], provs)
+        key = fleet._bucket_key(sample)
+        mesh = fleet._ensure_mesh()
+        J.AOT_CACHE.compile(key, mesh=mesh)
+        J.AOT_CACHE.compile(key._replace(B=4), mesh=mesh)
+        label = key._replace(B=4).label()
+        before = metrics.FLEET_DISPATCH.value({"bucket": label}) or 0.0
+        out_fleet = fleet.solve_fleet(reqs("a"))
+        after = metrics.FLEET_DISPATCH.value({"bucket": label}) or 0.0
+        assert after == before + 1  # ONE device call for the whole fleet
+        out_serial = [serial.solve_pods(**r) for r in reqs("a")]
+        for a, b in zip(out_fleet, out_serial):
+            assert a.cost == pytest.approx(b.cost)
+            assert sorted(a.unschedulable) == sorted(b.unschedulable)
+            pa = sorted(
+                (n.option.instance_type.name, n.option.zone,
+                 tuple(sorted(n.pod_names)))
+                for n in a.new_nodes
+            )
+            pb = sorted(
+                (n.option.instance_type.name, n.option.zone,
+                 tuple(sorted(n.pod_names)))
+                for n in b.new_nodes
+            )
+            assert pa == pb
+
+    def test_pre_encoded_solve_pods_identical_digest(self):
+        """encode_for_staging + solve_pods(pre_encoded=...) produces the
+        same problem digest and result as the one-shot solve_pods."""
+        provs = _setup(6)
+        s1 = TPUSolver(portfolio=4)
+        s2 = TPUSolver(portfolio=4)
+        pods = make_pods(6, prefix="pe", cpu="250m")
+        staged = s1.encode_for_staging(pods, provs)
+        r1 = s1.solve_pods(pods, provs, pre_encoded=staged)
+        r2 = s2.solve_pods(pods, provs)
+        assert r1.problem_digest == r2.problem_digest
+        assert r1.cost == pytest.approx(r2.cost)
+
+
+# ---------------------------------------------------------------------------
+# sharded controller: fleet flow, metrics, capsule + replay
+# ---------------------------------------------------------------------------
+
+from karpenter_tpu.cloudprovider import FakeCloudProvider, generate_catalog
+from karpenter_tpu.controllers.provisioning import ProvisioningController
+from karpenter_tpu.replay import replay_capsule
+from karpenter_tpu.state.cluster import Cluster
+from karpenter_tpu.utils.decisions import DECISIONS
+from karpenter_tpu.utils.flightrecorder import FLIGHT
+
+
+@pytest.fixture(autouse=True)
+def _fresh_rings():
+    DECISIONS.configure(2048)
+    DECISIONS.clear()
+    FLIGHT.configure(32)
+    FLIGHT.clear()
+    yield
+    FLIGHT.configure(32)
+    FLIGHT.clear()
+    DECISIONS.clear()
+
+
+def _sharded_controller(solver, **settings_kw):
+    cluster = Cluster()
+    provider = FakeCloudProvider(catalog=generate_catalog(n_types=12))
+    settings = Settings(
+        batch_idle_duration=0, batch_max_duration=0,
+        cell_sharding_enabled=True, **settings_kw,
+    )
+    controller = ProvisioningController(
+        cluster, provider, solver=solver, settings=settings
+    )
+    return cluster, controller
+
+
+def _cell_pod(pool, name, **kw):
+    return make_pod(name=name, node_selector={"pool": pool}, **kw)
+
+
+def _roundtrip(capsule):
+    return json.loads(json.dumps(capsule, default=str))
+
+
+class TestFleetSharded:
+    def test_fleet_round_dispatches_and_records(self, monkeypatch):
+        """Two dirty TPU cells batch into one device call: round stats and
+        the dispatch metrics say so, and the counter is bucket-labeled."""
+        # class-level: the sharded path solves through per-cell solver
+        # CLONES, which only see the class default
+        monkeypatch.setattr(TPUSolver, "race_min_pods", 0)
+        solver = TPUSolver(portfolio=4)
+        cluster, controller = _sharded_controller(solver)
+        cluster.add_provisioner(make_provisioner("cell-a", labels={"pool": "a"}))
+        cluster.add_provisioner(make_provisioner("cell-b", labels={"pool": "b"}))
+        for i in range(4):
+            cluster.add_pod(_cell_pod("a", f"fa{i}"))
+            cluster.add_pod(_cell_pod("b", f"fb{i}"))
+        # rounds 1-2: the fleet bucket for each round's shape is cold —
+        # staging backs off and queues the compile; the cells race per-cell
+        # unchanged. (Round 1 launches nodes, so round 2 lands on the
+        # existing-capacity bucket — the steady-state shape round 3 hits.)
+        controller.reconcile()
+        assert J.AOT_CACHE.wait_idle(timeout=300)
+        for i in range(4):
+            cluster.add_pod(_cell_pod("a", f"fa2{i}"))
+            cluster.add_pod(_cell_pod("b", f"fb2{i}"))
+        controller.reconcile()
+        assert J.AOT_CACHE.wait_idle(timeout=300)
+        # round 3: both cells dirty again, fleet executable resident
+        for i in range(4):
+            cluster.add_pod(_cell_pod("a", f"fa3{i}"))
+            cluster.add_pod(_cell_pod("b", f"fb3{i}"))
+        result = controller.reconcile()
+        assert not result.unschedulable
+        stats = result.solve.stats
+        assert stats.get("fleet_dispatches", 0) >= 1
+        assert stats.get("fleet_cells_batched", 0) >= 2
+        assert (metrics.FLEET_ROUND_DISPATCHES.value() or 0) >= 1
+
+    def test_fleet_flag_off_skips_staging(self):
+        solver = TPUSolver(portfolio=4)
+        cluster, controller = _sharded_controller(
+            solver, fleet_dispatch_enabled=False
+        )
+        cluster.add_provisioner(make_provisioner("cell-a", labels={"pool": "a"}))
+        cluster.add_provisioner(make_provisioner("cell-b", labels={"pool": "b"}))
+        for i in range(3):
+            cluster.add_pod(_cell_pod("a", f"na{i}"))
+            cluster.add_pod(_cell_pod("b", f"nb{i}"))
+        result = controller.reconcile()
+        assert "fleet_dispatches" not in result.solve.stats
+
+    def test_fleet_round_replays_byte_identical(self):
+        """A sharded round through the fleet flow (encode-first staging)
+        replays byte-identical, and the fleet-off counterfactual keeps BOTH
+        digests and placements — staging must not move a single encode
+        byte. (Deterministic solver: the dispatch layers are pinned by the
+        kernel bit-identity tests above.)"""
+        cluster, controller = _sharded_controller(GreedySolver())
+        cluster.add_provisioner(make_provisioner("cell-a", labels={"pool": "a"}))
+        cluster.add_provisioner(make_provisioner("cell-b", labels={"pool": "b"}))
+        for i in range(3):
+            cluster.add_pod(_cell_pod("a", f"ra{i}"))
+        for i in range(2):
+            cluster.add_pod(_cell_pod("b", f"rb{i}"))
+        result = controller.reconcile()
+        assert not result.unschedulable
+        capsule = _roundtrip(FLIGHT.latest("provisioning"))
+        assert capsule["inputs"]["settings"]["fleet_dispatch_enabled"] is True
+        report = replay_capsule(capsule, solver="greedy")
+        assert report["diffs"]["digests_match"] is True
+        assert report["diffs"]["placements_match"] is True
+        assert report["match"] is True
+        # counterfactual: per-cell dispatch flow, byte-identical encodes
+        cf = replay_capsule(
+            capsule, solver="greedy",
+            overrides=["settings.fleet_dispatch_enabled=false"],
+        )
+        assert cf["counterfactual"] is True
+        assert cf["diffs"]["digests_match"] is True
+        assert cf["diffs"]["placements_match"] is True
+
+    def test_tpu_fleet_round_digests_match_oracle(self, monkeypatch):
+        """With the REAL batched dispatch engaged, every per-cell digest in
+        the capsule equals a from-scratch encode of that cell's canonical
+        order — the fleet flow's digest contract at the controller level."""
+        monkeypatch.setattr(TPUSolver, "race_min_pods", 0)
+        solver = TPUSolver(portfolio=4)
+        cluster, controller = _sharded_controller(solver)
+        cluster.add_provisioner(make_provisioner("cell-a", labels={"pool": "a"}))
+        cluster.add_provisioner(make_provisioner("cell-b", labels={"pool": "b"}))
+        # spy on the staging encodes: capture each cell's EXACT encode
+        # inputs (canonical order snapshotted before post-round binds
+        # retire pods from the session) for the from-scratch oracle below
+        captured = []
+        orig_encode = TPUSolver.encode_for_staging
+
+        def spy(self, pods, provisioners, existing=(), daemonsets=(),
+                session=None, phase_mode="full"):
+            problem = orig_encode(
+                self, pods, provisioners, existing=existing,
+                daemonsets=daemonsets, session=session, phase_mode=phase_mode,
+            )
+            captured.append((
+                problem, list(session.ordered_pods()), list(provisioners),
+                list(existing), list(daemonsets),
+            ))
+            return problem
+
+        monkeypatch.setattr(TPUSolver, "encode_for_staging", spy)
+        for r in range(3):
+            for i in range(4):
+                cluster.add_pod(_cell_pod("a", f"da{r}{i}"))
+                cluster.add_pod(_cell_pod("b", f"db{r}{i}"))
+            result = controller.reconcile()
+            assert J.AOT_CACHE.wait_idle(timeout=300)
+        assert result.solve.stats.get("fleet_dispatches", 0) >= 1
+        assert len(captured) >= 2
+        for problem, ordered, provs2, existing, ds in captured[-2:]:
+            oracle = encode(ordered, provs2, existing=existing, daemonsets=ds)
+            assert problem_digest(problem) == problem_digest(oracle)
