@@ -1697,6 +1697,249 @@ def bench_gang_preemption(rounds=10, gang_size=8, fill_pods=60, serve_churn=4):
     }
 
 
+def bench_gang_topology(rounds=6, gang_size=4, n_types=12):
+    """Slice-topology scenario (ISSUE 13): TPU training gangs (hostname
+    anti-affinity — one rank per node, so every gang needs ``gang_size``
+    slice locations) arriving against an ICI-coordinate catalog, run through
+    BOTH gate arms on identical per-round workloads:
+
+    * **adjacency arm** (``slice_topology_enabled=true``): the gang gate's
+      hop-penalized replan + compact-coordinate remap;
+    * **blind arm** (``false``): the zone-granular PR 6 gate.
+
+    Reports the mean-pairwise-hop p50 of each arm (acceptance: adjacency
+    strictly below blind), the adjacency win rate (gangs landing whole in
+    ONE ICI domain at sub-cross-pod hop distance), realized gang plan cost
+    vs. the blind arm's unconstrained optimum (acceptance: within 1.05x),
+    and the zero-partial invariant. Two scripted epilogues cover the rest
+    of the subsystem: a preempt-or-launch round that must choose eviction
+    (and replay byte-identically from its capsule), and a gang-whole
+    consolidation move with its savings."""
+    import statistics as _st
+
+    from karpenter_tpu.api import ObjectMeta, Pod, Provisioner, Resources
+    from karpenter_tpu.api import labels as wk
+    from karpenter_tpu.api.objects import Node, PodAffinityTerm
+    from karpenter_tpu.api.resources import GPU_TPU
+    from karpenter_tpu.api.settings import Settings
+    from karpenter_tpu.cloudprovider import FakeCloudProvider, generate_catalog
+    from karpenter_tpu.controllers.provisioning import ProvisioningController
+    from karpenter_tpu.solver import topology
+    from karpenter_tpu.solver.solver import GreedySolver
+    from karpenter_tpu.state import Cluster
+
+    def _gang_pods(cluster, gang, size, priority=0, anti=True):
+        names = []
+        for i in range(size):
+            p = Pod(
+                meta=ObjectMeta(
+                    name=f"{gang}-{i}", owner_kind="Job",
+                    labels={"job": gang},
+                    annotations={
+                        wk.POD_GROUP: gang,
+                        wk.POD_GROUP_MIN_MEMBERS: str(size),
+                    },
+                ),
+                requests=Resources({"cpu": 8.0, "memory": 2.0 * 2**30,
+                                    GPU_TPU: 1.0}),
+                priority=priority,
+            )
+            if anti:
+                p.affinity_terms = [
+                    PodAffinityTerm(topology_key=wk.HOSTNAME, anti=True,
+                                    label_selector={"job": gang})
+                ]
+            names.append(p.name)
+            cluster.add_pod(p)
+        return names
+
+    def _arm(enabled):
+        cluster = Cluster()
+        provider = FakeCloudProvider(
+            catalog=generate_catalog(n_types=n_types, slice_topology=True)
+        )
+        controller = ProvisioningController(
+            cluster, provider, solver=GreedySolver(),
+            settings=Settings(
+                batch_idle_duration=0, batch_max_duration=0,
+                slice_topology_enabled=enabled,
+            ),
+        )
+        cluster.add_provisioner(Provisioner(meta=ObjectMeta(name="default")))
+        hop_means, costs, wins, times = [], [], [], []
+        partial = 0
+        for r in range(rounds):
+            members = _gang_pods(cluster, f"train-{r}", gang_size)
+            t0 = time.perf_counter()
+            controller.reconcile()
+            times.append(time.perf_counter() - t0)
+            bound = [m for m in members if cluster.pods[m].node_name]
+            if not bound:
+                continue  # deferred whole: no placement to score (NOT a
+                # perfect-adjacency 0-hop sample — that would let a
+                # deferral-heavy arm game the hop-p50 gate)
+            if len(bound) != gang_size:
+                partial += 1  # the invariant: never observed
+                continue
+            nodes = [
+                cluster.nodes[cluster.pods[m].node_name] for m in bound
+            ]
+            pts = [topology.node_point(n) for n in nodes]
+            mean, worst = topology.plan_hop_stats(pts)
+            hop_means.append(mean)
+            wins.append(
+                len({p.slice_pod for p in pts}) == 1
+                and all(p.slice_pod for p in pts)
+                and worst < topology.CROSS_POD_HOPS
+            )
+            costs.append(
+                sum(
+                    provider.pricing.price(
+                        n.instance_type(), n.zone(), n.capacity_type()
+                    ) or 0.0
+                    for n in nodes
+                )
+            )
+        return {
+            "hop_p50": round(_st.median(hop_means), 4) if hop_means else None,
+            "cost_total": round(sum(costs), 5),
+            "win_rate": round(sum(wins) / len(wins), 3) if wins else None,
+            "partial": partial,
+            "round_p50_ms": round(_st.median(times) * 1e3, 3),
+        }
+
+    adjacent = _arm(True)
+    blind = _arm(False)
+
+    # -- preempt-or-launch epilogue: eviction must undercut fresh capacity --
+    from karpenter_tpu.replay import replay_capsule
+    from karpenter_tpu.utils import metrics as _m
+    from karpenter_tpu.utils.flightrecorder import FLIGHT
+
+    cluster = Cluster()
+    provider = FakeCloudProvider(
+        catalog=generate_catalog(n_types=n_types, slice_topology=True)
+    )
+    controller = ProvisioningController(
+        cluster, provider, solver=GreedySolver(),
+        settings=Settings(
+            batch_idle_duration=0, batch_max_duration=0,
+            slice_topology_enabled=True,
+        ),
+    )
+    cluster.add_provisioner(Provisioner(meta=ObjectMeta(name="default")))
+    for ni in range(2):
+        node = Node(
+            meta=ObjectMeta(
+                name=f"full-{ni}",
+                labels={wk.PROVISIONER_NAME: "default", wk.ZONE: "zone-a",
+                        wk.INSTANCE_TYPE: "t",
+                        wk.SLICE_POD: "zone-a/pod-0",
+                        wk.SLICE_COORD: f"{ni}-0-0"},
+            ),
+            allocatable=Resources({"cpu": 40.0, "memory": 64.0 * 2**30,
+                                   "pods": 20.0, GPU_TPU: 4.0}),
+            capacity=Resources({"cpu": 40.0, "memory": 64.0 * 2**30,
+                                "pods": 20.0, GPU_TPU: 4.0}),
+            ready=True,
+        )
+        cluster.add_node(node)
+        for pi in range(4):
+            p = Pod(meta=ObjectMeta(name=f"low-{ni}-{pi}", owner_kind="ReplicaSet"),
+                    requests=Resources({"cpu": 8.0, "memory": 2**30, GPU_TPU: 1.0}))
+            cluster.add_pod(p)
+            cluster.bind_pod(p.name, node.name)
+    evict0 = _m.PREEMPT_OR_LAUNCH.value({"verdict": "evict"})
+    # no anti-affinity here: the gang must FIT onto the two fillers' freed
+    # capacity, so the evict-vs-launch comparison has a live evict side
+    _gang_pods(cluster, "urgent", gang_size, priority=100, anti=False)
+    controller.reconcile()
+    pol_evictions = int(_m.PREEMPT_OR_LAUNCH.value({"verdict": "evict"}) - evict0)
+    pol_replay_match = None
+    capsule = FLIGHT.latest("provisioning")
+    if capsule is not None:
+        try:
+            report = replay_capsule(json.loads(json.dumps(capsule, default=str)))
+            pol_replay_match = bool(report["match"])
+        except Exception:
+            pol_replay_match = False
+
+    # -- gang-whole consolidation epilogue ----------------------------------
+    from karpenter_tpu.controllers.deprovisioning import DeprovisioningController
+    from karpenter_tpu.controllers.termination import TerminationController
+    from karpenter_tpu.utils.cache import FakeClock
+
+    settings = Settings(
+        batch_idle_duration=0, batch_max_duration=0,
+        slice_topology_enabled=True,
+        consolidation_validation_ttl=0.0, stabilization_window=0.0,
+    )
+    cluster = Cluster()
+    provider = FakeCloudProvider(catalog=generate_catalog(n_types=20))
+    controller = ProvisioningController(
+        cluster, provider, solver=GreedySolver(), settings=settings
+    )
+    prov = Provisioner(meta=ObjectMeta(name="default"))
+    prov.consolidation_enabled = True
+    cluster.add_provisioner(prov)
+
+    def _small(name, cpu, group=None):
+        ann = {}
+        if group:
+            ann = {wk.POD_GROUP: group, wk.POD_GROUP_MIN_MEMBERS: "2"}
+        return Pod(meta=ObjectMeta(name=name, owner_kind="ReplicaSet",
+                                   annotations=ann),
+                   requests=Resources({"cpu": cpu}))
+
+    cluster.add_pod(_small("g-0", 0.3, "tj"))
+    cluster.add_pod(_small("filler", 0.5))
+    controller.reconcile()
+    cluster.add_pod(_small("g-1", 0.3, "tj"))
+    controller.reconcile()
+    cluster.delete_pod("filler")
+    clock = FakeClock(1e6)
+    term = TerminationController(cluster, provider, clock=clock)
+    deprov = DeprovisioningController(
+        cluster, provider, term, settings=settings, clock=clock
+    )
+    action = deprov.reconcile()
+    gang_moves = 1 if action is not None and action.gangs else 0
+    gang_move_savings = round(action.savings, 5) if gang_moves else 0.0
+    move_partial = 0
+    if gang_moves:
+        # the move must never leave the gang split: fully pending now...
+        bound = [m for m in ("g-0", "g-1") if cluster.pods[m].node_name]
+        if bound:
+            move_partial += 1
+        controller.reconcile()  # ...and fully re-placed by the gate
+        bound = [m for m in ("g-0", "g-1") if cluster.pods[m].node_name]
+        if len(bound) not in (0, 2):
+            move_partial += 1
+
+    zero_partial = (
+        adjacent["partial"] == 0 and blind["partial"] == 0 and move_partial == 0
+    )
+    cost_frac = (
+        round(adjacent["cost_total"] / blind["cost_total"], 4)
+        if blind["cost_total"] else None
+    )
+    return {
+        "rounds": rounds,
+        "gang_size": gang_size,
+        "hop_p50": adjacent["hop_p50"],
+        "hop_p50_blind": blind["hop_p50"],
+        "adjacency_win_rate": adjacent["win_rate"],
+        "round_p50_ms": adjacent["round_p50_ms"],
+        "round_p50_ms_blind": blind["round_p50_ms"],
+        "cost_vs_blind_frac": cost_frac,
+        "zero_partial": bool(zero_partial),
+        "preempt_or_launch_evictions": pol_evictions,
+        "preempt_replay_match": pol_replay_match,
+        "gang_moves_whole": gang_moves,
+        "gang_move_savings": gang_move_savings,
+    }
+
+
 def bench_spot_churn(n_pods=240, waves=3, replace_budget=2, n_types=20):
     """Spot-churn robustness scenario (ISSUE 7): a spot-heavy fleet under a
     scripted interruption schedule (utils/faults.InterruptionSchedule) —
@@ -2238,6 +2481,12 @@ def _run_details(dry_run: bool = False) -> dict:
         except Exception as e:
             details["spot_churn"] = {"error": f"{type(e).__name__}: {e}"}
         try:
+            details["gang_topology"] = bench_gang_topology(
+                rounds=2, gang_size=2, n_types=8
+            )
+        except Exception as e:
+            details["gang_topology"] = {"error": f"{type(e).__name__}: {e}"}
+        try:
             details["cell_decompose"] = bench_cell_decompose(
                 n_pods=2_000, n_cells=4, rounds=3, n_types=12
             )
@@ -2267,6 +2516,7 @@ def _run_details(dry_run: bool = False) -> dict:
         ("decision_overhead", bench_decision_overhead),
         ("flightrecorder_overhead", bench_flightrecorder_overhead),
         ("gang_preemption", bench_gang_preemption),
+        ("gang_topology", bench_gang_topology),
         ("spot_churn", bench_spot_churn),
         # the 500k synthetic: sharded rounds only (a flat 500k solve per
         # round is the O(cluster) cost the cells exist to escape), with a
@@ -2357,6 +2607,7 @@ def main(argv=None):
     decisions = details.get("decision_overhead", {})
     flightrec = details.get("flightrecorder_overhead", {})
     gangs = details.get("gang_preemption", {})
+    gangtopo = details.get("gang_topology", {})
     spot = details.get("spot_churn", {})
     cells = details.get("cell_decompose", {})
     race_topo = details.get("kernel_race_topology", {})
@@ -2384,6 +2635,18 @@ def main(argv=None):
         "gang_admission_p50_ms": gangs.get("gang_admission_p50_ms"),
         "preemption_round_p50_ms": gangs.get("preemption_round_p50_ms"),
         "gang_zero_partial": gangs.get("zero_partial"),
+        # slice topology (ISSUE 13): adjacency vs the topology-blind gate on
+        # identical workloads, preempt-or-launch verdicts + capsule replay,
+        # and gang-whole consolidation recovery
+        "gangtopo_hop_p50": gangtopo.get("hop_p50"),
+        "gangtopo_hop_p50_blind": gangtopo.get("hop_p50_blind"),
+        "gangtopo_adjacency_win_rate": gangtopo.get("adjacency_win_rate"),
+        "gangtopo_cost_vs_blind_frac": gangtopo.get("cost_vs_blind_frac"),
+        "gangtopo_zero_partial": gangtopo.get("zero_partial"),
+        "gangtopo_preempt_evictions": gangtopo.get("preempt_or_launch_evictions"),
+        "gangtopo_preempt_replay_match": gangtopo.get("preempt_replay_match"),
+        "gangtopo_gang_moves_whole": gangtopo.get("gang_moves_whole"),
+        "gangtopo_gang_move_savings": gangtopo.get("gang_move_savings"),
         # spot-churn robustness (ISSUE 7): the trajectory JSON tracks
         # correctness-under-reclamation, not just latency
         "spot_reclaims_survived": spot.get("reclaims_survived"),

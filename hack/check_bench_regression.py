@@ -27,6 +27,13 @@ floor:
   COLD_SOLVE_MS end to end (acceptance scale: 50k under ``--full``; 20k in
   the gate), and the kernel backend must win at least one race scenario on
   BOTH axes — cost AND wall-clock — with zero constraint violations.
+* ``gang_topology`` (ISSUE 13): on an ICI-coordinate catalog, gangs must
+  land on adjacent slices — hop-distance p50 strictly below the
+  topology-blind arm's on identical workloads — at cost within
+  GANGTOPO_COST_BAND x the unconstrained (blind) optimum, with the
+  zero-partial invariant intact; at least one consolidation action must
+  move a gang WHOLE, and the scripted preempt-or-launch round must choose
+  eviction AND replay byte-identically from its capsule.
 * ``soak`` (ISSUE 11): the scaled chaos soak (sustained churn over the
   real-HTTP stack incl. one operator SIGKILL+restart and one apiserver
   restart) must finish with ZERO invariant violations — which covers the
@@ -90,6 +97,10 @@ SOAK_EVENTS_PER_S_FLOOR = 100.0
 #: scaled window cannot fully exclude; the hours-long CLI run gates at
 #: 64 KiB/s.
 SOAK_MEM_SLOPE_BPS = 524_288.0
+#: gang_topology: adjacency-gated gang plan cost vs. the topology-blind
+#: arm's unconstrained optimum (the ISSUE-13 acceptance band; coordinates
+#: within a domain are price-equal, so measured ~1.0x)
+GANGTOPO_COST_BAND = 1.05
 
 
 def run_checks(full: bool = False) -> list:
@@ -127,6 +138,7 @@ def run_checks(full: bool = False) -> list:
     cells_fleet = bench.bench_cell_decompose(
         n_pods=20_000, n_cells=8, rounds=8, n_types=30, flat_compare=False
     )
+    gangtopo = bench.bench_gang_topology()
     race = bench.bench_kernel_race()
     race_topo = bench.bench_kernel_race_topology()
     # the chaos soak arm: acceptance-length (>=60 s churn) either way — the
@@ -141,7 +153,7 @@ def run_checks(full: bool = False) -> list:
     print(json.dumps({
         "delta_reconcile": delta, "consolidation_sweep": sweep,
         "spot_churn": churn, "cell_decompose": cells,
-        "cell_fleet": cells_fleet,
+        "cell_fleet": cells_fleet, "gang_topology": gangtopo,
         "cold_solve": cold, "kernel_race": race,
         "kernel_race_topology": race_topo,
         "kernel_race_topology_50k": race_topo_50k,
@@ -266,6 +278,45 @@ def run_checks(full: bool = False) -> list:
         failures.append(
             "cell_fleet: a cell's delta encode diverged from its "
             "from-scratch oracle under the fleet path"
+        )
+    # -- gang_topology gate (ISSUE 13) ---------------------------------------
+    hop = gangtopo.get("hop_p50")
+    hop_blind = gangtopo.get("hop_p50_blind")
+    if hop is None or hop_blind is None or not hop < hop_blind:
+        failures.append(
+            f"gang_topology: adjacency hop p50 {hop} not strictly below the "
+            f"topology-blind baseline {hop_blind}"
+        )
+    gfrac = gangtopo.get("cost_vs_blind_frac")
+    if gfrac is None or gfrac > GANGTOPO_COST_BAND:
+        failures.append(
+            f"gang_topology: adjacency plan cost {gfrac}x the unconstrained "
+            f"optimum (band {GANGTOPO_COST_BAND}x)"
+        )
+    if (gangtopo.get("adjacency_win_rate") or 0.0) <= 0.0:
+        failures.append(
+            "gang_topology: no gang landed whole in one ICI domain "
+            f"(win rate {gangtopo.get('adjacency_win_rate')})"
+        )
+    if not gangtopo.get("zero_partial", False):
+        failures.append(
+            "gang_topology: a gang was observed PARTIALLY placed (the "
+            "all-or-nothing invariant broke under topology packing)"
+        )
+    if (gangtopo.get("gang_moves_whole") or 0) < 1:
+        failures.append(
+            "gang_topology: consolidation moved no gang whole — the "
+            "gang-aware sweep regressed (or the scenario is vacuous)"
+        )
+    if (gangtopo.get("preempt_or_launch_evictions") or 0) < 1:
+        failures.append(
+            "gang_topology: preempt-or-launch chose eviction in no scripted "
+            "round (the cost decision regressed)"
+        )
+    if gangtopo.get("preempt_replay_match") is not True:
+        failures.append(
+            "gang_topology: the preempt-or-launch round did not replay "
+            "byte-identically from its capsule"
         )
     # -- cold-solve + kernel-race gate (ISSUE 9) -----------------------------
     # the 100ms acceptance budget is a driver-box number; the gate scales it

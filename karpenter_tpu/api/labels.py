@@ -35,6 +35,24 @@ TERMINATION_FINALIZER = f"{GROUP}/termination"
 POD_GROUP = f"{GROUP}/pod-group"
 POD_GROUP_MIN_MEMBERS = f"{GROUP}/pod-group-min-members"
 
+# TPU slice topology (solver/topology.py): a slice-capable offering carries
+# its ICI-domain id (the "TPU pod" it draws chips from) and its torus
+# coordinate inside that domain; nodes launched from it carry the same pair
+# as LABELS, so nodeSelector pinning, the encoder's node surfaces and the
+# flight-recorder capsules all see one vocabulary. SLICE_COORD values render
+# as "x-y-z" (see topology.format_coord).
+SLICE_POD = f"{GROUP}/slice-pod"
+SLICE_COORD = f"{GROUP}/slice-coord"
+
+# Per-pod slice-adjacency override (annotation): "required" forces the gang
+# gate's adjacency replan to stand only when every member lands in ONE ICI
+# domain, "none" opts the gang out of adjacency scoring entirely. Placement
+# policy affects grouping (a carrier must never bucket with an otherwise
+# identical plain pod), so encode._signature folds the value into the gang
+# component and the native encoder defers carriers to Python, like gang
+# members and spot-diversification carriers.
+SLICE_ADJACENCY = f"{GROUP}/slice-adjacency"
+
 # Per-pod spot-diversification override (annotation): a fraction in (0, 1]
 # tightening/loosening settings.spot_diversification_max_frac for this pod's
 # group, or "none" to opt the group out of the gate. Pool identity affects

@@ -253,6 +253,22 @@ class Node:
     def provisioner_name(self) -> Optional[str]:
         return self.meta.labels.get(wk.PROVISIONER_NAME)
 
+    def slice_pod(self) -> str:
+        """ICI-domain id of the TPU slice this node draws chips from, or ""
+        for non-slice nodes (slice coordinates ride the node as labels —
+        sparse on the wire like every unset label)."""
+        return self.meta.labels.get(wk.SLICE_POD, "")
+
+    def slice_coord(self) -> Optional[Tuple[int, int, int]]:
+        """Torus (x, y, z) coordinate inside the node's ICI domain, or None
+        when the node carries no (or a malformed) slice-coord label."""
+        raw = self.meta.labels.get(wk.SLICE_COORD)
+        if not raw:
+            return None
+        from ..solver.topology import parse_coord
+
+        return parse_coord(raw)
+
 
 @dataclass
 class KubeletConfiguration:

@@ -83,6 +83,26 @@ class Settings:
     # GangWaitExceeded warning event (it keeps deferring either way —
     # all-or-nothing is not negotiable); 0 disables the escalation.
     gang_max_wait_rounds: int = 8
+    # TPU slice topology (solver/topology.py): when enabled AND the catalog
+    # carries ICI-coordinate offerings, the gang gate scores placements by
+    # torus hop distance (adjacency replan onto one ICI domain, compact
+    # coordinate remap) and preempt-or-launch joins the cascade as one cost
+    # decision. Off by default: sliceless clusters see byte-identical
+    # behavior (and a topology-enabled operator on a sliceless catalog
+    # degrades to the zone-granular PR 6 gate).
+    slice_topology_enabled: bool = False
+    # hop-count penalty: a gang plan is charged price * (1 + frac *
+    # mean_pairwise_hops). The default makes one cross-zone pair
+    # (CROSS_ZONE_HOPS=16) cost the same 10% premium the zone-granular
+    # scatter penalty charged per extra zone: 0.00625 * 16 = 0.10.
+    slice_hop_penalty_frac: float = 0.00625
+    # thrash budget for victim-gang restart boosting: a gang evicted whole
+    # by the preemption planner re-enters Pending with one priority tier of
+    # VICTIM-side protection — it cannot be re-evicted by an equal-priority
+    # preemptor — for this many reconciles. (Deliberately not a preemptor
+    # boost: empowering the evicted gang against equal-priority peers would
+    # let two equal-tier gangs displace each other in a cycle.) 0 disables.
+    gang_restart_boost_rounds: int = 4
     # risk-aware spot capacity pools (utils/riskcache.py + the rebalance
     # controller): when enabled, offerings carry live interruption
     # probabilities, the solver prices price + p * interruption_penalty_cost,
@@ -213,6 +233,14 @@ class Settings:
             )
         if self.interruption_penalty_cost < 0:
             raise ValueError("interruptionPenaltyCost must be >= 0")
+        if self.slice_hop_penalty_frac < 0:
+            raise ValueError(
+                "sliceHopPenaltyFrac must be >= 0 (0 scores adjacency free)"
+            )
+        if self.gang_restart_boost_rounds < 0:
+            raise ValueError(
+                "gangRestartBoostRounds must be >= 0 (0 disables the boost)"
+            )
         if not 0 < self.spot_diversification_max_frac <= 1:
             raise ValueError(
                 "spotDiversificationMaxFrac must be in (0, 1] (1.0 disables the gate)"

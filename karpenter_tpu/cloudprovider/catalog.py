@@ -166,6 +166,27 @@ def make_instance_type(
     )
 
 
+def _accelerator_types(
+    zones: Sequence[str], kubelet: Optional[KubeletConfiguration] = None
+) -> List[InstanceType]:
+    return [
+        make_instance_type(
+            name,
+            "tpu",
+            "5",
+            name.split(".")[1],
+            vcpus,
+            mem,
+            price,
+            zones,
+            accelerator=name.split(".")[0],
+            accelerator_count=chips,
+            kubelet=kubelet,
+        )
+        for name, (chips, vcpus, mem, price) in _ACCEL.items()
+    ]
+
+
 _catalog_cache: Dict[tuple, List[InstanceType]] = {}
 
 
@@ -174,6 +195,7 @@ def generate_catalog(
     zones: Sequence[str] = DEFAULT_ZONES,
     kubelet: Optional[KubeletConfiguration] = None,
     include_accelerators: bool = True,
+    slice_topology: bool = False,
 ) -> List[InstanceType]:
     """Deterministic catalog; ``n_types`` samples evenly across the size spectrum
     so a truncated catalog still spans small through large types.
@@ -186,7 +208,7 @@ def generate_catalog(
     list (shallow copy) so list-level mutation can't leak between them."""
     cache_key = None
     if kubelet is None:
-        cache_key = (n_types, tuple(zones), include_accelerators)
+        cache_key = (n_types, tuple(zones), include_accelerators, slice_topology)
         hit = _catalog_cache.get(cache_key)
         if hit is not None:
             return list(hit)
@@ -215,23 +237,7 @@ def generate_catalog(
                     )
                 )
     if include_accelerators:
-        for name, (chips, vcpus, mem, price) in _ACCEL.items():
-            family, size = name.split(".")
-            out.append(
-                make_instance_type(
-                    name,
-                    "tpu",
-                    "5",
-                    size,
-                    vcpus,
-                    mem,
-                    price,
-                    zones,
-                    accelerator=family,
-                    accelerator_count=chips,
-                    kubelet=kubelet,
-                )
-            )
+        out.extend(_accelerator_types(zones, kubelet))
     if n_types is not None and n_types < len(out):
         # Sample evenly across the size spectrum so a truncated catalog still
         # spans small through large types (not just the N smallest).
@@ -242,6 +248,24 @@ def generate_catalog(
             # step > 1 under the n_types < len(out) guard, so indices are distinct
             step = (len(ranked) - 1) / (n_types - 1)
             out = [ranked[round(i * step)] for i in range(n_types)]
+    if slice_topology:
+        # ICI-coordinate offerings for the TPU types (solver/topology.py):
+        # each accelerator (zone, ct) offering expands into per-(domain,
+        # coordinate) offerings whose slice identity the solver can target.
+        # AFTER the n_types sampling (n_types counts TYPES, not offerings),
+        # with the accelerator types force-included past the sampling — a
+        # sliced catalog without slices would be a silent no-op. An explicit
+        # include_accelerators=False still wins: the caller asked for a
+        # TPU-less universe, and the expansion is then a deliberate no-op.
+        from ..solver.topology import with_slice_topology
+
+        if include_accelerators:
+            have = {it.name for it in out}
+            out = out + [
+                it for it in _accelerator_types(zones, kubelet)
+                if it.name not in have
+            ]
+        out = with_slice_topology(out)
     if cache_key is not None:
         _catalog_cache[cache_key] = out
         return list(out)

@@ -162,6 +162,16 @@ class FakeCloudProvider(WindowedBatchers, CloudProvider):
         self.risk_cache = risk_cache
         self.pools.risk = risk_cache
 
+    def enable_slice_topology(self) -> None:
+        """Expand the catalog's TPU-type offerings into per-coordinate slice
+        offerings (solver/topology.py) — the fake's analogue of a TPU API
+        serving topology descriptors. Idempotent (already-expanded offerings
+        pass through); bumps catalog_version via set_catalog so every
+        downstream cache sees the new axis."""
+        from ..solver.topology import with_slice_topology
+
+        self.set_catalog(with_slice_topology(self.catalog))
+
     def set_insufficient_capacity(self, instance_type: str, zone: str, capacity_type: str) -> None:
         self.insufficient_capacity_pools.add((instance_type, zone, capacity_type))
 
@@ -380,6 +390,18 @@ class FakeCloudProvider(WindowedBatchers, CloudProvider):
         machine.meta.labels[wk.ZONE] = offering.zone
         machine.meta.labels[wk.CAPACITY_TYPE] = offering.capacity_type
         machine.meta.labels[wk.PROVISIONER_NAME] = machine.provisioner_name
+        if offering.slice_pod:
+            # slice identity rides the node as labels: the encoder's node
+            # surfaces, slice-pinned nodeSelectors and hop-distance scoring
+            # all read the same karpenter.tpu/slice-* pair
+            from ..solver.topology import format_coord
+
+            machine.meta.labels[wk.SLICE_POD] = offering.slice_pod
+            instance.tags[wk.SLICE_POD] = offering.slice_pod
+            if offering.slice_coord is not None:
+                coord = format_coord(offering.slice_coord)
+                machine.meta.labels[wk.SLICE_COORD] = coord
+                instance.tags[wk.SLICE_COORD] = coord
         if cfg is not None:
             machine.meta.annotations[wk.LAUNCH_TEMPLATE_ANNOTATION] = cfg.name
         return machine
@@ -479,6 +501,10 @@ class FakeCloudProvider(WindowedBatchers, CloudProvider):
                     interruption_probability=self.pools.probability(
                         it.name, o.zone, o.capacity_type
                     ),
+                    # slice identity passes through: price/ICE/risk stay keyed
+                    # on the (type, zone, ct) pool the coordinate draws from
+                    slice_pod=o.slice_pod,
+                    slice_coord=o.slice_coord,
                 )
                 for o in it.offerings
             ]
@@ -538,6 +564,13 @@ class FakeCloudProvider(WindowedBatchers, CloudProvider):
                     wk.ZONE: instance.zone,
                     wk.CAPACITY_TYPE: instance.capacity_type,
                     wk.PROVISIONER_NAME: instance.tags.get(wk.PROVISIONER_NAME, ""),
+                    # slice identity survives describe/list reconstruction
+                    # (GC re-adoption must not strip a node's coordinates)
+                    **{
+                        k: instance.tags[k]
+                        for k in (wk.SLICE_POD, wk.SLICE_COORD)
+                        if k in instance.tags
+                    },
                 },
             ),
             provisioner_name=instance.tags.get(wk.PROVISIONER_NAME, ""),

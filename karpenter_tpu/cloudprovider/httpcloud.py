@@ -90,6 +90,10 @@ def describe_instance_type(it: InstanceType) -> Dict:
             (o.price for o in it.offerings if o.capacity_type == wk.CAPACITY_TYPE_ON_DEMAND),
             0.0,
         ),
+        # slice-topology flag, not the expansion itself: the per-zone torus
+        # synthesis is deterministic (topology.zone_torus), so the client
+        # re-derives identical coordinate offerings from this one bit
+        "slice_topology": any(o.slice_pod for o in it.offerings),
     }
 
 
@@ -126,6 +130,11 @@ def instance_type_from_description(
                 for o in it.offerings
             ]
         )
+    if desc.get("slice_topology"):
+        # expand AFTER pricing: coordinates copy their pool's live price
+        from ..solver.topology import with_slice_topology
+
+        it = with_slice_topology([it])[0]
     return it
 
 
@@ -207,6 +216,13 @@ class CloudHTTPService:
         # committed two instances (see launch_audit()).
         self.launch_log: List[Tuple[str, str, float]] = []
         self.insufficient_capacity_pools: set = set()
+        # cloud-side interruption queue (the SQS analogue), served over
+        # /v1/queue/* so the notice pipeline crosses a REAL network boundary:
+        # the operator's HTTPCloudProvider polls it, tests/the soak harness
+        # inject messages into it over the wire
+        from ..controllers.interruption import FakeQueue
+
+        self.queue = FakeQueue()
         self.request_log: List[str] = []  # endpoint per backend call
         self._counter = 0
         self._lock = threading.Lock()
@@ -275,6 +291,15 @@ class CloudHTTPService:
                 with self._lock:
                     self._counter += 1
                     iid = f"i-{self._counter:08d}"
+                    slice_tags = {}
+                    if offering.slice_pod:
+                        from ..solver.topology import format_coord
+
+                        slice_tags[wk.SLICE_POD] = offering.slice_pod
+                        if offering.slice_coord is not None:
+                            slice_tags[wk.SLICE_COORD] = format_coord(
+                                offering.slice_coord
+                            )
                     inst = Instance(
                         id=iid,
                         instance_type=it.name,
@@ -285,6 +310,7 @@ class CloudHTTPService:
                             wk.MANAGED_BY: "karpenter-tpu",
                             wk.PROVISIONER_NAME: machine.provisioner_name,
                             "subnet": subnet.id,
+                            **slice_tags,
                             **({"launch-token": token} if token else {}),
                             **body.get("tags", {}),
                         },
@@ -302,11 +328,29 @@ class CloudHTTPService:
                 raise
 
         candidates = []
-        for t, z, ct in overrides:
+        for entry in overrides:
+            t, z, ct = entry[:3]
             it = self._by_name.get(t)
             if it is None:
                 continue
-            candidates.append((it, Offering(zone=z, capacity_type=ct, price=0.0)))
+            # optional slice-location pin (entries 4-5): the launched
+            # instance must sit at exactly this ICI coordinate
+            slice_pod = entry[3] if len(entry) > 3 else ""
+            raw_coord = entry[4] if len(entry) > 4 else ""
+            coord = None
+            if raw_coord:
+                from ..solver.topology import parse_coord
+
+                coord = parse_coord(raw_coord)
+            candidates.append(
+                (
+                    it,
+                    Offering(
+                        zone=z, capacity_type=ct, price=0.0,
+                        slice_pod=slice_pod, slice_coord=coord,
+                    ),
+                )
+            )
         try:
             launched = launch_with_fallback(
                 machine,
@@ -473,6 +517,27 @@ class CloudHTTPService:
                     for i in matched
                 ]
             }
+        if path == "/v1/queue/send":
+            raw = (body or {}).get("body", "")
+            if not isinstance(raw, str):
+                raw = json.dumps(raw)
+            # send_raw verbatim: garbage bodies must cross the wire as
+            # garbage (the parser-registry noop path and the flight
+            # recorder's raw-message capture depend on byte fidelity)
+            return 200, {"id": self.queue.send_raw(raw)}
+        if path == "/v1/queue/receive":
+            n = int((body or {}).get("max_messages", 10))
+            msgs = self.queue.receive(n) if n > 0 else []
+            return 200, {
+                "messages": [
+                    {"id": m.id, "body": m.body, "receiveCount": m.receive_count}
+                    for m in msgs
+                ],
+                "count": len(self.queue),
+            }
+        if path == "/v1/queue/delete":
+            self.queue.delete((body or {}).get("id", ""))
+            return 200, {}
         if path == "/admin/ice":  # test injection, like fake ICE pools
             key = tuple((body or {})["key"])
             if (body or {}).get("clear"):
@@ -552,6 +617,45 @@ class CloudHTTPService:
 # ---------------------------------------------------------------------------
 
 
+class HTTPQueue:
+    """Interruption-queue client over the /v1/queue/* wire — the same
+    receive/delete surface as controllers.interruption.FakeQueue, so the
+    InterruptionController consumes the cloud service's queue through a real
+    HTTP boundary (the SQS-analog the reference polls). Calls ride the
+    provider's resilient transport (retries + breakers)."""
+
+    def __init__(self, provider: "HTTPCloudProvider"):
+        self._provider = provider
+
+    def send(self, body: Dict) -> str:
+        return self._provider._call("/v1/queue/send", {"body": json.dumps(body)})["id"]
+
+    def send_raw(self, body: str) -> str:
+        return self._provider._call("/v1/queue/send", {"body": body})["id"]
+
+    def receive(self, max_messages: int = 10):
+        from ..controllers.interruption import QueueMessage
+
+        resp = self._provider._call(
+            "/v1/queue/receive", {"max_messages": max_messages}
+        )
+        return [
+            QueueMessage(
+                id=m["id"], body=m["body"],
+                receive_count=m.get("receiveCount", 0),
+            )
+            for m in resp.get("messages", [])
+        ]
+
+    def delete(self, message_id: str) -> None:
+        self._provider._call("/v1/queue/delete", {"id": message_id})
+
+    def __len__(self) -> int:
+        return int(
+            self._provider._call("/v1/queue/receive", {"max_messages": 0})["count"]
+        )
+
+
 class HTTPCloudProvider(WindowedBatchers, CloudProvider):
     """CloudProvider speaking JSON/HTTP to a CloudHTTPService.
 
@@ -585,6 +689,10 @@ class HTTPCloudProvider(WindowedBatchers, CloudProvider):
             else UnavailableOfferings()
         )
         self.node_template_lookup = None  # protocol attr; templates unsupported
+        # the service's interruption queue, polled over the wire: handed to
+        # the InterruptionController by Operator.new when no explicit queue
+        # is injected, so interruption notices cross real HTTP end to end
+        self.queue = HTTPQueue(self)
         self._lock = threading.Lock()
         self._catalog_cache: Optional[Tuple[float, List[InstanceType]]] = None
         self._by_name: Dict[str, InstanceType] = {}  # filled by _catalog()
@@ -685,6 +793,10 @@ class HTTPCloudProvider(WindowedBatchers, CloudProvider):
                     and not self.unavailable_offerings.is_unavailable(
                         it.name, o.zone, o.capacity_type
                     ),
+                    # slice identity passes through: the ICE mask stays
+                    # keyed on the (type, zone, ct) pool
+                    slice_pod=o.slice_pod,
+                    slice_coord=o.slice_coord,
                 )
                 for o in it.offerings
             ]
@@ -715,6 +827,10 @@ class HTTPCloudProvider(WindowedBatchers, CloudProvider):
             )
         import uuid
 
+        # lazy: cloudprovider modules stay importable without dragging the
+        # solver package (and its JAX surface) in at import time
+        from ..solver.topology import format_coord as _format_coord
+
         resp = self._call(
             "/v1/run-instances",
             {
@@ -727,7 +843,18 @@ class HTTPCloudProvider(WindowedBatchers, CloudProvider):
                 # restarted operator can never collide with old launches
                 "client_token": uuid.uuid4().hex,
                 "overrides": [
-                    [it.name, o.zone, o.capacity_type] for it, o in candidates
+                    [it.name, o.zone, o.capacity_type]
+                    + (
+                        [
+                            o.slice_pod,
+                            _format_coord(o.slice_coord)
+                            if o.slice_coord is not None
+                            else "",
+                        ]
+                        if o.slice_pod
+                        else []
+                    )
+                    for it, o in candidates
                 ],
             },
         )
@@ -754,6 +881,9 @@ class HTTPCloudProvider(WindowedBatchers, CloudProvider):
         machine.meta.labels[wk.ZONE] = inst["zone"]
         machine.meta.labels[wk.CAPACITY_TYPE] = inst["capacity_type"]
         machine.meta.labels[wk.PROVISIONER_NAME] = machine.provisioner_name
+        for key in (wk.SLICE_POD, wk.SLICE_COORD):
+            if key in inst.get("tags", {}):
+                machine.meta.labels[key] = inst["tags"][key]
         return machine
 
     def delete(self, machine: Machine) -> None:
@@ -887,6 +1017,11 @@ class HTTPCloudProvider(WindowedBatchers, CloudProvider):
                     wk.ZONE: d["zone"],
                     wk.CAPACITY_TYPE: d["capacity_type"],
                     wk.PROVISIONER_NAME: d["tags"].get(wk.PROVISIONER_NAME, ""),
+                    **{
+                        k: d["tags"][k]
+                        for k in (wk.SLICE_POD, wk.SLICE_COORD)
+                        if k in d["tags"]
+                    },
                 },
             ),
             provisioner_name=d["tags"].get(wk.PROVISIONER_NAME, ""),

@@ -64,6 +64,12 @@ def candidate_offerings(
     )
     chosen_ct = wk.CAPACITY_TYPE_SPOT if use_spot else wk.CAPACITY_TYPE_ON_DEMAND
     zone_req = requirements.get(wk.ZONE)
+    # slice-topology pins: a machine launched for a slice-placed node spec
+    # carries the ICI domain/coordinate as requirements, and only offerings
+    # at that exact slice location may satisfy it (absent keys pass — the
+    # default Exists tolerates any offering, sliced or not)
+    slice_pod_req = requirements.get(wk.SLICE_POD)
+    slice_coord_req = requirements.get(wk.SLICE_COORD)
     # ONE pass collects launchable offerings into the chosen-capacity list and
     # (for the spot-vs-OD comparison) the on-demand alternative list, priced
     # LIVE — so the two can never use different filter rules.
@@ -73,6 +79,13 @@ def candidate_offerings(
         for o in it.offerings:
             if not o.available or not zone_req.has(o.zone):
                 continue
+            if not slice_pod_req.has(o.slice_pod):
+                continue
+            if o.slice_coord is not None:
+                from ..solver.topology import format_coord
+
+                if not slice_coord_req.has(format_coord(o.slice_coord)):
+                    continue
             if is_unavailable(it.name, o.zone, o.capacity_type):
                 continue
             p = price(it.name, o.zone, o.capacity_type) if price is not None else None

@@ -19,7 +19,7 @@ from __future__ import annotations
 
 import math
 from dataclasses import dataclass, field, replace
-from typing import Dict, List, Mapping, Optional, Sequence
+from typing import Dict, List, Mapping, Optional, Sequence, Tuple
 
 from ..api import labels as wk
 from ..api.objects import KubeletConfiguration
@@ -43,6 +43,13 @@ class Offering:
     mask, so the estimate rides the seqnum-cached instance-type lists and
     the flight recorder captures it per round. 0.0 (the on-demand/disabled
     value) keeps legacy constructions and problem digests unchanged.
+
+    ``slice_pod``/``slice_coord`` are the TPU slice-topology axis
+    (solver/topology.py): the ICI domain ("TPU pod") this offering's chips
+    belong to and the torus (x, y, z) coordinate inside it. Both are sparse —
+    empty/None for every non-slice offering, so legacy catalogs, wire
+    capsules and problem digests are byte-identical — and both ride the
+    launched node as ``karpenter.tpu/slice-*`` labels.
     """
 
     zone: str
@@ -50,6 +57,8 @@ class Offering:
     price: float
     available: bool = True
     interruption_probability: float = 0.0
+    slice_pod: str = ""
+    slice_coord: Optional[Tuple[int, int, int]] = None
 
     def pool_key(self, instance_type_name: str) -> "CapacityPool":
         return (instance_type_name, self.zone, self.capacity_type)
@@ -121,16 +130,25 @@ def offering_to_wire(o: Offering) -> Dict:
     # recorded before the risk axis existed decode identically
     if o.interruption_probability:
         out["interruptionProbability"] = o.interruption_probability
+    # sparse slice-topology axis: non-slice offerings stay byte-identical on
+    # the wire, and pre-topology capsules decode identically
+    if o.slice_pod:
+        out["slicePod"] = o.slice_pod
+    if o.slice_coord is not None:
+        out["sliceCoord"] = list(o.slice_coord)
     return out
 
 
 def offering_from_wire(d: Dict) -> Offering:
+    coord = d.get("sliceCoord")
     return Offering(
         zone=d["zone"],
         capacity_type=d["capacityType"],
         price=d["price"],
         available=d.get("available", True),
         interruption_probability=d.get("interruptionProbability", 0.0),
+        slice_pod=d.get("slicePod", ""),
+        slice_coord=tuple(coord) if coord is not None else None,
     )
 
 

@@ -53,6 +53,14 @@ class PlannedAction:
     replacements: List[object] = field(default_factory=list)  # NewNodeSpec list
     created: float = 0.0
     savings: float = 0.0  # $/hr reclaimed (consolidation actions)
+    # gang-whole consolidation (slice-topology subsystem): members of the
+    # candidate node's gangs that sit on OTHER nodes — evicted at execute
+    # time so the whole gang re-enters Pending together and the provisioning
+    # gang gate re-places it atomically (all-or-nothing + rollback). Empty
+    # for every non-gang action (legacy wire/replay identity unchanged).
+    evict_pods: List[str] = field(default_factory=list)
+    #: the gangs this action moves whole (audit/decision detail)
+    gangs: List[str] = field(default_factory=list)
 
     @property
     def replacement(self) -> Optional[object]:
@@ -126,6 +134,12 @@ class DeprovisioningController:
         self.sweep_workers = default_workers(self.settings.consolidation_sweep_workers)
         self._worker_solvers: Optional[List[tuple]] = None  # lazy clones
         self.pending_action: Optional[PlannedAction] = None
+        # gang-aware sweep state (reset per _consolidatable pass): nodes
+        # hosting movable gangs (single-node sweep only — the multi-node
+        # prefix search keeps its bounded non-gang scope) and the per-gang
+        # movability memo (bound_members + PDB vets are O(cluster pods))
+        self._gang_hosts: set = set()
+        self._gang_movable_memo: Optional[Dict[str, Optional[tuple]]] = None
         # machine-name sequence override (replay harness; None = global)
         self.machine_ids = None
         # flight-recorder round state (set per reconcile pass)
@@ -381,7 +395,11 @@ class DeprovisioningController:
         self._sweep_daemonsets = self.cluster.daemonsets()
         try:
             # multi-node first (2..N cheapest-to-disrupt prefix), then single
-            multi = self._try_multi_node(candidates)
+            # — gang-hosting nodes only join the single-node sweep, where
+            # the whole-gang move semantics are defined
+            multi = self._try_multi_node(
+                [n for n in candidates if n.name not in self._gang_hosts]
+            )
             if multi is not None:
                 return multi
             action = self._single_node_sweep(candidates)
@@ -474,8 +492,60 @@ class DeprovisioningController:
         clone.risk_penalty = s.risk_penalty
         return clone
 
+    def _gang_movable(self, group: str) -> Optional[Tuple[str, str]]:
+        """Can gang ``group`` be moved WHOLE by a sweep? Returns None when
+        yes, else (blocking pod, reason). Every bound member — wherever it
+        sits — must be owned, evictable, PDB-clear, and on a MANAGED node
+        (a member on capacity we don't control can never be re-placed by our
+        gang gate, so the gang is not ours to move). Memoized per pass."""
+        memo = self._gang_movable_memo
+        if memo is not None and group in memo:
+            return memo[group]
+        from ..solver import gang as gangmod
+        from .termination import pdb_blocks
+
+        managed = {n.name for n in self.cluster.managed_nodes()}
+        blocker: Optional[Tuple[str, str]] = None
+        members = gangmod.bound_members(self.cluster, group)
+        # CUMULATIVE PDB accounting (the preemption planner's discipline):
+        # the move evicts every member together, so each member's check
+        # counts the gang's earlier members as already-disrupted — a PDB
+        # every member clears alone must not be blown by the whole move
+        planned: set = set()
+        for m in members:
+            if m.meta.annotations.get(wk.DO_NOT_EVICT_ANNOTATION) == "true":
+                blocker = (m.name, "gang member carries do-not-evict")
+                break
+            if not m.owned():
+                blocker = (m.name, "controllerless gang member cannot be recreated")
+                break
+            if m.node_name not in managed:
+                blocker = (m.name, "gang member on unmanaged node")
+                break
+            if pdb_blocks(self.cluster, m, planned=planned):
+                blocker = (m.name, "gang member pod disruption budget violated")
+                break
+            planned.add(m.meta.name)
+        if memo is not None:
+            memo[group] = blocker
+        return blocker
+
+    @property
+    def _gang_moves_enabled(self) -> bool:
+        """Gang-whole consolidation rides the slice-topology subsystem
+        switch: with it off, gang-hosting nodes stay fenced off exactly as
+        PR 6 left them (a cost sweep must never split an atomic group, and
+        moving one whole needs the topology-aware gate to re-place it
+        well)."""
+        return (
+            self.settings.gang_scheduling_enabled
+            and self.settings.slice_topology_enabled
+        )
+
     def _consolidatable(self) -> List[Node]:
         out = []
+        self._gang_hosts = set()
+        self._gang_movable_memo = {}
         for node in self._candidates():
             prov = self._provisioner_of(node)
             if prov is None or not prov.consolidation_enabled:
@@ -484,6 +554,7 @@ class DeprovisioningController:
                 continue
             pods = [p for p in self.cluster.pods_on_node(node.name) if not p.is_daemonset]
             blocker = None  # (blocking pod, reason) — the audit log's answer
+            hosts_gang = False
             for pod in pods:
                 if pod.meta.annotations.get(wk.DO_NOT_EVICT_ANNOTATION) == "true":
                     blocker = (pod.name, "do-not-evict annotation")
@@ -491,17 +562,27 @@ class DeprovisioningController:
                 if not pod.owned():
                     blocker = (pod.name, "controllerless pod cannot be recreated")
                     break
-                if self.settings.gang_scheduling_enabled and pod.pod_group():
-                    # conservative: consolidation re-places pods one at a
-                    # time, which would transiently drop a gang below quorum
-                    # — an atomic pod group moves only via preemption (whole)
-                    # or its own controller, never a cost sweep
-                    blocker = (pod.name, "gang member (atomic pod group)")
-                    break
+                if self.settings.gang_scheduling_enabled and (g := pod.pod_group()):
+                    if not self._gang_moves_enabled:
+                        # conservative (PR 6): consolidation re-places pods
+                        # one at a time, which would transiently drop a gang
+                        # below quorum — an atomic pod group moves only via
+                        # preemption (whole) or its own controller
+                        blocker = (pod.name, "gang member (atomic pod group)")
+                        break
+                    # gang-aware sweep: the node is a candidate iff every
+                    # hosted gang can move WHOLE (all members, cluster-wide)
+                    hosts_gang = True
+                    blocker = self._gang_movable(g)
+                    if blocker is not None:
+                        break
+                    continue  # the whole-gang vet covers this pod's checks
                 if self.termination._pdb_blocks(pod):
                     blocker = (pod.name, "pod disruption budget violated")
                     break
             if blocker is None:
+                if hosts_gang:
+                    self._gang_hosts.add(node.name)
                 out.append(node)
             else:
                 # coalesced: the same blocker repeats every pass until the
@@ -514,9 +595,15 @@ class DeprovisioningController:
 
     def _disruption_cost(self, node: Node) -> float:
         """consolidation.md:25-36 ranking: fewer pods first, then pod-deletion
-        cost, pod priority, and sooner-to-expire nodes first."""
+        cost, pod priority, and sooner-to-expire nodes first. A gang-hosting
+        node's cost also counts the CROSS-NODE members its move would evict
+        — whole-gang moves disrupt more than the node's own pod count
+        shows, so plain nodes are tried first."""
         pods = [p for p in self.cluster.pods_on_node(node.name) if not p.is_daemonset]
         cost = float(len(pods))
+        if node.name in self._gang_hosts:
+            _, remote, _ = self._gang_movers(node.name, pods)
+            cost += float(len(remote))
         cost += sum(max(p.deletion_cost(), 0.0) for p in pods) / 1000.0
         cost += sum(max(p.priority, 0) for p in pods) / 1e6
         prov = self._provisioner_of(node)
@@ -525,6 +612,28 @@ class DeprovisioningController:
             remaining = max(prov.ttl_seconds_until_expired - age, 0.0)
             cost *= remaining / prov.ttl_seconds_until_expired
         return cost
+
+    def _gang_movers(self, node_name: str, pods: Sequence[Pod]):
+        """Whole-gang move set for a candidate node: (movers, remote_names,
+        gang_names). ``movers`` is the node's own workload plus every OTHER
+        node's members of the gangs it hosts — the set one simulation must
+        re-place together for the move to be atomic; ``remote_names`` are the
+        cross-node members the action evicts at execute time."""
+        groups = sorted({g for p in pods if (g := p.pod_group())})
+        if not groups:
+            return list(pods), [], []
+        from ..solver import gang as gangmod
+
+        here = {p.meta.name for p in pods}
+        movers = list(pods)
+        remote: List[str] = []
+        for g in groups:
+            for m in gangmod.bound_members(self.cluster, g):
+                if m.meta.name not in here:
+                    movers.append(m)
+                    remote.append(m.meta.name)
+        return movers, remote, groups
+
 
     def _try_single_node(self, node: Node, solvers: Optional[tuple] = None):
         if self._sweep_pods is not None:
@@ -537,14 +646,25 @@ class DeprovisioningController:
                 savings=self._node_price(node),
             )
         price = self._node_price(node)
+        remote: List[str] = []
+        gangs: List[str] = []
+        movers: Sequence[Pod] = pods
+        if node.name in self._gang_hosts:
+            # gang-whole move: the simulation re-places the node's pods AND
+            # the hosted gangs' cross-node members together, against the
+            # fleet with those members' requests freed — one replacement
+            # plan for the whole gang, never a partial placement
+            movers, remote, gangs = self._gang_movers(node.name, pods)
         fits, replacements = self._simulate(
-            pods, exclude=[node.name], price_ceiling=price, solvers=solvers
+            movers, exclude=[node.name], price_ceiling=price, solvers=solvers,
+            freed=remote,
         )
         if not fits:
             return None
         if not replacements:
             return PlannedAction(
-                reason="consolidation-delete", nodes=[node.name], savings=price
+                reason="consolidation-delete", nodes=[node.name], savings=price,
+                evict_pods=remote, gangs=gangs,
             )
         # replacement required: spot nodes are delete-only (deprovisioning.md:83-85)
         if node.capacity_type() == wk.CAPACITY_TYPE_SPOT:
@@ -553,6 +673,7 @@ class DeprovisioningController:
             reason="consolidation-replace", nodes=[node.name],
             replacements=replacements,
             savings=price - sum(r.option.price for r in replacements),
+            evict_pods=remote, gangs=gangs,
         )
 
     def _try_multi_node(self, candidates: List[Node]):
@@ -644,6 +765,7 @@ class DeprovisioningController:
         price_ceiling: Optional[float] = None,
         max_new: Optional[int] = 1,
         solvers: Optional[tuple] = None,
+        freed: Sequence[str] = (),
     ) -> Tuple[bool, List[object]]:
         """Re-schedule simulation: can `pods` land on the remaining nodes, plus at
         most `max_new` new nodes (each strictly cheaper than `price_ceiling`, when
@@ -669,6 +791,13 @@ class DeprovisioningController:
             capacity = self.cluster.existing_capacity()
         excluded = set(exclude)
         existing = [e for e in capacity if e.node.name not in excluded]
+        if freed:
+            # gang-whole moves: cross-node members' requests are handed back
+            # (their nodes survive; the members re-place with the batch) —
+            # the preemption planner's shared freed-capacity idiom
+            from .preemption import freed_existing_view
+
+            existing = freed_existing_view(existing, set(freed))
         provisioners = [
             (prov, self.provider.get_instance_types(prov))
             for prov in self.cluster.provisioners.values()
@@ -752,8 +881,21 @@ class DeprovisioningController:
             for p in self.cluster.pods_on_node(n.name)
             if not p.is_daemonset
         ]
+        # gang-whole moves re-validate the FULL move set: cross-node members
+        # still bound re-place with the batch (vanished ones simply shrink
+        # it); any member that moved onto the candidate node is already in
+        # ``pods``
+        here = {p.meta.name for p in pods}
+        remote = []
+        for name in action.evict_pods:
+            p = self.cluster.pods.get(name)
+            if p is not None and p.node_name is not None and p.meta.name not in here:
+                pods.append(p)
+                remote.append(name)
         price = sum(self._node_price(n) for n in nodes)
-        fits, replacements = self._simulate(pods, exclude=action.nodes, price_ceiling=price)
+        fits, replacements = self._simulate(
+            pods, exclude=action.nodes, price_ceiling=price, freed=remote
+        )
         if not fits:
             return False
         if not action.replacements and replacements:
@@ -773,6 +915,24 @@ class DeprovisioningController:
                 self.cluster, self.provider, replacement, requests,
                 retry_policy=self.retry_policy, machine_ids=self.machine_ids,
             )
+        if action.evict_pods:
+            # gang-whole move: evict the gangs' cross-node members in the
+            # same pass the candidate node drains, so the entire group
+            # re-enters Pending together and the provisioning gang gate
+            # re-places it all-or-nothing (its rollback owns any launch
+            # split). requeue_unowned is belt-and-braces — movability vetted
+            # ownership, but a racing controller change must not delete.
+            from .termination import evict_pod
+
+            for name in action.evict_pods:
+                pod = self.cluster.pods.get(name)
+                if pod is not None and pod.node_name is not None:
+                    evict_pod(
+                        self.cluster, pod, self.recorder,
+                        reason=f"consolidation: gang moved whole "
+                               f"({', '.join(action.gangs)})",
+                        requeue_unowned=True,
+                    )
         for name in action.nodes:
             self.termination.delete_node(name)
         self.termination.reconcile()
@@ -780,16 +940,20 @@ class DeprovisioningController:
         self.recorder.publish(
             "Deprovisioned", f"{action.reason}: {action.nodes}", object_kind="Deprovisioner"
         )
+        details = {
+            "nodes": list(action.nodes),
+            "replacements": [
+                r.option.instance_type.name for r in action.replacements
+            ],
+            "savings": round(action.savings, 5),
+        }
+        if action.gangs:
+            details["gangs_moved_whole"] = list(action.gangs)
+            details["evicted_members"] = list(action.evict_pods)
         DECISIONS.record(
             "consolidation", "acted", reason=action.reason,
             node=action.nodes[0] if action.nodes else "",
-            details={
-                "nodes": list(action.nodes),
-                "replacements": [
-                    r.option.instance_type.name for r in action.replacements
-                ],
-                "savings": round(action.savings, 5),
-            },
+            details=details,
         )
 
     # -- helpers ---------------------------------------------------------

@@ -56,6 +56,46 @@ from .termination import evict_pod, pdb_blocks
 MAX_PREEMPTORS_PER_ROUND = 4
 MAX_VICTIM_UNITS = 16
 
+#: restart tax per evicted pod ($/hr-equivalents): the drain + reschedule +
+#: lost-work cost the preempt-or-launch comparison charges on top of the
+#: trial's price delta, so "evict for free" never beats a genuinely cheap
+#: launch just because victims carry no pod-deletion-cost
+RESTART_TAX_PER_POD = 0.02
+
+#: effective-priority bump for a restart-boosted victim gang (one tier),
+#: applied to its VICTIM-side entitlement ONLY: freshly re-placed after an
+#: eviction, it cannot be re-evicted by an equal-priority preemptor while
+#: the gang_restart_boost_rounds budget runs. Deliberately NOT applied to
+#: the gang's preemptor-side priority — a boosted gang that could evict
+#: equal-priority peers would let two equal-tier gangs displace each other
+#: in a cycle, the exact thrash the budget exists to prevent.
+RESTART_BOOST = 1
+
+
+def freed_existing_view(
+    existing: Sequence[ExistingNode], freed_names: Set[str]
+) -> List[ExistingNode]:
+    """``existing`` with the named pods' requests handed back (their nodes
+    stay; only the pods move) — the shared trial-capacity idiom of the
+    preemption planner and the gang-whole consolidation sweep."""
+    if not freed_names:
+        return list(existing)
+    out: List[ExistingNode] = []
+    for e in existing:
+        gone = [p for p in e.pods if p.meta.name in freed_names]
+        if not gone:
+            out.append(e)
+            continue
+        freed = merge([p.requests + Resources(pods=1) for p in gone])
+        out.append(
+            ExistingNode(
+                node=e.node,
+                remaining=e.remaining + freed,
+                pods=tuple(p for p in e.pods if p.meta.name not in freed_names),
+            )
+        )
+    return out
+
 
 @dataclass
 class Preemptor:
@@ -97,12 +137,43 @@ class PreemptionPlan:
     def victim_names(self) -> List[str]:
         return [p.meta.name for u in self.victims for p in u.pods]
 
+    @property
+    def victim_gangs(self) -> List[str]:
+        """Names of gangs evicted whole by this plan (restart-boost targets)."""
+        return [
+            u.name[len("gang/"):] for u in self.victims
+            if u.name.startswith("gang/")
+        ]
+
+    def evict_cost(self) -> float:
+        """The preempt-or-launch price of executing this plan: the trial's
+        new-node price delta, a flat restart tax per evicted pod, and the
+        victims' pod-deletion-cost scaled to $-hours (the same 1/1000 the
+        consolidation disruption ranking uses)."""
+        n = sum(len(u.pods) for u in self.victims)
+        return self.price_delta + RESTART_TAX_PER_POD * n + self.eviction_cost / 1000.0
+
 
 class PreemptionPlanner:
     def __init__(self, cluster: Cluster, solver, recorder: Optional[Recorder] = None):
         self.cluster = cluster
         self.solver = solver
         self.recorder = recorder or Recorder()
+        # gangs under an active restart boost (evicted whole by an earlier
+        # plan, still inside the gang_restart_boost_rounds thrash budget):
+        # the provisioning controller refreshes this set every reconcile
+        self.restart_boosted: Set[str] = set()
+        # caller-staged capacity view for trial solves (None = read the live
+        # cluster): the in-cascade preempt-or-launch sets it per decision so
+        # a trial can never claim capacity the round's solve already
+        # assigned to other pods (double-booking)
+        self.base_existing: Optional[List[ExistingNode]] = None
+
+    def boosted_priority(self, base: int, gang: Optional[str]) -> int:
+        """Effective priority of a gang under the restart boost."""
+        if gang is not None and gang in self.restart_boosted:
+            return base + RESTART_BOOST
+        return base
 
     # -- candidate victims --------------------------------------------------
     def _victim_units(self, preemptor: Preemptor) -> List[VictimUnit]:
@@ -143,7 +214,13 @@ class PreemptionPlanner:
             units.append(
                 VictimUnit(
                     name=f"gang/{g}", pods=members,
-                    priority=max(p.priority for p in members),
+                    # restart-boosted gangs carry one extra tier of
+                    # entitlement: freshly re-placed after an eviction, they
+                    # cannot be re-evicted by an equal-priority preemptor
+                    # while the thrash budget runs
+                    priority=self.boosted_priority(
+                        max(p.priority for p in members), g
+                    ),
                     deletion_cost=sum(max(p.deletion_cost(), 0.0) for p in members),
                 )
             )
@@ -182,22 +259,16 @@ class PreemptionPlanner:
     def _freed_existing(self, victim_names: Set[str]) -> List[ExistingNode]:
         """The cluster's existing capacity with the victims' requests handed
         back — exactly the view the re-solve will see once the evictions
-        execute, so the accepted trial IS the final placement."""
-        out: List[ExistingNode] = []
-        for e in self.cluster.existing_capacity():
-            gone = [p for p in e.pods if p.meta.name in victim_names]
-            if not gone:
-                out.append(e)
-                continue
-            freed = merge([p.requests + Resources(pods=1) for p in gone])
-            out.append(
-                ExistingNode(
-                    node=e.node,
-                    remaining=e.remaining + freed,
-                    pods=tuple(p for p in e.pods if p.meta.name not in victim_names),
-                )
-            )
-        return out
+        execute, so the accepted trial IS the final placement. When the
+        caller staged a base view (``base_existing`` — the in-cascade
+        preempt-or-launch passes capacity NET of the round's still-unbound
+        existing assignments), victims free capacity on top of it."""
+        base = (
+            self.base_existing
+            if self.base_existing is not None
+            else self.cluster.existing_capacity()
+        )
+        return freed_existing_view(base, victim_names)
 
     # -- planning -----------------------------------------------------------
     def plan(self, preemptor: Preemptor, digest_sink=None) -> Optional[PreemptionPlan]:

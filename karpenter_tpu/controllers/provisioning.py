@@ -29,6 +29,7 @@ from ..cloudprovider.interface import CloudProvider, CloudProviderError, Insuffi
 from ..cloudprovider.types import InstanceType
 from ..solver import diversify
 from ..solver import gang as gangmod
+from ..solver import topology
 from ..solver.encode import ExistingNode
 from ..solver.gang import Gang
 from ..solver.result import NewNodeSpec, SolveResult
@@ -228,6 +229,11 @@ class ProvisioningController:
         self._gang_wait: Dict[str, int] = {}
         self._gang_wait_ticked: set = set()
         self.preemption = PreemptionPlanner(cluster, self.solver, self.recorder)
+        # victim-gang restart boost (thrash budget): gang name -> reconciles
+        # of +1-tier protection left. Set when a plan evicts a gang whole,
+        # ticked down once per reconcile, expired entries dropped — bounded
+        # by construction (every entry starts at gang_restart_boost_rounds).
+        self._gang_restart_boost: Dict[str, int] = {}
         cluster.watch(self._on_event)
 
     @property
@@ -371,6 +377,15 @@ class ProvisioningController:
             else {}
         )
         self._gang_wait_ticked.clear()  # new reconcile: each gang may tick once
+        # restart-boost bookkeeping: the protected set is built BEFORE the
+        # tick-down, so a boost of N protects exactly N subsequent
+        # reconciles (building it after dropped the last protected round —
+        # rounds=1 would have protected nothing)
+        self.preemption.restart_boosted = set(self._gang_restart_boost)
+        if self._gang_restart_boost:
+            self._gang_restart_boost = {
+                k: v - 1 for k, v in self._gang_restart_boost.items() if v > 1
+            }
         if len(self._gang_wait) > 512:
             # bound the wait map: gangs that vanished without ever admitting
             # (cancelled jobs, deleted members) would otherwise accrete one
@@ -414,6 +429,10 @@ class ProvisioningController:
         div_masked: set = set()
         div_retries = 0
         div_fallback = False  # placement-over-diversification escape taken
+        # gangs admitted by evicting victims (in-cascade preempt-or-launch
+        # or the post-cascade last resort): their gang-admitted verdict is
+        # emitted at the decision point, so _finalize_gangs skips them
+        preempted_gangs: set = set()
         for round_no in range(
             max(len(provisioners), 1) + 1 + self._ICE_RETRIES
             + self._DIVERSIFY_RETRIES + 1
@@ -503,6 +522,14 @@ class ProvisioningController:
                     capacity_gangs[gname] = gangs[gname]
                     gang_admit_details.pop(gname, None)
                 gang_admit_details.update(gate.admitted_details)
+                # preempt-or-launch: an admitted gang about to open FRESH
+                # capacity may instead evict cheaper victims and bind onto
+                # the freed nodes — one cost decision inside the cascade,
+                # not a last resort after it
+                solve, pol = self._preempt_or_launch(
+                    solve, gangs, gate.admitted_gangs, result, cap
+                )
+                preempted_gangs |= pol
             div_stripped = False
             if div_units:
                 # spot-pool concentration gate, after the gang gate (it must
@@ -591,11 +618,10 @@ class ProvisioningController:
         # (a capacity-deferred or launch-blocked gang, or an unschedulable
         # prioritized pod) may displace cheaper lower-priority victims and
         # bind in this same round.
-        preempted_gangs: set = set()
         if self.settings.preemption_enabled and (
             result.unschedulable or result.gang_deferred or capacity_gangs
         ):
-            preempted_gangs = self._run_preemption(
+            preempted_gangs |= self._run_preemption(
                 result, gangs, capacity_gangs, cap
             )
         # All-or-nothing epilogue: launch failures (limits, ICE, cloud
@@ -1186,6 +1212,31 @@ class ProvisioningController:
         drop_spec_idx: set = set()
         swap_specs: List[NewNodeSpec] = []
         digest_sink = cap.add_digest if cap is not None else None
+        # slice-adjacency scoring is active only when BOTH the setting is on
+        # and the round's catalog actually carries ICI coordinates — a
+        # topology-enabled operator on a sliceless catalog is the zone-
+        # granular PR 6 gate, byte for byte
+        slice_active = self.settings.slice_topology_enabled and (
+            topology.catalog_has_slices(round_provs)
+        )
+        # coordinates claimed by gangs admitted EARLIER IN THIS PASS: their
+        # swapped specs are staged (not yet cluster nodes), so without this
+        # accumulator two gangs replanned into the same cheapest domain
+        # would window onto colliding slice locations
+        pass_occupied: Dict[Tuple[str, str], set] = {}
+
+        def occupied_lookup(zone: str, domain: str) -> frozenset:
+            return self._occupied_coords(zone, domain) | frozenset(
+                pass_occupied.get((zone, domain), ())
+            )
+
+        def claim_coords(specs) -> None:
+            for s in specs:
+                opt = s.option
+                if opt.slice_pod and opt.slice_coord is not None:
+                    pass_occupied.setdefault(
+                        (opt.zone, opt.slice_pod), set()
+                    ).add(opt.slice_coord)
         for name in sorted(gangs):
             g = gangs[name]
             # judge only the members still unbound: a mid-cascade round must
@@ -1224,23 +1275,205 @@ class ProvisioningController:
                     },
                 )
                 continue
-            # fully placed: rank-aware zone packing for pure fresh-node gangs
+            # fully placed: rank-aware packing for pure fresh-node gangs
             # (only when the WHOLE gang is being placed this round — already-
-            # bound members pin their zones and are never repacked)
+            # bound members pin their zones/slices and are never repacked).
+            # With slice topology active the score is ICI hop distance
+            # (adjacency replan onto one domain, compact coordinate remap);
+            # otherwise the PR 6 zone-granular scatter replan runs verbatim.
             price_delta = 0.0
             zones = set(placement.zones)
             zones.update(z for p in bound if (z := node_zone(p.node_name or "")))
-            if placement.pure and len(zones) > 1 and not bound:
+            hop_mean: Optional[float] = None
+            domains: List[str] = []
+            did_slice = False
+            # the gang's replan outcome is staged locally and folded into
+            # the shared drop/swap sets only at ADMISSION — a required-mode
+            # deferral below must discard the swap, or the swapped specs
+            # (which bypass the per-spec strip filter) would bind a gang
+            # the gate just deferred
+            gang_drop: set = set()
+            gang_swap: List[NewNodeSpec] = []
+            if slice_active and bound:
+                # scale-up of a RUNNING adjacency-required gang: new
+                # members must join the bound members' home domain. A
+                # solver plan that leaves it gets one pinned replan
+                # (budget bypassed — required is a constraint, not a
+                # preference); failing that, the new members defer. A gang
+                # running on non-slice capacity has no satisfiable home —
+                # the annotation is inert for it, like the CPU-gang case.
+                mode = gangmod.gang_adjacency_mode(g_round)
+                if mode == "required" and gangmod.wants_slices(g_round):
+                    home = {
+                        (n.zone(), n.slice_pod())
+                        for p in bound
+                        if (n := self.cluster.nodes.get(p.node_name or ""))
+                        is not None
+                    }
+                    anchored = len(home) == 1 and next(iter(home))[1] != ""
+                    if anchored:
+                        locs = set()
+                        for node_name, names_ in solve.existing_assignments.items():
+                            if unbound_names & set(names_):
+                                n = self.cluster.nodes.get(node_name)
+                                locs.add(
+                                    (n.zone(), n.slice_pod())
+                                    if n is not None
+                                    else ("", "")
+                                )
+                        for spec in solve.new_nodes:
+                            if unbound_names & set(spec.pod_names):
+                                locs.add(
+                                    (spec.option.zone, spec.option.slice_pod)
+                                )
+                        ok = locs <= home
+                        if ok and placement.pure and placement.pure_spec_idx:
+                            # in-domain already, but the solver stacks
+                            # price-equal coordinates arbitrarily: remap
+                            # the new members' specs onto free slots so
+                            # they never collide with the running members'
+                            zone_h, dom_h = next(iter(home))
+                            remapped = topology.remap_compact(
+                                [
+                                    solve.new_nodes[i]
+                                    for i in placement.pure_spec_idx
+                                ],
+                                round_provs,
+                                occupied=occupied_lookup(zone_h, dom_h),
+                            )
+                            if remapped is not None:
+                                gang_drop = set(placement.pure_spec_idx)
+                                gang_swap = remapped
+                        if not ok and placement.pure:
+                            replan = gangmod.slice_adjacency_replan(
+                                self.solver, g_round, placement.cost, [],
+                                round_provs,
+                                self.settings.slice_hop_penalty_frac,
+                                daemonsets=daemonsets,
+                                digest_sink=digest_sink,
+                                occupied_lookup=occupied_lookup,
+                                enforce_budget=False,
+                                restrict=home,
+                            )
+                            if replan is not None:
+                                _domain, specs, cost, _hops = replan
+                                gang_drop = set(placement.pure_spec_idx)
+                                gang_swap = specs
+                                price_delta = round(
+                                    cost - placement.cost, 5
+                                )
+                                ok = True
+                        if not ok:
+                            strip.update(unbound_names)
+                            deferred.extend(sorted(unbound_names))
+                            capacity_deferred.append(name)
+                            self._note_gang_deferral(
+                                g, "gang-deferred",
+                                "scale-up members cannot join the running "
+                                "gang's slice domain (slice-adjacency: "
+                                "required)",
+                                {
+                                    "members": len(g.pods),
+                                    "domains": sorted(
+                                        d for _, d in home if d
+                                    ),
+                                },
+                            )
+                            continue
+            if slice_active and placement.pure and not bound:
+                pts = [
+                    topology.spec_point(solve.new_nodes[i].option)
+                    for i in placement.pure_spec_idx
+                ]
+                hop_mean, _ = topology.plan_hop_stats(pts)
+                domains = sorted(
+                    {p.slice_pod for p in pts if p.slice_pod}
+                )
+                mode = gangmod.gang_adjacency_mode(g_round)
+                slice_eligible = mode != "none" and gangmod.wants_slices(g_round)
+                if slice_eligible and hop_mean > 0:
+                    replan = gangmod.slice_adjacency_replan(
+                        self.solver, g_round, placement.cost, pts, round_provs,
+                        self.settings.slice_hop_penalty_frac,
+                        daemonsets=daemonsets, digest_sink=digest_sink,
+                        occupied_lookup=occupied_lookup,
+                        # required mode: adjacency is a hard constraint —
+                        # the best single-domain plan wins whatever it
+                        # costs against the incumbent (a budget-filtered
+                        # None would defer the gang forever while feasible
+                        # adjacent capacity exists)
+                        enforce_budget=(mode != "required"),
+                    )
+                    if replan is not None:
+                        # only a SUCCESSFUL slice swap supersedes the PR 6
+                        # zone replan: a budget-rejected slice replan must
+                        # still fall through to the single-zone repack a
+                        # multi-zone scatter would otherwise get
+                        did_slice = True
+                        domain, specs, cost, hop_mean = replan
+                        gang_drop = set(placement.pure_spec_idx)
+                        gang_swap = specs
+                        price_delta = round(cost - placement.cost, 5)
+                        zones = {specs[0].option.zone} if specs else zones
+                        domains = [domain]
+                # "required" binds only slice-CONSUMING gangs: a CPU gang
+                # annotated required can never be slice-adjacent, and
+                # deferring it forever would be a silent permanent-Pending
+                # trap for a one-line annotation mistake (the annotation is
+                # simply inert for it, like "preferred")
+                if mode == "required" and slice_eligible and (
+                    len(domains) != 1
+                    or len(zones) > 1
+                    or hop_mean is None
+                    or hop_mean >= topology.CROSS_POD_HOPS
+                ):
+                    # adjacency is a hard constraint for this gang: no
+                    # single-domain plan exists this round, so it waits
+                    # (all-or-nothing discipline, now in the ICI dimension)
+                    strip.update(unbound_names)
+                    deferred.extend(sorted(unbound_names))
+                    capacity_deferred.append(name)
+                    self._note_gang_deferral(
+                        g, "gang-deferred",
+                        "no adjacent single-slice-domain placement "
+                        "(slice-adjacency: required)",
+                        {"members": len(g.pods), "domains": domains},
+                    )
+                    continue
+            if not did_slice and placement.pure and len(zones) > 1 and not bound:
                 replan = gangmod.rank_aware_replan(
                     self.solver, g, placement.cost, zones, round_provs,
                     daemonsets=daemonsets, digest_sink=digest_sink,
                 )
                 if replan is not None:
                     zone, specs, cost = replan
-                    drop_spec_idx.update(placement.pure_spec_idx)
-                    swap_specs.extend(specs)
+                    gang_drop = set(placement.pure_spec_idx)
+                    gang_swap = specs
                     price_delta = round(cost - placement.cost, 5)
                     zones = {zone}
+                    if hop_mean is not None:
+                        # the hop detail must describe the SWAPPED plan, not
+                        # the scattered one the zone replan just replaced
+                        hop_mean, _ = topology.plan_hop_stats(
+                            [topology.spec_point(s.option) for s in specs]
+                        )
+                        domains = sorted(
+                            {
+                                s.option.slice_pod
+                                for s in specs
+                                if s.option.slice_pod
+                            }
+                        )
+            drop_spec_idx.update(gang_drop)
+            swap_specs.extend(gang_swap)
+            # register the admitted gang's slice locations so LATER gangs
+            # in this same pass window around them (their specs are staged,
+            # not yet cluster nodes)
+            claim_coords(
+                gang_swap
+                if gang_swap
+                else [solve.new_nodes[i] for i in placement.pure_spec_idx]
+            )
             admitted.extend(sorted(unbound_names))
             admitted_gangs.append(name)
             admitted_details[name] = {
@@ -1249,6 +1482,10 @@ class ProvisioningController:
                 "scattered": len(zones) > 1,
                 "price_delta": price_delta,
             }
+            if slice_active and hop_mean is not None:
+                admitted_details[name]["hop_mean"] = round(hop_mean, 4)
+                admitted_details[name]["slice_domains"] = domains
+                metrics.GANG_HOP_DISTANCE.observe(hop_mean)
         if not strip and not drop_spec_idx:
             return GangGateOutcome(
                 solve, deferred, admitted, admitted_gangs, capacity_deferred,
@@ -1293,6 +1530,19 @@ class ProvisioningController:
             admitted_details,
         )
 
+    def _occupied_coords(self, zone: str, domain: str) -> frozenset:
+        """Slice coordinates live nodes already hold in (zone, domain): the
+        adjacency remap windows around them — a physical slice hosts one
+        node, so successive gangs in one domain must not collide. Pure
+        function of cluster state, so replay re-derives it byte-for-byte."""
+        return frozenset(
+            c
+            for n in self.cluster.nodes.values()
+            if n.zone() == zone
+            and n.slice_pod() == domain
+            and (c := n.slice_coord()) is not None
+        )
+
     def _note_gang_deferral(
         self, g: Gang, outcome: str, reason: str, details: Dict
     ) -> None:
@@ -1321,6 +1571,174 @@ class ProvisioningController:
         )
 
     # -- preemption ---------------------------------------------------------
+    def _priority_floor(self) -> Optional[int]:
+        """Lowest priority among bound workload pods — the entitlement bar a
+        preemptor must clear strictly (None when nothing is bound)."""
+        floor = None
+        for p in self.cluster.pods.values():
+            if p.node_name is not None and not p.is_daemonset:
+                if floor is None or p.priority < floor:
+                    floor = p.priority
+        return floor
+
+    def _note_gang_evicted(self, plan) -> None:
+        """Start the restart-boost clock for every gang this plan evicted
+        whole (bounded by settings.gang_restart_boost_rounds; 0 disables)."""
+        rounds = self.settings.gang_restart_boost_rounds
+        if rounds <= 0:
+            return
+        for gname in plan.victim_gangs:
+            self._gang_restart_boost[gname] = rounds
+            self.preemption.restart_boosted.add(gname)
+
+    def _preempt_or_launch(
+        self,
+        solve: SolveResult,
+        gangs: Dict[str, Gang],
+        admitted_gangs,
+        result: ProvisioningResult,
+        cap,
+    ) -> Tuple[SolveResult, set]:
+        """One cost decision per admitted gang about to open fresh capacity:
+        evict cost (victim price delta + restart tax, PreemptionPlan.
+        evict_cost) vs. launch cost (the gang's pure new-node price). When
+        eviction wins, the plan executes, the gang binds onto the freed
+        capacity in this same round, and its launch specs are stripped from
+        the solve — "Priority Matters" preemption folded into the packing
+        objective instead of a post-cascade last resort. Gated with slice
+        topology (the topology-aware packing objective); the last-resort
+        path (_run_preemption) stays on regardless.
+
+        Returns the (possibly stripped) solve and the gang names admitted
+        via eviction. Every trial digest flows to the capsule, and both
+        verdicts land in karpenter_tpu_preempt_or_launch_total + the
+        decision log, so the choice replays and explains itself."""
+        if not (
+            self.settings.preemption_enabled
+            and self.settings.slice_topology_enabled
+            and admitted_gangs
+        ):
+            return solve, set()
+        floor = self._priority_floor()
+        if floor is None:
+            return solve, set()
+        node_zone = lambda name: (  # noqa: E731
+            n.zone() if (n := self.cluster.nodes.get(name)) is not None else ""
+        )
+        digest_sink = cap.add_digest if cap is not None else None
+        preempted: set = set()
+        strip_idx: set = set()
+        candidates = sorted(
+            (g for g in admitted_gangs if g in gangs),
+            key=lambda n: (-gangs[n].priority, n),
+        )
+        attempts = 0
+        for gname in candidates:
+            if attempts >= MAX_PREEMPTORS_PER_ROUND:
+                break
+            g = gangs[gname]
+            unbound = [p for p in g.pods if p.node_name is None]
+            if not unbound:
+                continue
+            g_round = Gang(
+                name=gname, pods=unbound, min_members=g.min_members,
+                priority=g.priority,
+            )
+            placement = gangmod.gang_placement(solve, g_round, node_zone)
+            # only PURE fresh-node plans can be cancelled cleanly: shared
+            # specs / existing reuse launch for other pods regardless, so
+            # there is no launch cost to trade away
+            if placement.unplaced or not placement.pure or placement.cost <= 0:
+                continue
+            if g.priority <= floor:
+                continue  # nothing strictly below it to evict
+            launch_cost = placement.cost
+            attempts += 1
+            # the trial must see existing capacity NET of this round's
+            # still-unbound existing assignments: _apply_solve binds them
+            # with no fit re-check AFTER this decision, so a trial claiming
+            # the same free capacity would overcommit the node
+            consumed: Dict[str, Resources] = {}
+            for node_name, pod_names in solve.existing_assignments.items():
+                reqs = [
+                    q.requests + Resources(pods=1)
+                    for n in pod_names
+                    if (q := self.cluster.pods.get(n)) is not None
+                ]
+                if reqs:
+                    consumed[node_name] = merge(reqs)
+            base = []
+            for e in self.cluster.existing_capacity():
+                c = consumed.get(e.node.name)
+                base.append(
+                    e if c is None else ExistingNode(
+                        node=e.node,
+                        remaining=(e.remaining - c).clamp_min_zero(),
+                        pods=e.pods,
+                    )
+                )
+            self.preemption.base_existing = base
+            try:
+                plan = self.preemption.plan(
+                    Preemptor(
+                        name=gname, pods=unbound, priority=g.priority,
+                        is_gang=True,
+                    ),
+                    digest_sink=digest_sink,
+                )
+            finally:
+                self.preemption.base_existing = None
+            if plan is None or plan.evict_cost() >= launch_cost - 1e-9:
+                metrics.PREEMPT_OR_LAUNCH.inc({"verdict": "launch"})
+                DECISIONS.record_coalesced(
+                    "preemption", "preempt-or-launch-launch", pod=gname,
+                    reason="fresh capacity undercuts eviction",
+                    details={
+                        "launch_cost": round(launch_cost, 5),
+                        "evict_cost": (
+                            round(plan.evict_cost(), 5) if plan is not None else None
+                        ),
+                    },
+                )
+                continue
+            # eviction wins: execute, bind the trial, cancel the launches
+            self.preemption.execute(plan)
+            self._note_gang_evicted(plan)
+            for victim in plan.victim_names:
+                result.bound.pop(victim, None)
+            self._apply_solve(plan.result, result, ())
+            strip_idx.update(placement.pure_spec_idx)
+            preempted.add(gname)
+            self._gang_wait.pop(gname, None)
+            metrics.PREEMPT_OR_LAUNCH.inc({"verdict": "evict"})
+            metrics.GANG_VERDICTS.inc({"outcome": "admitted-preemption"})
+            DECISIONS.record(
+                "gang", "gang-admitted", pod=gname,
+                reason="preempt-or-launch: eviction undercut fresh capacity",
+                details={
+                    "members": len(g.pods),
+                    "victims": plan.victim_names,
+                    "launch_cost": round(launch_cost, 5),
+                    "evict_cost": round(plan.evict_cost(), 5),
+                    "price_delta": plan.price_delta,
+                },
+            )
+        if not strip_idx:
+            return solve, preempted
+        new_nodes = [
+            spec for idx, spec in enumerate(solve.new_nodes)
+            if idx not in strip_idx
+        ]
+        stripped = SolveResult(
+            new_nodes=new_nodes,
+            existing_assignments=dict(solve.existing_assignments),
+            unschedulable=list(solve.unschedulable),
+            cost=sum(s.option.price for s in new_nodes),
+            stats=dict(solve.stats),
+            problem_digest=solve.problem_digest,
+        )
+        return stripped, preempted
+
     def _run_preemption(
         self,
         result: ProvisioningResult,
@@ -1333,11 +1751,7 @@ class ProvisioningController:
         Gangs preempt WHOLE (their trial solve places every pending member or
         the plan is rejected) — a gang member never preempts as a singleton.
         Returns the names of gangs admitted via preemption."""
-        floor = None
-        for p in self.cluster.pods.values():
-            if p.node_name is not None and not p.is_daemonset:
-                if floor is None or p.priority < floor:
-                    floor = p.priority
+        floor = self._priority_floor()
         if floor is None:
             return set()  # nothing bound, nothing to evict
         launch_blocked = set(result.unschedulable)
@@ -1359,6 +1773,10 @@ class ProvisioningController:
             alive = len(pending) + len(gangmod.bound_members(self.cluster, gname))
             if alive < g.min_members:
                 continue  # belt-and-braces: below quorum, never preempt
+            # preemptor priority is the gang's OWN: the restart boost is
+            # victim-side protection only (an evicted gang empowered to
+            # displace equal-priority peers would cycle — see
+            # preemption.RESTART_BOOST)
             if pending and g.priority > floor:
                 preemptors.append(
                     Preemptor(
@@ -1389,6 +1807,11 @@ class ProvisioningController:
                 )
                 continue
             self.preemption.execute(plan)
+            self._note_gang_evicted(plan)
+            # last-resort regime: no launch plan existed for this demand, so
+            # the cost decision is eviction vs. nothing — counted separately
+            # from the in-cascade priced verdicts
+            metrics.PREEMPT_OR_LAUNCH.inc({"verdict": "evict-unpriced"})
             # victims bound EARLIER THIS RECONCILE (e.g. fresh serving churn
             # the cascade just placed) are Pending again: drop them from the
             # round's bound map so the result/capsule agrees with cluster
@@ -1854,16 +2277,28 @@ def launch_from_spec(
     option = spec.option
     prov = option.provisioner
     name = f"{prov.name}-{(machine_ids or _machine_ids).next()}"
+    machine_reqs = [
+        Requirement.in_values(wk.INSTANCE_TYPE, [option.instance_type.name]),
+        Requirement.in_values(wk.ZONE, [option.zone]),
+        Requirement.in_values(wk.CAPACITY_TYPE, [option.capacity_type]),
+    ]
+    if option.slice_pod:
+        # slice-placed spec: the machine pins its ICI domain (and coordinate,
+        # when the plan chose one) so the provider launches at exactly that
+        # slice location and the node carries the matching labels
+        from ..solver.topology import format_coord
+
+        machine_reqs.append(Requirement.in_values(wk.SLICE_POD, [option.slice_pod]))
+        if option.slice_coord is not None:
+            machine_reqs.append(
+                Requirement.in_values(
+                    wk.SLICE_COORD, [format_coord(option.slice_coord)]
+                )
+            )
     machine = Machine(
         meta=ObjectMeta(name=name, labels=dict(prov.labels)),
         provisioner_name=prov.name,
-        requirements=Requirements(
-            [
-                Requirement.in_values(wk.INSTANCE_TYPE, [option.instance_type.name]),
-                Requirement.in_values(wk.ZONE, [option.zone]),
-                Requirement.in_values(wk.CAPACITY_TYPE, [option.capacity_type]),
-            ]
-        ),
+        requirements=Requirements(machine_reqs),
         requests=requests,
         taints=list(prov.taints),
         kubelet=prov.kubelet,

@@ -35,8 +35,10 @@ static PyObject *s_required_affinity_terms, *s_tolerations, *s_topology_spread,
     *s_affinity_terms, *s_requests, *s_r, *s_node_selector, *s_meta, *s_labels,
     *s_preferred_affinity_terms, *s_volume_zones, *s_priority, *s_annotations,
     *pod_group_key, /* "karpenter.tpu/pod-group" (lockstep with labels.POD_GROUP) */
-    *spot_div_key;  /* "karpenter.tpu/spot-diversification-max-frac"
+    *spot_div_key,  /* "karpenter.tpu/spot-diversification-max-frac"
                      * (lockstep with labels.SPOT_DIVERSIFICATION) */
+    *slice_adj_key; /* "karpenter.tpu/slice-adjacency"
+                     * (lockstep with labels.SLICE_ADJACENCY) */
 
 /* tuple(d.items()) for a dict; () for empty/non-dict (caller validates). */
 static PyObject *
@@ -130,10 +132,14 @@ gang_or_priority(PyObject *pod, PyObject *idict)
         truthy = PyDict_Contains(ann, pod_group_key);
         if (truthy == 0)
             truthy = PyDict_Contains(ann, spot_div_key);
+        if (truthy == 0)
+            truthy = PyDict_Contains(ann, slice_adj_key);
     } else {
         truthy = PySequence_Contains(ann, pod_group_key);
         if (truthy == 0)
             truthy = PySequence_Contains(ann, spot_div_key);
+        if (truthy == 0)
+            truthy = PySequence_Contains(ann, slice_adj_key);
     }
     Py_DECREF(ann);
     return truthy;
@@ -462,13 +468,14 @@ PyInit__encoder(void)
     pod_group_key = PyUnicode_InternFromString("karpenter.tpu/pod-group");
     spot_div_key = PyUnicode_InternFromString(
         "karpenter.tpu/spot-diversification-max-frac");
+    slice_adj_key = PyUnicode_InternFromString("karpenter.tpu/slice-adjacency");
     if (sig_key == NULL || s_required_affinity_terms == NULL ||
         s_tolerations == NULL || s_topology_spread == NULL ||
         s_affinity_terms == NULL || s_requests == NULL || s_r == NULL ||
         s_node_selector == NULL || s_meta == NULL || s_labels == NULL ||
         s_preferred_affinity_terms == NULL || s_volume_zones == NULL ||
         s_priority == NULL || s_annotations == NULL || pod_group_key == NULL ||
-        spot_div_key == NULL)
+        spot_div_key == NULL || slice_adj_key == NULL)
         return NULL;
     return PyModule_Create(&moduledef);
 }

@@ -110,6 +110,15 @@ class Operator:
             )
             if hasattr(provider, "attach_risk_cache"):
                 provider.attach_risk_cache(risk_cache)
+        # TPU slice topology: a provider that can synthesize ICI-coordinate
+        # offerings (the fake; a real TPU API serves them natively and the
+        # HTTP provider gets them from its server's catalog) expands its
+        # catalog so the gang gate's adjacency machinery has coordinates to
+        # score. Sliceless providers degrade to the zone-granular gate.
+        if settings.slice_topology_enabled and hasattr(
+            provider, "enable_slice_topology"
+        ):
+            provider.enable_slice_topology()
         # AOT kernel executable cache: capacity + persistence from settings
         # (process-global — sweep worker clones share the registry), and the
         # operator's solver inherits the pre-compile/donation policy
@@ -149,7 +158,12 @@ class Operator:
         interruption = None
         if settings.interruption_queue_name is not None:
             # NOT `queue or FakeQueue()`: FakeQueue has __len__, so an empty
-            # caller-supplied queue is falsy and would be silently replaced
+            # caller-supplied queue is falsy and would be silently replaced.
+            # With no injected queue, a provider-served queue (the HTTP
+            # cloud's /v1/queue SQS-analog) wins over a process-local fake:
+            # notices then cross the same wire the launches do.
+            if queue is None:
+                queue = getattr(provider, "queue", None)
             interruption = InterruptionController(
                 cluster, queue if queue is not None else FakeQueue(), termination,
                 unavailable_offerings=getattr(provider, "unavailable_offerings", None),

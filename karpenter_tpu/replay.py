@@ -75,7 +75,11 @@ def _coerce_like(current, raw: str):
 def apply_overrides(capsule: Dict, overrides: Sequence[str]) -> Dict:
     """Apply ``--override`` directives to a (deep-copied) capsule:
 
-    * ``settings.<field>=<value>`` — replay under different settings;
+    * ``settings.<field>=<value>`` — replay under different settings
+      (topology counterfactuals ride this:
+      ``settings.slice_topology_enabled=false`` replays a recorded round
+      topology-blind, ``settings.slice_hop_penalty_frac=<f>`` re-prices
+      adjacency — the capsule catalog already carries the ICI coordinates);
     * ``offerings=<type>/<zone>/<ct>=available|unavailable|price:<x>`` —
       flip an offering's availability (undo an ICE mask, simulate one) or
       reprice it; ``*`` wildcards any path segment;
@@ -765,6 +769,8 @@ def _pending_action_from_wire(wire: Dict, cluster, provider, clock, settings):
         replacements=replacements,
         created=clock.now() - settings.consolidation_validation_ttl - 1.0,
         savings=wire.get("savings", 0.0),
+        evict_pods=list(wire.get("evict_pods", [])),
+        gangs=list(wire.get("gangs", [])),
     )
 
 

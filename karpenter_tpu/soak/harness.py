@@ -38,6 +38,7 @@ import sys
 import tempfile
 import threading
 import time
+import urllib.request
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
@@ -279,6 +280,11 @@ class SoakHarness:
             # path has its own gates (ISSUE 9); the soak watches everything
             # else. Override via extra_env to soak the compile path itself.
             "KARPENTER_TPU_AOT_PRECOMPILE_ENABLED": "false",
+            # interruption notices ride the cloud service's /v1/queue
+            # SQS-analog: the operator's InterruptionController polls it over
+            # real HTTP (Operator.new adopts the HTTP provider's queue), and
+            # the harness's reclaim ops inject messages into it over the wire
+            "KARPENTER_TPU_INTERRUPTION_QUEUE_NAME": "soak-queue",
         })
         env.update(self.cfg.extra_env)
         log_path = os.path.join(self.dump_dir, f"operator-{self._incarnation}.log")
@@ -463,11 +469,42 @@ class SoakHarness:
             wire = got[1]
             if wire["meta"].get("deletionTimestamp") is not None:
                 return  # already going away
+            # the REAL notice path: a spot-interruption message into the
+            # cloud service's /v1/queue SQS-analog, over the wire — the
+            # operator's interruption controller receives it over HTTP,
+            # drains the node and deletes the queue message (exactly-once)
+            iid = str(wire.get("providerId", "")).rsplit("/", 1)[-1]
+            if iid and self._cloud_queue_send(
+                {
+                    "version": "0",
+                    "source": "cloud.compute",
+                    "detail-type": "Spot Instance Interruption Warning",
+                    "detail": {"instance-id": iid},
+                }
+            ):
+                self._count("reclaim-wave")
+                return
+            # fallback (no provider id yet / queue POST failed): direct
+            # deletion-timestamp stamp, the pre-queue reclaim shape
             wire["meta"]["deletionTimestamp"] = time.time()
             out = self._http("PUT", f"/api/nodes/{name}", wire)
             if out is not None and out[0] < 400:
                 self._count("reclaim-wave")
         return op
+
+    def _cloud_queue_send(self, message: Dict) -> bool:
+        """POST one interruption message to the cloud service's queue over
+        the wire; False on any transport failure (callers fall back)."""
+        try:
+            body = json.dumps({"body": json.dumps(message)}).encode()
+            req = urllib.request.Request(
+                f"{self.cloud.endpoint}/v1/queue/send", data=body,
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=5) as r:
+                return r.status == 200
+        except Exception:
+            return False
 
     def _make_drift_op(self, name: str):
         def op() -> None:

@@ -161,12 +161,20 @@ def _signature(pod: Pod) -> tuple:
     # (encoder.c: gang/priority/spot-div check).
     gang = _EMPTY
     ann = pod.meta.annotations
-    if pod.priority or (ann and (wk.POD_GROUP in ann or wk.SPOT_DIVERSIFICATION in ann)):
+    if pod.priority or (
+        ann
+        and (
+            wk.POD_GROUP in ann
+            or wk.SPOT_DIVERSIFICATION in ann
+            or wk.SLICE_ADJACENCY in ann
+        )
+    ):
         gang = (
             pod.priority,
             ann.get(wk.POD_GROUP, ""),
             ann.get(wk.POD_GROUP_MIN_MEMBERS, ""),
             ann.get(wk.SPOT_DIVERSIFICATION, ""),
+            ann.get(wk.SLICE_ADJACENCY, ""),
         )
     sig = (
         _items_t(pod.requests.items_mapping()),
@@ -280,6 +288,12 @@ class LaunchOption:
     # what the cluster actually pays.
     interruption_probability: float = 0.0
     risk_cost: float = 0.0
+    # TPU slice-topology axis (solver/topology.py): the ICI domain and torus
+    # coordinate of the offering's chips. Sparse — ""/None on every
+    # non-slice option, so legacy encodes are untouched; the gang gate's
+    # adjacency replan scores gang plans by the hop distance between these.
+    slice_pod: str = ""
+    slice_coord: Optional[tuple] = None
 
     @property
     def effective_price(self) -> float:
@@ -383,16 +397,34 @@ def build_options(
                     continue
                 if not ct_req.has(offering.capacity_type):
                     continue
-                okey = (offering.zone, offering.capacity_type, provisioner.name)
+                okey = (
+                    offering.zone, offering.capacity_type, provisioner.name,
+                    offering.slice_pod, offering.slice_coord,
+                )
                 oreq = offering_reqs.get(okey)
                 if oreq is None:
-                    oreq = Requirements(
-                        [
-                            Requirement.in_values(wk.ZONE, [offering.zone]),
-                            Requirement.in_values(wk.CAPACITY_TYPE, [offering.capacity_type]),
-                            Requirement.in_values(wk.PROVISIONER_NAME, [provisioner.name]),
-                        ]
-                    )
+                    reqs = [
+                        Requirement.in_values(wk.ZONE, [offering.zone]),
+                        Requirement.in_values(wk.CAPACITY_TYPE, [offering.capacity_type]),
+                        Requirement.in_values(wk.PROVISIONER_NAME, [provisioner.name]),
+                    ]
+                    if offering.slice_pod:
+                        # slice identity rides the node label surface: a
+                        # slice-pinned pod (nodeSelector on the slice keys)
+                        # is compatible with exactly its domain's options
+                        from .topology import format_coord
+
+                        reqs.append(
+                            Requirement.in_values(wk.SLICE_POD, [offering.slice_pod])
+                        )
+                        if offering.slice_coord is not None:
+                            reqs.append(
+                                Requirement.in_values(
+                                    wk.SLICE_COORD,
+                                    [format_coord(offering.slice_coord)],
+                                )
+                            )
+                    oreq = Requirements(reqs)
                     offering_reqs[okey] = oreq
                 node_reqs = merged.intersect(oreq)
                 if daemonsets:
@@ -412,6 +444,8 @@ def build_options(
                         allocatable=effective,
                         interruption_probability=offering.interruption_probability,
                         risk_cost=offering.interruption_probability * risk_penalty,
+                        slice_pod=offering.slice_pod,
+                        slice_coord=offering.slice_coord,
                     )
                 )
     _options_cache.clear()  # hold one generation; stale keys pin dead objects
@@ -477,7 +511,7 @@ def _type_sig(it: InstanceType) -> tuple:
         ),
         tuple(
             (o.zone, o.capacity_type, o.price, o.available,
-             o.interruption_probability)
+             o.interruption_probability, o.slice_pod, o.slice_coord)
             for o in it.offerings
         ),
     )

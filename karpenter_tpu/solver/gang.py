@@ -176,6 +176,123 @@ def candidate_zones(round_provs) -> List[str]:
     return sorted(best, key=lambda z: (best[z], z))[:MAX_REPLAN_ZONES]
 
 
+def _slice_pinned_clone(pod: Pod, domain: str) -> Pod:
+    """A copy of ``pod`` with the ICI domain folded into its nodeSelector —
+    the slice analogue of ``_zone_pinned_clone`` (the slice-pod key is part
+    of every slice offering's requirement surface, so the clone is
+    compatible with exactly that domain's options)."""
+    clone = dataclasses.replace(pod)
+    clone.node_selector = {**pod.node_selector, wk.SLICE_POD: domain}
+    clone.__dict__.pop("_sched_sig", None)
+    return clone
+
+
+def gang_adjacency_mode(gang: Gang) -> str:
+    """The gang's slice-adjacency policy from the per-pod annotation
+    (``karpenter.tpu/slice-adjacency``): "preferred" (default — the replan
+    swaps in an adjacent plan when it wins on penalized cost), "required"
+    (the gang defers until a single-domain plan exists) or "none" (opt out
+    of adjacency scoring). Deterministic under conflicting members: the
+    name-sorted first annotated member wins."""
+    for p in gang.pods:  # pods are name-sorted (collect_gangs)
+        v = p.meta.annotations.get(wk.SLICE_ADJACENCY, "")
+        if v in ("required", "none", "preferred"):
+            return v
+    return "preferred"
+
+
+def wants_slices(gang: Gang) -> bool:
+    """Adjacency replanning only makes sense for gangs that consume TPU
+    chips — a CPU gang pinned onto slice capacity would pay accelerator
+    prices for nothing (the budget check would reject it anyway; this gate
+    saves the doomed trial solves)."""
+    from ..api.resources import GPU_TPU
+
+    return any(p.requests.get(GPU_TPU) > 0 for p in gang.pods)
+
+
+def slice_adjacency_replan(
+    solver,
+    gang: Gang,
+    scattered_cost: float,
+    scattered_points,
+    round_provs,
+    hop_penalty_frac: float,
+    daemonsets: Sequence[Pod] = (),
+    digest_sink=None,
+    max_domains: int = MAX_REPLAN_ZONES,
+    occupied_lookup=None,
+    enforce_budget: bool = True,
+    restrict=None,
+) -> Optional[Tuple[str, List[NewNodeSpec], float, float]]:
+    """Repack a gang onto ONE ICI domain, scored by torus hop distance.
+
+    The incumbent (scattered) plan is charged
+    ``cost * (1 + hop_penalty_frac * mean_hops)`` — the hop-count penalty
+    that replaces the flat 10%-per-zone scatter fraction: cross-zone pairs
+    cost CROSS_ZONE_HOPS, cross-domain pairs CROSS_POD_HOPS, intra-domain
+    pairs their ring-metric distance. Candidate domains are tried
+    cheapest-first (bounded); each trial pins member clones to the domain,
+    solves, then remaps the resulting nodes onto a compact coordinate
+    window (topology.remap_compact) so "one domain" also means "adjacent
+    slices" — windowed around the coordinates live nodes already hold
+    (``occupied_lookup(zone, domain) -> frozenset``; a physical slice hosts
+    one node, so a second gang in a half-full domain packs the free ball).
+    Returns ``(domain, specs, cost, mean_hops)`` for the best plan whose
+    penalized score beats the incumbent's, or None. Every trial's problem
+    digest flows to ``digest_sink`` for byte-faithful replay.
+
+    ``enforce_budget=False`` (the adjacency-REQUIRED mode) keeps the
+    cheapest-first search but accepts the best single-domain plan whatever
+    it costs relative to the incumbent: for a required gang adjacency is a
+    hard constraint, and a budget-filtered None here would defer it forever
+    while feasible adjacent capacity exists. ``restrict`` limits the
+    candidate (zone, domain) pairs — the scale-up path pins the search to a
+    running gang's home domain."""
+    from . import topology
+
+    inc_hops, _ = topology.plan_hop_stats(scattered_points)
+    budget = scattered_cost * (1.0 + hop_penalty_frac * inc_hops)
+    best: Optional[Tuple[str, List[NewNodeSpec], float, float]] = None
+    best_score = None
+    candidates = topology.candidate_domains(round_provs)[:max_domains]
+    if restrict is not None:
+        candidates = [c for c in candidates if c in restrict]
+    for zone, domain in candidates:
+        clones = [_slice_pinned_clone(p, domain) for p in gang.pods]
+        trial = solver.solve_pods(
+            clones, round_provs, existing=(), daemonsets=daemonsets,
+            session=None, phase_mode="sim",
+        )
+        if digest_sink is not None:
+            digest_sink(trial.problem_digest)
+        if trial.unschedulable or trial.existing_assignments:
+            continue
+        occupied = (
+            occupied_lookup(zone, domain)
+            if occupied_lookup is not None
+            else frozenset()
+        )
+        specs = topology.remap_compact(
+            list(trial.new_nodes), round_provs, occupied=occupied
+        )
+        if specs is None:
+            # topology drifted mid-round (or plan outgrew the torus): keep
+            # the solver's own coordinate choices rather than invent options
+            specs = list(trial.new_nodes)
+        cost = sum(s.option.price for s in specs)
+        hops, _ = topology.plan_hop_stats(
+            [topology.spec_point(s.option) for s in specs]
+        )
+        score = cost * (1.0 + hop_penalty_frac * hops)
+        if enforce_budget and score > budget + 1e-9:
+            continue
+        if best_score is None or score < best_score - 1e-9:
+            best = (domain, specs, cost, hops)
+            best_score = score
+    return best
+
+
 def rank_aware_replan(
     solver,
     gang: Gang,

@@ -158,6 +158,10 @@ def _option_patch_key(o) -> tuple:
         id(o.instance_type.requirements),
         o.zone,
         o.capacity_type,
+        # slice identity: coordinate-expanded options share every other
+        # component, and colliding keys would mispatch compat columns
+        o.slice_pod,
+        o.slice_coord,
         tuple(t.as_tuple() for t in o.taints),
     )
 

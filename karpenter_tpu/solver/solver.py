@@ -282,9 +282,14 @@ def problem_digest(problem: EncodedProblem) -> bytes:
         h.update("\x1e".join([e.node.meta.name for e in problem.existing]).encode())
     seen_prov: dict = {}
     for o in problem.options:
-        h.update(
-            f"{o.instance_type.name}\x1f{o.zone}\x1f{o.capacity_type}\x1f{o.provisioner.name}\x1e".encode()
-        )
+        # slice identity is SPARSE in the digest line: two options differing
+        # only in ICI coordinates have identical compat/price rows, so the
+        # array bytes alone cannot tell their orderings apart — but a
+        # sliceless catalog's lines (the pre-topology world) stay unchanged
+        line = f"{o.instance_type.name}\x1f{o.zone}\x1f{o.capacity_type}\x1f{o.provisioner.name}"
+        if o.slice_pod:
+            line += f"\x1f{o.slice_pod}\x1f{o.slice_coord}"
+        h.update((line + "\x1e").encode())
         seen_prov.setdefault(id(o.provisioner), o.provisioner)
     for p in seen_prov.values():
         h.update(repr(_provisioner_sig(p)).encode())
@@ -349,6 +354,8 @@ def _problems_content_equal(a: EncodedProblem, b: EncodedProblem) -> bool:
             or oa.zone != ob.zone
             or oa.capacity_type != ob.capacity_type
             or oa.provisioner.name != ob.provisioner.name
+            or oa.slice_pod != ob.slice_pod
+            or oa.slice_coord != ob.slice_coord
         ):
             return False
     # FULL provisioner signatures: a reused problem's options hand their

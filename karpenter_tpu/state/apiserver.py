@@ -303,18 +303,28 @@ class ClusterAPIServer:
                     cell = query.get("cell")
                     if cell is not None and kind in CellIndex.FILTERABLE:
                         # indexed per-cell list: O(cell) names from the
-                        # maintained index, serialization only for matches
+                        # maintained index; snapshot the matches under the
+                        # lock, encode outside it (same discipline as the
+                        # full list below)
                         names = sorted(self._cell_index.members(kind, cell))
                         with self.backing._lock:
-                            items = [
-                                encode(coll[n]) for n in names if n in coll
-                            ]
+                            objs = [coll[n] for n in names if n in coll]
                             version = self.backing._version
-                        return 200, {"items": items, "resourceVersion": version}
+                        return 200, {
+                            "items": [encode(o) for o in objs],
+                            "resourceVersion": version,
+                        }
+                    # snapshot under the lock, ENCODE OUTSIDE it (round-5
+                    # advisor): wire-encoding a 500k-object collection holds
+                    # the store lock for tens of milliseconds, stalling every
+                    # write (and the watch appliers behind them) per list
                     with self.backing._lock:
-                        items = [encode(o) for o in coll.values()]
+                        objs = list(coll.values())
                         version = self.backing._version
-                    return 200, {"items": items, "resourceVersion": version}
+                    return 200, {
+                        "items": [encode(o) for o in objs],
+                        "resourceVersion": version,
+                    }
                 if method == "POST":
                     obj = decode(body)
                     return self._write(kind, obj, create=True)
@@ -373,6 +383,21 @@ class ClusterAPIServer:
             return 400, {"error": f"{type(e).__name__}: {e}"}
 
     def _write(self, kind: str, obj, create: bool) -> Tuple[int, Dict]:
+        # k8s verb semantics (round-5 advisor): POST is CREATE — an existing
+        # name is 409 AlreadyExists, never a silent overwrite; PUT is
+        # REPLACE — a missing name is 404, so every PUT-path write records
+        # MODIFIED in the watch log, never ADDED. (The check-then-write is
+        # not atomic against a concurrent writer — the same discipline as
+        # every other handler path over this store.)
+        with self.backing._lock:
+            exists = obj.meta.name in self._collection(kind)
+        if create and exists:
+            return 409, {
+                "error": f"{kind}/{obj.meta.name} already exists",
+                "reason": "AlreadyExists",
+            }
+        if not create and not exists:
+            return 404, {"error": f"{kind}/{obj.meta.name} not found"}
         admit = _ADMIT.get(kind)
         if admit is not None:
             admit(obj)  # defaulting + validation; AdmissionError -> 422
@@ -408,7 +433,24 @@ class ClusterAPIServer:
                 body = None
                 length = int(self.headers.get("Content-Length") or 0)
                 if length:
-                    body = json.loads(self.rfile.read(length))
+                    raw = self.rfile.read(length)
+                    try:
+                        body = json.loads(raw)
+                    except (ValueError, UnicodeDecodeError):
+                        # malformed body is a CLIENT error: answer 400 with
+                        # a JSON error instead of letting the decode
+                        # exception tear down the connection (round-5
+                        # advisor — a socket reset reads as a server fault
+                        # and trips retry/breaker machinery for nothing)
+                        payload = json.dumps(
+                            {"error": "malformed JSON request body"}
+                        ).encode()
+                        self.send_response(400)
+                        self.send_header("Content-Type", "application/json")
+                        self.send_header("Content-Length", str(len(payload)))
+                        self.end_headers()
+                        self.wfile.write(payload)
+                        return
                 # server span in the CALLER'S trace (traceparent header),
                 # stamped with the originating reconcile id: one reconcile's
                 # apiserver round-trips join its client span tree by trace

@@ -609,7 +609,19 @@ class HTTPCluster(Cluster):
         fields the server's admission added are folded back in."""
         kind = kind_of(obj)
         with self._InFlight(self, kind, obj.meta.name):
-            stored = self._call("POST", f"/api/{kind}", to_wire(obj))
+            try:
+                stored = self._call("POST", f"/api/{kind}", to_wire(obj))
+            except RuntimeError as e:
+                if "HTTP 409" not in str(e):
+                    raise
+                # POST is strict CREATE on the wire now (409 AlreadyExists):
+                # an add_* over an existing name — a transport retry whose
+                # first attempt landed, or a caller re-adding — replays as
+                # the replace it semantically is, so HTTPCluster's upsert
+                # surface is unchanged
+                stored = self._call(
+                    "PUT", f"/api/{kind}/{obj.meta.name}", to_wire(obj)
+                )
             decoded = KINDS[kind][2](stored)
             if kind in ("provisioners", "nodetemplates"):
                 # admission defaulting ran server-side; adopt the stored spec
@@ -643,9 +655,18 @@ class HTTPCluster(Cluster):
     def update(self, obj) -> None:
         kind = kind_of(obj)
         with self._InFlight(self, kind, obj.meta.name):
-            stored = self._call(
-                "PUT", f"/api/{kind}/{obj.meta.name}", to_wire(obj)
-            )
+            try:
+                stored = self._call(
+                    "PUT", f"/api/{kind}/{obj.meta.name}", to_wire(obj)
+                )
+            except RuntimeError as e:
+                if "HTTP 404" not in str(e):
+                    raise
+                # PUT is strict REPLACE on the wire now (404 on a missing
+                # name): an update racing a server-side delete falls back to
+                # create, preserving this client's historical upsert
+                # behavior for callers that re-announce objects they hold
+                stored = self._call("POST", f"/api/{kind}", to_wire(obj))
             # keep the CALLER'S object authoritative in the cache: controllers
             # mutate objects they hold and expect those instances to stay live
             # (the same contract as the in-process store). Only the version

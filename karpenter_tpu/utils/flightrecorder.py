@@ -395,7 +395,7 @@ def action_to_wire(action) -> Optional[Dict]:
     and must not enter the comparison)."""
     if action is None:
         return None
-    return {
+    out = {
         "reason": action.reason,
         "nodes": list(action.nodes),
         "savings": round(action.savings, 5),
@@ -415,6 +415,14 @@ def action_to_wire(action) -> Optional[Dict]:
             for r in action.replacements
         ],
     }
+    # sparse: gang-whole moves record their cross-node evictions + gangs so
+    # the matured-plan replay reconstructs them; legacy actions' wire (and
+    # every pre-topology capsule comparison) is byte-identical
+    if getattr(action, "evict_pods", None):
+        out["evict_pods"] = list(action.evict_pods)
+    if getattr(action, "gangs", None):
+        out["gangs"] = list(action.gangs)
+    return out
 
 
 class FlightRecorder:

@@ -307,6 +307,24 @@ PREEMPTION_EVICTIONS = Counter(
          "demand, labeled by preemptor kind (gang or pod).",
     registry=REGISTRY,
 )
+GANG_HOP_DISTANCE = Histogram(
+    "karpenter_tpu_gang_hop_distance",
+    help="Mean pairwise ICI hop distance of each admitted gang's placement "
+         "(solver/topology.py metric: ring-metric hops inside a torus, "
+         "CROSS_POD/CROSS_ZONE constants across domains/zones). Observed "
+         "once per gang admission while slice topology is enabled; the "
+         "histogram p50 is the bench's adjacency headline.",
+    registry=REGISTRY,
+    buckets=(0.5, 1.0, 2.0, 4.0, 8.0, 16.0, 32.0),
+)
+PREEMPT_OR_LAUNCH = Counter(
+    "karpenter_tpu_preempt_or_launch_total",
+    help="Preempt-or-launch cost decisions, labeled by verdict: evict (the "
+         "victim price delta plus restart tax undercut the launch price), "
+         "launch (fresh capacity was cheaper), or evict-unpriced (no launch "
+         "plan existed, the PR 6 last-resort regime).",
+    registry=REGISTRY,
+)
 NODES_CREATED = Counter(
     "karpenter_tpu_nodes_created_total",
     help="Nodes launched, labeled by owning provisioner.",
