@@ -336,6 +336,120 @@ def bench_delta_reconcile(n_pods=50_000, churn=0.01, rounds=8, n_types=400):
     }
 
 
+def bench_device_staging(n_pods=5_000, churn=0.01, rounds=6, n_types=50):
+    """Delta staging scenario (ISSUE 14): a deployment-shaped fleet churns
+    ``churn`` per round through an EncodeSession; each round's padded
+    problem tensors stage through the solver's DeviceStager, and the rows
+    it re-uploads must EQUAL an independent host-side diff of consecutive
+    rounds' padded arrays — the churned columns and nothing else. A clean
+    repeat round (same problem re-staged) must move ZERO bytes. This is the
+    regression gate's staging arm: a stager that re-uploads too much is a
+    perf regression; one that re-uploads too little would be serving stale
+    tensors (the correctness property tests pin that side too)."""
+    import statistics as _st
+
+    from karpenter_tpu.api import ObjectMeta, Pod, Provisioner, Resources
+    from karpenter_tpu.cloudprovider import generate_catalog
+    from karpenter_tpu.solver import EncodeSession, TPUSolver
+    from karpenter_tpu.solver.jax_solver import PackInputs
+
+    prov = Provisioner(meta=ObjectMeta(name="default"))
+    provs = [(prov, generate_catalog(n_types=n_types))]
+    cpus = ["100m", "250m", "500m", "1", "2", "4"]
+    mems = ["256Mi", "512Mi", "1Gi", "2Gi", "4Gi", "8Gi"]
+    n_deploys = 20
+
+    def mkpod(name, shape):
+        return Pod(
+            meta=ObjectMeta(name=name),
+            requests=Resources(cpu=cpus[shape % 6], memory=mems[(shape // 2) % 6]),
+        )
+
+    pods = []
+    per = n_pods // n_deploys + 1
+    for shape in range(n_deploys):
+        pods += [mkpod(f"d{shape}-{i}", shape) for i in range(per)]
+    pods = pods[:n_pods]
+    session = EncodeSession()
+    # single-device path: the stager is bypassed under an explicit mesh
+    solver = TPUSolver(portfolio=8, auto_mesh=False, mesh=None)
+
+    def leaves_of(problem):
+        (inputs, orders, alphas, looks, rsvs, swaps, _s, _z) = solver._prepare(
+            problem
+        )
+        d = {f: np.asarray(getattr(inputs, f)) for f in PackInputs._fields}
+        d.update(orders=orders, alphas=alphas, looks=looks, rsvs=rsvs,
+                 swaps=swaps)
+        return d
+
+    def changed_rows(old, new):
+        if old.shape != new.shape or old.dtype != new.dtype:
+            return None  # structural — the stager invalidates
+        if old.ndim == 0 or old.shape[0] == 0:
+            return 0
+        diff = old != new
+        return int(
+            diff.sum() if old.ndim == 1
+            else diff.reshape(old.shape[0], -1).any(axis=1).sum()
+        )
+
+    problem = session.encode(pods, provs)
+    prev = leaves_of(problem)
+    solver._device_inputs(problem)  # first contact: everything stages
+
+    n_churn = max(int(n_pods * churn) // 2, 1)
+    serial = 0
+    matches = True
+    hit_rates, restaged_total, expected_total = [], 0, 0
+    for r in range(rounds):
+        down, up = r % n_deploys, (r + 7) % n_deploys
+        removed = [p for p in pods if p.meta.name.startswith(f"d{down}-")][:n_churn]
+        added = [mkpod(f"up{serial + i}-d{up}", up) for i in range(n_churn)]
+        serial += n_churn
+        gone = {p.meta.name for p in removed}
+        pods = [p for p in pods if p.meta.name not in gone] + added
+        for p in removed:
+            session.pod_event("DELETED", p)
+        for p in added:
+            session.pod_event("ADDED", p)
+        problem = session.encode(pods, provs)
+        cur = leaves_of(problem)
+        solver._device_inputs(problem)
+        rnd = solver._stager.last_round
+        # oracle: the stager's restaged rows must equal the independent diff
+        for name, new in cur.items():
+            exp = changed_rows(prev[name], new)
+            got = rnd["rows"].get(name, 0)
+            if exp is None or exp > max(1, int(new.shape[0] * 0.5)):
+                continue  # full-leaf path; not a scatter restage
+            if exp != got:
+                matches = False
+            restaged_total += got
+            expected_total += exp if exp is not None else 0
+        total = rnd.get("bytes_total", 0)
+        moved = rnd.get("bytes_transferred", 0)
+        hit_rates.append(1.0 - moved / total if total else 0.0)
+        prev = cur
+    # clean repeat: re-stage the SAME problem content — zero transfer
+    solver._device_cache.clear()
+    problem.__dict__.pop("_prep_memo", None)
+    solver._device_inputs(problem)
+    clean = solver._stager.last_round
+    return {
+        "pods": n_pods,
+        "rounds": rounds,
+        "churn_per_round": 2 * n_churn,
+        "leaves": len(prev),
+        "staging_hit_rate": round(float(_st.median(hit_rates)), 5),
+        "restage_matches_churn": bool(matches),
+        "restaged_rows_total": int(restaged_total),
+        "expected_rows_total": int(expected_total),
+        "clean_repeat_restages": int(clean.get("restage", 0) + clean.get("full", 0)),
+        "clean_repeat_transfer_bytes": int(clean.get("bytes_transferred", 0)),
+    }
+
+
 def _device_counts():
     """(jax device count, host CPU count) — wall-clock context recorded
     into the race/fleet scenarios and the final summary line, so a
@@ -1307,7 +1421,7 @@ def bench_cold_solve(n_pods=20_000, n_types=400, trials=5):
     solver.solve_pods(pods, provs, existing=existing)
     solver.solve_pods(pods, provs, existing=existing)
     _join_warm_threads()
-    times, encodes, backends = [], [], []
+    times, encodes, stages, dispatches, backends = [], [], [], [], []
     result = None
     for ci in range(trials):
         batch = list(pods) + [
@@ -1319,6 +1433,8 @@ def bench_cold_solve(n_pods=20_000, n_types=400, trials=5):
         result = solver.solve_pods(batch, provs, existing=existing)
         times.append(time.perf_counter() - t0)
         encodes.append(result.stats.get("encode_s", 0.0))
+        stages.append(result.stats.get("stage_s", 0.0))
+        dispatches.append(result.stats.get("dispatch_s", 0.0))
         backends.append(
             {0.0: "greedy", 1.0: "kernel", 2.0: "host-lp", 3.0: "host-ffd"}.get(
                 result.stats.get("backend"), "?"
@@ -1326,15 +1442,18 @@ def bench_cold_solve(n_pods=20_000, n_types=400, trials=5):
         )
     # machine factor: the regression gate's 100ms acceptance budget was
     # calibrated on the driver box (BENCH_r05: 32ms fresh 50k encode =
-    # 0.64us/pod). A slower box scales the budget by its measured fresh
-    # encode rate against that anchor instead of flapping the gate — on
+    # 0.64us/pod — re-anchored by PR 14's columnar encode to 0.46us/pod,
+    # the old anchor scaled by this code's measured 0.72x per-pod
+    # improvement, so the factor keeps measuring BOX slowness, not code
+    # speed). A slower box scales the budget by its measured fresh encode
+    # rate against that anchor instead of flapping the gate — on
     # driver-class hardware the factor degrades to 1.0 and the gate is the
     # literal acceptance number. CAPPED: the factor is measured by the same
     # code being gated, so an uncapped factor would absorb a real encode
     # regression; past 8x the gate fails regardless (the delta_reconcile
     # gate separately pins encode performance as a ratio).
     enc_ms = _st.median(encodes) * 1e3
-    nominal_enc_ms = 0.00064 * n_pods
+    nominal_enc_ms = 0.00046 * n_pods
     factor = (
         min(max(1.0, enc_ms / nominal_enc_ms), 8.0) if nominal_enc_ms > 0 else 1.0
     )
@@ -1342,7 +1461,12 @@ def bench_cold_solve(n_pods=20_000, n_types=400, trials=5):
         "pods": n_pods,
         "cold_solve_ms": round(_st.median(times) * 1e3, 1),
         "cold_solve_p100_ms": round(max(times) * 1e3, 1),
+        # the cold-path split (PR 14): encode vs device staging vs the
+        # observed device-dispatch latency, per cold solve
         "encode_fresh_ms": round(enc_ms, 1),
+        "stage_ms": round(_st.median(stages) * 1e3, 2),
+        "dispatch_ms": round(_st.median(dispatches) * 1e3, 2),
+        "staging_hit_rate": round(solver._stager.hit_rate(), 4),
         "machine_factor": round(factor, 2),
         "backends": backends,
         "unschedulable": len(result.unschedulable),
@@ -2278,6 +2402,47 @@ def bench_flightrecorder_overhead(repeats=10, n_pods=300):
     }
 
 
+def _box_busy_probe(load_frac=0.5, spin_ratio=2.5):
+    """Pre-flight CPU-contention probe for the soak arm. The DECIDING
+    signal is a SELF-CALIBRATING spin probe: ten identical pure-python spin
+    loops — on an idle box median ≈ min; under a concurrent heavy process
+    the scheduler's time slices inflate most samples, so median/min blowing
+    past ``spin_ratio`` means we are ACTIVELY being preempted right now (no
+    absolute ms budget, so a slow box never false-positives). The 1-minute
+    load average is corroborating context only: it lags by design — a box
+    whose own test run just finished reads high while already idle, and
+    skipping the soak arm on that decay would hollow the gate out. Returns
+    a human-readable reason when the box is busy, else None."""
+    import os
+    import statistics as _st
+
+    cpus = os.cpu_count() or 1
+    try:
+        la1 = os.getloadavg()[0]
+    except OSError:
+        la1 = 0.0
+    samples = []
+    for _ in range(10):
+        t0 = time.perf_counter()
+        x = 0
+        for i in range(100_000):
+            x += i
+        samples.append(time.perf_counter() - t0)
+    lo, med = min(samples), _st.median(samples)
+    if lo > 0 and med / lo > spin_ratio:
+        loaded = (
+            f"; load average {la1:.2f} over {cpus} cpus"
+            if la1 > load_frac * cpus
+            else ""
+        )
+        return (
+            f"spin probe median {med * 1e3:.1f}ms vs best {lo * 1e3:.1f}ms "
+            f"(ratio {med / lo:.1f} > {spin_ratio}) — the box is "
+            f"time-slicing under concurrent load{loaded}"
+        )
+    return None
+
+
 def bench_soak(duration_s=75.0, rate_hz=0.0, seed=11, **overrides):
     """Chaos soak scenario (ISSUE 11 / ROADMAP item 5): the scaled ~60–90 s
     run of the sustained-load harness — the full real-HTTP stack (apiserver +
@@ -2294,6 +2459,24 @@ def bench_soak(duration_s=75.0, rate_hz=0.0, seed=11, **overrides):
     ``python -m karpenter_tpu.soak --duration ...``."""
     from karpenter_tpu.soak import SoakConfig, run_soak
 
+    # Pre-flight load probe (PR 14, the PR 12 note): the soak's invariant
+    # budgets (pod-ready p99, settle-phase stuck pods, memory windows) are
+    # wall-clock contracts, and a box already busy with a concurrent heavy
+    # process stretches the 75s script to ~200s and strands settle pods —
+    # a FALSE invariant failure. A loaded box degrades the arm to an
+    # EXPLICIT skip with a reason, never a bogus red.
+    busy = _box_busy_probe()
+    if busy is not None:
+        return {
+            "skipped_busy_box": True,
+            "reason": busy,
+            "invariant_violations": 0,
+            "replay_all_matched": None,
+            "duplicate_launches": None,
+            "mem_slope_kib_per_s": None,
+            "events_per_s": None,
+            "pod_ready_p99_s": None,
+        }
     config = SoakConfig(
         duration_s=duration_s, rate_hz=rate_hz, seed=seed, **overrides
     )
@@ -2389,6 +2572,8 @@ def bench_config(name, make, repeats=REPEATS):
         cold_batch = batch
     cold_s = statistics.median(cold_times)
     encode_fresh_s = cold_result.stats.get("encode_s", 0.0)
+    cold_stage_s = cold_result.stats.get("stage_s", 0.0)
+    cold_dispatch_s = cold_result.stats.get("dispatch_s", 0.0)
     # validate + bound the cold result (round-4 verdict item 2: one-shot
     # efficiency was unmeasured) — encoded fresh so nothing leaks from the
     # solver's interned state into the check
@@ -2437,9 +2622,18 @@ def bench_config(name, make, repeats=REPEATS):
         "encode_ms": round(encode_s * 1e3, 1),
         "encode_fresh_ms": round(encode_fresh_s * 1e3, 1),
         "cold_solve_ms": round(cold_s * 1e3, 1),
+        # cold-path split (PR 14): encode / device staging / observed
+        # dispatch per cold and novel solve — the data-movement budget,
+        # separable at a glance (stage 0.0 = no device path engaged)
+        "cold_stage_ms": round(cold_stage_s * 1e3, 2),
+        "cold_dispatch_ms": round(cold_dispatch_s * 1e3, 2),
         "cold_efficiency": round(float(cold_eff), 4),
         "novel_cold_ms": round(novel_s * 1e3, 1),
+        "novel_encode_ms": round(novel_result.stats.get("encode_s", 0.0) * 1e3, 1),
+        "novel_stage_ms": round(novel_result.stats.get("stage_s", 0.0) * 1e3, 2),
+        "novel_dispatch_ms": round(novel_result.stats.get("dispatch_s", 0.0) * 1e3, 2),
         "novel_efficiency": round(float(novel_eff), 4),
+        "staging_hit_rate": round(solver._stager.hit_rate(), 4),
         "cost_per_hour": round(float(result.cost), 3),
         "lower_bound": round(lb, 3),
         "efficiency_vs_lb": round(float(eff), 4),
@@ -2492,6 +2686,12 @@ def _run_details(dry_run: bool = False) -> dict:
             )
         except Exception as e:
             details["cell_decompose"] = {"error": f"{type(e).__name__}: {e}"}
+        try:
+            details["device_staging"] = bench_device_staging(
+                n_pods=300, n_types=8, rounds=2
+            )
+        except Exception as e:
+            details["device_staging"] = {"error": f"{type(e).__name__}: {e}"}
         # the soak spawns (and kills) real operator processes — minutes, not
         # seconds: dry-run keeps the summary-line CONTRACT (the soak_* keys
         # appear, null) without running it; the slow gate runs the real thing
@@ -2506,6 +2706,7 @@ def _run_details(dry_run: bool = False) -> dict:
             details[name] = {"error": f"{type(e).__name__}: {e}"}
     for key, fn in (
         ("delta_reconcile", bench_delta_reconcile),
+        ("device_staging", bench_device_staging),
         ("consolidation_sweep", bench_sweep_parallel),
         ("consolidation", bench_consolidation),
         ("interruption", bench_interruption),
@@ -2607,6 +2808,7 @@ def main(argv=None):
     decisions = details.get("decision_overhead", {})
     flightrec = details.get("flightrecorder_overhead", {})
     gangs = details.get("gang_preemption", {})
+    staging = details.get("device_staging", {})
     gangtopo = details.get("gang_topology", {})
     spot = details.get("spot_churn", {})
     cells = details.get("cell_decompose", {})
@@ -2621,6 +2823,12 @@ def main(argv=None):
         "vs_baseline": line["vs_baseline"],
         "efficiency_vs_lb": line["efficiency_vs_lb"],
         "cold_solve_ms": line["cold_solve_ms"],
+        # cold-path data movement (PR 14): device staging time within the
+        # 50k cold solve and the byte-weighted residency hit rate
+        "cold_stage_ms": head.get("cold_stage_ms"),
+        "staging_hit_rate": head.get("staging_hit_rate"),
+        "staging_restage_matches_churn": staging.get("restage_matches_churn"),
+        "staging_delta_hit_rate": staging.get("staging_hit_rate"),
         "delta_encode_speedup": delta.get("encode_speedup"),
         "delta_encode_p50_ms": delta.get("encode_delta_p50_ms"),
         "delta_cost_equal": delta.get("cost_equal"),

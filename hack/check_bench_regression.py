@@ -22,11 +22,20 @@ floor:
   solve under a deterministic solver, and the sharded steady-state round
   must stay >= MIN_CELL_SPEEDUP x faster than the flat round at the same
   scale (churn is cell-local; the flat path re-solves O(cluster) anyway).
-* ``cold_solve`` + ``kernel_race`` (ISSUE 9): a fresh-batch cold solve in a
-  warm process (AOT bucket executables resident) must answer under
-  COLD_SOLVE_MS end to end (acceptance scale: 50k under ``--full``; 20k in
-  the gate), and the kernel backend must win at least one race scenario on
-  BOTH axes — cost AND wall-clock — with zero constraint violations.
+* ``cold_solve`` + ``kernel_race`` (ISSUE 9, tightened by ISSUE 14 to the
+  literal ROADMAP acceptance): a fresh-batch cold solve in a warm process
+  (AOT bucket executables resident) must answer under COLD_SOLVE_MS x
+  machine_factor end to end — under ``--full`` that is the 50k fresh batch
+  against the literal 100ms acceptance number — and the kernel backend must
+  win a race scenario on BOTH axes (cost AND wall-clock) with zero
+  constraint violations; under ``--full`` specifically
+  ``kernel_race_topology`` at 50k must report ``winner_both: kernel``.
+* ``device_staging`` (ISSUE 14): the delta-staging arm — the stager's
+  re-uploaded rows must equal the independent host-side diff of
+  consecutive rounds' padded tensors (restage count == churned-column
+  count), a clean repeat round must move ZERO bytes, and the byte-weighted
+  residency hit rate on the 1%-churn scenario must exceed
+  STAGING_HIT_RATE_FLOOR.
 * ``gang_topology`` (ISSUE 13): on an ICI-coordinate catalog, gangs must
   land on adjacent slices — hop-distance p50 strictly below the
   topology-blind arm's on identical workloads — at cost within
@@ -84,6 +93,10 @@ FLEET_GATE_MAX_BATCH = 16
 #: fresh-batch cold solve (warm process, changed batch) end-to-end budget —
 #: the ROADMAP item-1 acceptance number
 COLD_SOLVE_MS = 100.0
+#: device staging: byte-weighted fraction of staged tensor traffic served
+#: from device residency on the 1%-churn delta scenario (ISSUE 14
+#: acceptance: > 0.9)
+STAGING_HIT_RATE_FLOOR = 0.9
 #: soak: absolute floor on achieved churn. The acceptance target is 1k
 #: events/s on driver-class hardware; the scenario box-calibrates its rate
 #: (a sustainable fraction of measured apiserver ingest, capped at 1k) and
@@ -138,6 +151,7 @@ def run_checks(full: bool = False) -> list:
     cells_fleet = bench.bench_cell_decompose(
         n_pods=20_000, n_cells=8, rounds=8, n_types=30, flat_compare=False
     )
+    staging = bench.bench_device_staging()
     gangtopo = bench.bench_gang_topology()
     race = bench.bench_kernel_race()
     race_topo = bench.bench_kernel_race_topology()
@@ -154,6 +168,7 @@ def run_checks(full: bool = False) -> list:
         "delta_reconcile": delta, "consolidation_sweep": sweep,
         "spot_churn": churn, "cell_decompose": cells,
         "cell_fleet": cells_fleet, "gang_topology": gangtopo,
+        "device_staging": staging,
         "cold_solve": cold, "kernel_race": race,
         "kernel_race_topology": race_topo,
         "kernel_race_topology_50k": race_topo_50k,
@@ -348,6 +363,42 @@ def run_checks(full: bool = False) -> list:
             f"cost={race_topo.get('winner_cost')} "
             f"wall={race_topo.get('winner_wall')})"
         )
+    if full and race_topo_50k is not None and (
+        race_topo_50k.get("winner_both") != "kernel"
+    ):
+        # the literal ROADMAP acceptance (tightened by ISSUE 14): at 50k the
+        # realistic topology race must flip to the kernel on BOTH axes —
+        # a win in some other scenario no longer substitutes under --full
+        failures.append(
+            "kernel_race_topology@50k winner_both is "
+            f"{race_topo_50k.get('winner_both')!r}, not 'kernel' "
+            f"(cost={race_topo_50k.get('winner_cost')} "
+            f"wall={race_topo_50k.get('winner_wall')}) — the acceptance-"
+            "scale race verdict regressed"
+        )
+    # -- device-staging gate (ISSUE 14) --------------------------------------
+    if staging.get("restage_matches_churn") is not True:
+        failures.append(
+            "device_staging: restaged rows diverged from the independent "
+            f"churn diff ({staging.get('restaged_rows_total')} restaged vs "
+            f"{staging.get('expected_rows_total')} churned) — the stager is "
+            "moving the wrong rows"
+        )
+    if staging.get("clean_repeat_restages", 1) != 0 or staging.get(
+        "clean_repeat_transfer_bytes", 1
+    ) != 0:
+        failures.append(
+            "device_staging: a clean repeat round moved "
+            f"{staging.get('clean_repeat_transfer_bytes')} bytes "
+            f"({staging.get('clean_repeat_restages')} restages) — an "
+            "unchanged problem must stage zero"
+        )
+    if (staging.get("staging_hit_rate") or 0.0) <= STAGING_HIT_RATE_FLOOR:
+        failures.append(
+            f"device_staging: residency hit rate "
+            f"{staging.get('staging_hit_rate')} <= floor "
+            f"{STAGING_HIT_RATE_FLOOR} on the 1%-churn delta scenario"
+        )
     for label, r in (
         ("kernel_race_topology", race_topo),
         ("kernel_race_topology_50k", race_topo_50k),
@@ -357,6 +408,17 @@ def run_checks(full: bool = False) -> list:
                 f"{label} produced {r.get('violations')} constraint violations"
             )
     # -- chaos soak gate (ISSUE 11) ------------------------------------------
+    if soak.get("skipped_busy_box"):
+        # the PR 12 contention note, made explicit (ISSUE 14): a box already
+        # running a heavy concurrent process stretches the soak's wall-clock
+        # contracts into false invariant failures — the pre-flight probe
+        # degrades the arm to a VISIBLE skip instead. Every soak assertion
+        # below is bypassed; run the gate on an idle box for the real arm.
+        print(
+            "NOTE: soak arm skipped (busy box): "
+            f"{soak.get('reason')}", file=sys.stderr,
+        )
+        return failures
     if soak.get("invariant_violations", 1) != 0:
         failures.append(
             f"soak tripped {soak.get('invariant_violations')} invariant(s): "

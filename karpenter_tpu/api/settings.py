@@ -169,6 +169,16 @@ class Settings:
     # shapes; the chaos soak runs this way so compile-arena growth cannot
     # mask a real leak).
     aot_precompile_enabled: bool = True
+    # delta-aware device staging (solver/staging.py DeviceStager): problem
+    # tensors stay resident on device across reconcile rounds, keyed by
+    # padded-shape tag; a delta round scatter-updates only its churned rows
+    # instead of re-copying the whole pytree, and donated dispatches clone
+    # the resident master device-side. Disabled: every dispatch re-uploads
+    # everything (the correctness-control path the staging property tests
+    # compare against). Events: karpenter_tpu_device_staging_total{event}.
+    device_staging_enabled: bool = True
+    # resident staged tensor budget per solver (MiB); LRU-evicted past it.
+    device_staging_capacity_mb: int = 256
     # donate problem-tensor device buffers on kernel dispatch: XLA reuses
     # the input allocation for outputs, cutting the device round-trip on
     # cold one-shot solves. Repeat dispatches re-stage inputs from host, so
@@ -262,6 +272,8 @@ class Settings:
             )
         if self.aot_cache_capacity < 1:
             raise ValueError("aotCacheCapacity must be >= 1")
+        if self.device_staging_capacity_mb < 1:
+            raise ValueError("deviceStagingCapacityMb must be >= 1")
         if self.leader_election_enabled and not self.leader_election_lease_path:
             raise ValueError(
                 "leaderElectionLeasePath is required when leader election is enabled"

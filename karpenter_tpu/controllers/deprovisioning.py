@@ -116,6 +116,8 @@ class DeprovisioningController:
                 warmup_spike_s=self.solver.warmup_spike_s,
                 quality_race=True,
                 quality_sync=False,
+                device_staging=self.solver._stager.enabled,
+                staging_capacity_mb=self.solver._stager.capacity_bytes >> 20,
             )
             self.quality_solver.risk_penalty = self.solver.risk_penalty
         # sweep solves attributed by winning backend (observability for the
@@ -482,6 +484,8 @@ class DeprovisioningController:
                 warmup_spike_s=s.warmup_spike_s,
                 quality_race=s.quality_race,
                 quality_sync=s.quality_sync,
+                device_staging=s._stager.enabled,
+                staging_capacity_mb=s._stager.capacity_bytes >> 20,
             )
         elif isinstance(s, GreedySolver):
             clone = GreedySolver()

@@ -847,6 +847,13 @@ class ProvisioningController:
                     daemonsets=daemonsets,
                     session=router.session(key),
                 )
+                # encode/H2D overlap (PR 14): start this cell's padding +
+                # host→device transfers NOW — JAX transfers are async, so
+                # the copies stream while the REMAINING cells encode. The
+                # padded arrays land in the solver's _prepare memo (the
+                # fleet staging below reuses them instead of re-padding)
+                # and the tensors are resident by dispatch time.
+                solvers[i].prestage(staged[i])
             fleet_stats = stage_fleet(
                 [(solvers[i], staged[i]) for i in sorted(staged)],
                 max_batch=self.settings.fleet_max_batch,
@@ -1115,6 +1122,13 @@ class ProvisioningController:
         except Exception:
             return None
         clone.risk_penalty = getattr(self.solver, "risk_penalty", 0.0)
+        # staging policy rides along: per-cell stagers are private, but the
+        # operator's enable/capacity choice must bind every clone (the
+        # staging correctness tests drive a stager-disabled control fleet)
+        st = getattr(self.solver, "_stager", None)
+        if st is not None and hasattr(clone, "_stager"):
+            clone._stager.enabled = st.enabled
+            clone._stager.capacity_bytes = st.capacity_bytes
         return clone
 
     # -- /debug/cells -------------------------------------------------------

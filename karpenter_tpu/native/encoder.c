@@ -23,8 +23,18 @@
  *     the exact same key the Python path uses, so the two implementations
  *     interoperate on warm pods
  *
+ * Columnar-warm grouping (PR 14): the run-adjacency fast path STAMPS the run
+ * leader's signature object onto every matched member, so the next encode of
+ * the same pods takes a cached-signature POINTER compare per pod instead of
+ * re-walking eleven fields — the warm fresh-encode loop drops from ~0.4us to
+ * ~0.1us per pod. Stamping a member with the leader's (value-equal) tuple is
+ * the same merge tolerance matches_prev already applies: it can only keep
+ * together what the insertion-ordered signature might have split into
+ * equivalent groups, never mix distinct scheduling identities.
+ *
  * Exposed API:
  *   group_pods(pods, py_signature) -> list[list[pod]]
+ *   join_names(pods, sep) -> bytes   (the problem-digest name blob)
  */
 
 #define PY_SSIZE_T_CLEAN
@@ -33,7 +43,8 @@
 static PyObject *sig_key = NULL; /* interned "_sched_sig" */
 static PyObject *s_required_affinity_terms, *s_tolerations, *s_topology_spread,
     *s_affinity_terms, *s_requests, *s_r, *s_node_selector, *s_meta, *s_labels,
-    *s_preferred_affinity_terms, *s_volume_zones, *s_priority, *s_annotations,
+    *s_name, *s_preferred_affinity_terms, *s_volume_zones, *s_priority,
+    *s_annotations,
     *pod_group_key, /* "karpenter.tpu/pod-group" (lockstep with labels.POD_GROUP) */
     *spot_div_key,  /* "karpenter.tpu/spot-diversification-max-frac"
                      * (lockstep with labels.SPOT_DIVERSIFICATION) */
@@ -360,6 +371,7 @@ group_pods_c(PyObject *self, PyObject *args)
     PyObject *pods, *py_signature, *buckets = NULL, *order = NULL, *seq = NULL;
     PyObject *prev_r = NULL, *prev_sel = NULL, *prev_labels = NULL;
     PyObject *prev_members = NULL; /* borrowed (owned by order) */
+    PyObject *prev_sig = NULL;     /* owned: the last group's signature */
     Py_ssize_t n, i;
 
     if (!PyArg_ParseTuple(args, "OO", &pods, &py_signature))
@@ -375,22 +387,64 @@ group_pods_c(PyObject *self, PyObject *args)
 
     for (i = 0; i < n; i++) {
         PyObject *pod = PySequence_Fast_GET_ITEM(seq, i); /* borrowed */
-        PyObject *sig, *members;
+        PyObject *sig, *members, *dict;
         int simple = 0;
 
-        if (prev_members != NULL) {
-            int same = matches_prev(pod, prev_r, prev_sel, prev_labels);
-            if (same < 0)
+        /* cached-signature fast path: a pod stamped on an earlier encode
+         * (by signature_for, the Python _signature, or the member-stamping
+         * below) resolves by one dict probe; a POINTER match against the
+         * previous pod's signature appends without even a bucket hash —
+         * the dominant warm-encode case, since run members share the
+         * leader's signature object. */
+        dict = PyObject_GenericGetDict(pod, NULL);
+        if (dict == NULL)
+            goto fail;
+        sig = PyDict_GetItemWithError(dict, sig_key); /* borrowed */
+        if (sig == NULL && PyErr_Occurred()) {
+            Py_DECREF(dict);
+            goto fail;
+        }
+        if (sig != NULL && sig == prev_sig && prev_members != NULL) {
+            Py_DECREF(dict);
+            if (PyList_Append(prev_members, pod) < 0)
                 goto fail;
+            continue;
+        }
+        if (sig == NULL && prev_members != NULL && prev_r != NULL) {
+            int same = matches_prev(pod, prev_r, prev_sel, prev_labels);
+            if (same < 0) {
+                Py_DECREF(dict);
+                goto fail;
+            }
             if (same) {
+                /* stamp the run's signature so the NEXT encode of this pod
+                 * takes the pointer path above (value-equal merge, see the
+                 * module comment) */
+                if (prev_sig != NULL &&
+                    PyDict_SetItem(dict, sig_key, prev_sig) < 0) {
+                    Py_DECREF(dict);
+                    goto fail;
+                }
+                Py_DECREF(dict);
                 if (PyList_Append(prev_members, pod) < 0)
                     goto fail;
                 continue;
             }
         }
-        sig = signature_for(pod, py_signature, &simple);
-        if (sig == NULL)
-            goto fail;
+        if (sig != NULL) {
+            Py_INCREF(sig);
+            Py_DECREF(dict);
+            /* simplicity unknown for an externally-cached signature: keep
+             * the pointer fast path armed but disable the value-compare
+             * (matches_prev merging against a possibly-complex pod would
+             * ignore its constraint fields) */
+            simple = -1;
+        } else {
+            Py_DECREF(dict);
+            sig = signature_for(pod, py_signature, &simple);
+            if (sig == NULL)
+                goto fail;
+        }
         members = PyDict_GetItemWithError(buckets, sig); /* borrowed */
         if (members == NULL) {
             if (PyErr_Occurred()) {
@@ -406,10 +460,10 @@ group_pods_c(PyObject *self, PyObject *args)
             }
             Py_DECREF(members); /* owned by buckets + order now */
         }
-        Py_DECREF(sig);
+        Py_XSETREF(prev_sig, sig); /* transfer: prev_sig owns it now */
         if (PyList_Append(members, pod) < 0)
             goto fail;
-        if (simple) {
+        if (simple == 1) {
             if (load_prev(pod, &prev_r, &prev_sel, &prev_labels) < 0)
                 goto fail;
             prev_members = members;
@@ -417,9 +471,11 @@ group_pods_c(PyObject *self, PyObject *args)
             Py_CLEAR(prev_r);
             Py_CLEAR(prev_sel);
             Py_CLEAR(prev_labels);
-            prev_members = NULL;
+            /* pointer matches still work off the cached signature */
+            prev_members = (simple == -1) ? members : NULL;
         }
     }
+    Py_XDECREF(prev_sig);
     Py_XDECREF(prev_r);
     Py_XDECREF(prev_sel);
     Py_XDECREF(prev_labels);
@@ -428,6 +484,7 @@ group_pods_c(PyObject *self, PyObject *args)
     return order;
 
 fail:
+    Py_XDECREF(prev_sig);
     Py_XDECREF(prev_r);
     Py_XDECREF(prev_sel);
     Py_XDECREF(prev_labels);
@@ -437,10 +494,65 @@ fail:
     return NULL;
 }
 
+/* join_names(pods, sep) -> bytes: the UTF-8 encoding of
+ * sep.join(p.meta.name for p in pods) — the problem-digest name blob,
+ * byte-identical to the Python join (lockstep with solver.problem_digest).
+ * One C pass instead of a 50k-iteration attribute walk + list build. */
+static PyObject *
+join_names_c(PyObject *self, PyObject *args)
+{
+    PyObject *pods, *sep, *seq = NULL, *names = NULL, *joined, *out;
+    Py_ssize_t n, i;
+
+    if (!PyArg_ParseTuple(args, "OU", &pods, &sep))
+        return NULL;
+    seq = PySequence_Fast(pods, "pods must be a sequence");
+    if (seq == NULL)
+        return NULL;
+    n = PySequence_Fast_GET_SIZE(seq);
+    names = PyList_New(n);
+    if (names == NULL) {
+        Py_DECREF(seq);
+        return NULL;
+    }
+    for (i = 0; i < n; i++) {
+        PyObject *pod = PySequence_Fast_GET_ITEM(seq, i); /* borrowed */
+        PyObject *meta, *name;
+        meta = PyObject_GetAttr(pod, s_meta);
+        if (meta == NULL)
+            goto fail;
+        name = PyObject_GetAttr(meta, s_name);
+        Py_DECREF(meta);
+        if (name == NULL)
+            goto fail;
+        if (!PyUnicode_Check(name)) {
+            Py_DECREF(name);
+            PyErr_SetString(PyExc_TypeError, "pod name must be str");
+            goto fail;
+        }
+        PyList_SET_ITEM(names, i, name); /* steals */
+    }
+    joined = PyUnicode_Join(sep, names);
+    Py_DECREF(names);
+    Py_DECREF(seq);
+    if (joined == NULL)
+        return NULL;
+    out = PyUnicode_AsUTF8String(joined);
+    Py_DECREF(joined);
+    return out;
+
+fail:
+    Py_DECREF(names);
+    Py_DECREF(seq);
+    return NULL;
+}
+
 static PyMethodDef methods[] = {
     {"group_pods", group_pods_c, METH_VARARGS,
      "group_pods(pods, py_signature) -> list[list[pod]] bucketed by "
      "scheduling signature, first-seen order"},
+    {"join_names", join_names_c, METH_VARARGS,
+     "join_names(pods, sep) -> bytes: UTF-8 of sep.join(p.meta.name ...)"},
     {NULL, NULL, 0, NULL},
 };
 
@@ -461,6 +573,7 @@ PyInit__encoder(void)
     s_node_selector = PyUnicode_InternFromString("node_selector");
     s_meta = PyUnicode_InternFromString("meta");
     s_labels = PyUnicode_InternFromString("labels");
+    s_name = PyUnicode_InternFromString("name");
     s_preferred_affinity_terms = PyUnicode_InternFromString("preferred_affinity_terms");
     s_volume_zones = PyUnicode_InternFromString("volume_zones");
     s_priority = PyUnicode_InternFromString("priority");
@@ -473,6 +586,7 @@ PyInit__encoder(void)
         s_tolerations == NULL || s_topology_spread == NULL ||
         s_affinity_terms == NULL || s_requests == NULL || s_r == NULL ||
         s_node_selector == NULL || s_meta == NULL || s_labels == NULL ||
+        s_name == NULL ||
         s_preferred_affinity_terms == NULL || s_volume_zones == NULL ||
         s_priority == NULL || s_annotations == NULL || pod_group_key == NULL ||
         spot_div_key == NULL || slice_adj_key == NULL)

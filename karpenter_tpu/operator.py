@@ -132,6 +132,8 @@ class Operator:
         solver = solver or TPUSolver(
             aot_precompile=settings.aot_precompile_enabled,
             aot_donate=settings.aot_donate_inputs,
+            device_staging=settings.device_staging_enabled,
+            staging_capacity_mb=settings.device_staging_capacity_mb,
         )
         provisioning = ProvisioningController(
             cluster, provider, solver=solver, settings=settings, recorder=recorder

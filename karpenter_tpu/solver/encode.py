@@ -1041,6 +1041,91 @@ def _compat_row(
     return tol_ok & req_ok & cap_ok
 
 
+def _req_class_key(g: PodGroup) -> Optional[tuple]:
+    """Content key of everything ``scheduling_requirement_terms`` derives
+    from, read off the representative's cached scheduling signature:
+    (node_selector, required terms, active soft terms, volume zones). Groups
+    whose reps share these four components provably build value-identical
+    ``terms``, so one requirement-table evaluation serves them all. None when
+    the signature is not cached (the caller then evaluates uncached)."""
+    sig = g.pods[0].__dict__.get("_sched_sig") if g.pods else None
+    if sig is None or len(sig) < 9:
+        return None
+    return (sig[1], sig[2], sig[7], sig[8])
+
+
+def _class_rows(
+    groups: Sequence[PodGroup],
+    table: "_ReqTable",
+    taint_groups: Dict[tuple, object],
+    n_cols: int,
+    base_mask: Optional[np.ndarray] = None,
+) -> np.ndarray:
+    """Toleration & requirement compatibility of every group against one
+    column axis (launch options or existing nodes), built columnar: one
+    toleration evaluation per distinct toleration tuple, one
+    requirement-term evaluation per distinct term CLASS (``_req_class_key``)
+    — deployment-shaped fleets share both across most groups, so the
+    per-group python loop collapses to a handful of vectorized passes.
+    ``base_mask`` (e.g. node schedulability) is ANDed into every row; the
+    caller ANDs in its capacity pass via ``_cap_and``. Row-for-row equal to
+    the per-group ``_compat_row`` reference (property-tested)."""
+    out = np.zeros((len(groups), n_cols), dtype=bool)
+    if not len(groups) or not n_cols:
+        return out
+    tol_rows: Dict[tuple, np.ndarray] = {}
+    req_rows: Dict[tuple, np.ndarray] = {}
+    for i, g in enumerate(groups):
+        tol_ok = tol_rows.get(g.tolerations)
+        if tol_ok is None:
+            tol_ok = np.zeros(n_cols, bool)
+            tols = list(g.tolerations)
+            for taints, idx in taint_groups.items():
+                if tolerates_all(tols, taints):
+                    tol_ok[np.asarray(idx)] = True
+            tol_rows[g.tolerations] = tol_ok
+        rkey = _req_class_key(g)
+        req_ok = req_rows.get(rkey) if rkey is not None else None
+        if req_ok is None:
+            req_ok = table.eval_terms(g.terms)
+            if rkey is not None:
+                req_rows[rkey] = req_ok
+        row = tol_ok & req_ok
+        out[i] = row if base_mask is None else row & base_mask
+    return out
+
+
+def _cap_and(out: np.ndarray, demand: np.ndarray, cap: np.ndarray) -> None:
+    """AND the per-pod capacity check into ``out`` IN PLACE: one broadcast
+    pass of demand[G, R] against cap[N, R], chunked so the [g, N, R]
+    intermediate stays bounded (~8M elements per block)."""
+    G = out.shape[0]
+    N, R = cap.shape[0], cap.shape[1] if cap.ndim == 2 else 1
+    if not G or not N:
+        return
+    step = max(1, (8 << 20) // max(N * max(R, 1), 1))
+    for lo in range(0, G, step):
+        hi = min(G, lo + step)
+        out[lo:hi] &= ~np.any(
+            demand[lo:hi, None, :] > cap[None, :, :] + 1e-9, axis=2
+        )
+
+
+def _compat_rows(
+    groups: Sequence[PodGroup],
+    opt_table: "_ReqTable",
+    taint_index: Dict[tuple, np.ndarray],
+    alloc: np.ndarray,
+    demand: np.ndarray,
+) -> np.ndarray:
+    """PRE-weight-gate compatibility of EVERY group against every option,
+    built columnar (PR 14): ``_class_rows`` for tolerations + term classes,
+    ``_cap_and`` for the chunked capacity plane."""
+    compat = _class_rows(groups, opt_table, taint_index, alloc.shape[0])
+    _cap_and(compat, demand, alloc)
+    return compat
+
+
 def _apply_weight_gate(
     groups: Sequence[PodGroup],
     options: Sequence[LaunchOption],
@@ -1120,9 +1205,8 @@ def _existing_arrays(
     G, E, R = len(groups), len(existing), len(axes)
     ex_rem = np.zeros((E, R), dtype=np.float64)
     ex_zone = np.zeros((E,), dtype=np.int32)
-    ex_compat = np.zeros((G, E), dtype=bool)
     if not E:
-        return ex_rem, ex_zone, ex_compat
+        return ex_rem, ex_zone, np.zeros((G, E), dtype=bool)
     axes_t = tuple(axes)
     for k, e in enumerate(existing):
         # remaining-vector memo on the ExistingNode: a consolidation sweep
@@ -1143,15 +1227,12 @@ def _existing_arrays(
     ex_taint_groups: Dict[tuple, list] = {}
     for k, taints in enumerate(eff_taints):
         ex_taint_groups.setdefault(taints, []).append(k)
-    for i, g in enumerate(groups):
-        tol_ok = np.zeros(E, bool)
-        tols = list(g.tolerations)
-        for taints, idx in ex_taint_groups.items():
-            if tolerates_all(tols, taints):
-                tol_ok[np.asarray(idx)] = True
-        req_ok = ex_table.eval_terms(g.terms)
-        cap_ok = ~np.any(demand[i][None, :] > ex_rem + 1e-9, axis=1)
-        ex_compat[i] = schedulable & tol_ok & req_ok & cap_ok
+    # columnar build (PR 14): the same _class_rows/_cap_and passes the
+    # option plane uses, with node schedulability as the base mask
+    ex_compat = _class_rows(
+        groups, ex_table, ex_taint_groups, E, base_mask=schedulable
+    )
+    _cap_and(ex_compat, demand, ex_rem)
     return ex_rem, ex_zone, ex_compat
 
 
@@ -1251,14 +1332,10 @@ def encode(
         )
         alloc, price, opt_zone = _option_arrays(options, axes, zone_index)
 
-        # -- compat masks, vectorized over the option/node axis --------------
+        # -- compat masks, columnar over BOTH axes (PR 14) -------------------
         opt_table = _get_option_table(options)
         taint_index = _taint_index(options)
-        G, O = len(groups), len(options)
-        compat = np.zeros((G, O), dtype=bool)
-        if O:
-            for i, g in enumerate(groups):
-                compat[i] = _compat_row(g, opt_table, taint_index, alloc, axes)
+        compat = _compat_rows(groups, opt_table, taint_index, alloc, demand)
 
         ex_rem, ex_zone, ex_compat = _existing_arrays(
             groups, existing, provisioners, zone_index, axes, demand

@@ -737,10 +737,13 @@ def _get_jit(donate: bool, fleet: bool = False):
     """The jit wrapper an AOT lowering goes through. The donating variant
     hands the problem tensors' device buffers to XLA for reuse — a cold
     one-shot dispatch then skips the output-allocation copy; callers must
-    treat the staged inputs as consumed (the solver drops its device-cache
-    entry after a donated dispatch). Fleet buckets route to the vmapped
-    multi-problem program; they never donate (the staging stacks fresh
-    host arrays per round and the batch is dispatched exactly once)."""
+    pass buffers they own outright (the solver dispatches DEVICE-SIDE
+    CLONES of the DeviceStager's resident master, never the master
+    itself). Fleet buckets route to the vmapped multi-problem program;
+    they MUST stay donate-free: a fleet dispatch is fed the stager's live
+    resident tensors (host-stacked or d2d-stacked masters), which a
+    donating executable would consume out from under the next round's
+    stage()."""
     global _DONATING_JIT
     if fleet:
         return pack_solve_fleet

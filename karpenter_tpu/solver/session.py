@@ -52,6 +52,7 @@ from .encode import (
     ExistingNode,
     PodGroup,
     _compat_row,
+    _compat_rows,
     _existing_arrays,
     _finalize,
     _get_option_table,
@@ -411,10 +412,7 @@ class EncodeSession:
         opt_table = _get_option_table(options)
         taint_index = _taint_index(options)
         G, O = len(groups), len(options)
-        compat = np.zeros((G, O), dtype=bool)
-        if O:
-            for i, g in enumerate(groups):
-                compat[i] = _compat_row(g, opt_table, taint_index, alloc, axes)
+        compat = _compat_rows(groups, opt_table, taint_index, alloc, demand)
         ex_rem, ex_zone, ex_compat = _existing_arrays(
             groups, existing, provisioners, zone_index, axes, demand
         )

@@ -342,9 +342,10 @@ SOLVE_DURATION = Histogram(
 )
 SOLVE_PHASE = Histogram(
     "karpenter_tpu_solve_phase_seconds",
-    help="Solver phase latency (encode/presolve/solve/decode), labeled by "
-         "phase and by the round's encode mode (delta/full) — the continuous "
-         "view of the incremental-encode win.",
+    help="Solver phase latency (encode/presolve/stage/solve/decode), "
+         "labeled by phase and by the round's encode mode (delta/full) — "
+         "the continuous view of the incremental-encode win; {phase=stage} "
+         "separates host-to-device staging from encode and solve.",
     registry=REGISTRY,
 )
 RECONCILE_DURATION = Histogram(
@@ -438,6 +439,18 @@ AOT_CACHE_EVENTS = Counter(
          "served by a resident bucket executable), miss (bucket not "
          "resident), compile (an executable was built — or loaded from the "
          "on-disk compilation cache), evict (LRU capacity eviction).",
+    registry=REGISTRY,
+)
+# delta-aware device staging (solver/staging.py DeviceStager): problem
+# tensors kept resident on device across rounds — the cold-solve data
+# movement layer
+DEVICE_STAGING = Counter(
+    "karpenter_tpu_device_staging_total",
+    help="Device staging-cache events, labeled by event: hit (a problem "
+         "tensor served from device residency, zero transfer), restage (a "
+         "leaf patched by scatter-updating only its churned rows), evict "
+         "(capacity eviction), invalidate (residency dropped: bucket "
+         "growth, shape/axes change, settings flip).",
     registry=REGISTRY,
 )
 # incremental reconcile encoding (solver/session.py EncodeSession)

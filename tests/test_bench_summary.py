@@ -42,6 +42,13 @@ def test_dry_run_last_stdout_line_is_json_summary():
     assert "kernel_cold_ms" in summary
     assert "kernel_warm_ms" in summary
     assert "aot_cache_hits" in summary
+    # the ISSUE-14 cold-path-split + staging fields ride the summary; the
+    # staging scenario RUNS in dry-run (it spawns no processes), so its
+    # verdict fields are concrete, not null
+    assert "cold_stage_ms" in summary
+    assert "staging_hit_rate" in summary
+    assert summary["staging_restage_matches_churn"] is True
+    assert summary["staging_delta_hit_rate"] is not None
     # the ISSUE-11 soak fields ride the summary (null in dry-run: the soak
     # spawns operator processes and only the slow gate runs it for real)
     for key in ("soak_events_per_s", "soak_invariant_violations",

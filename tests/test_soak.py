@@ -187,6 +187,36 @@ class TestInvariantMonitor:
         assert report["ok"] and report["violations"] == []
 
 
+class TestBusyBoxProbe:
+    """The bench soak arm's pre-flight contention probe (ISSUE 14): a
+    loaded box must degrade the arm to an EXPLICIT skip with a reason —
+    never a false invariant failure — and the skip shape must carry the
+    gate-facing fields as nulls so the summary line stays parseable."""
+
+    def test_busy_box_degrades_to_explicit_skip(self, monkeypatch):
+        import bench
+
+        monkeypatch.setattr(
+            bench, "_box_busy_probe", lambda **kw: "synthetic: box busy"
+        )
+        out = bench.bench_soak(duration_s=1.0)
+        assert out["skipped_busy_box"] is True
+        assert "busy" in out["reason"]
+        assert out["invariant_violations"] == 0
+        assert out["events_per_s"] is None
+
+    def test_probe_decided_by_spin_arm_not_loadavg(self, monkeypatch):
+        """Load average is context, not the decider: a decaying loadavg
+        from a just-finished run (idle box, spin clean) must NOT skip the
+        soak — only active time-slicing does."""
+        import os
+
+        import bench
+
+        monkeypatch.setattr(os, "getloadavg", lambda: (99.0, 99.0, 99.0))
+        assert bench._box_busy_probe(spin_ratio=1e9) is None
+
+
 # ---------------------------------------------------------------------------
 # Watch-intake backpressure (HTTPCluster bounded queue)
 # ---------------------------------------------------------------------------
