@@ -11,6 +11,7 @@ All five BASELINE configs run; per-config results land in the ``details`` field.
 
 from __future__ import annotations
 
+import itertools
 import json
 import statistics
 import sys
@@ -2256,6 +2257,184 @@ def bench_spot_churn(n_pods=240, waves=3, replace_budget=2, n_types=20):
     }
 
 
+def bench_device_faults(n_pods=20_000, storm_rounds=6, overhead_repeats=8,
+                        n_types=60):
+    """Solver fault-domain scenario (ISSUE 15): a scripted device-fault
+    storm — garbage/NaN kernel plans, dispatch hangs, device OOM, staging
+    corruption, compile failures — against full provisioning rounds at
+    ``n_pods``, plus the clean-path validator-overhead guard.
+
+    Invariants this scenario pins (gated in hack/check_bench_regression.py):
+
+    * every storm round COMPLETES via host fallback (all pods bound);
+    * ZERO invalid bindings — every bind re-audited post-round against node
+      allocatable/taints/labels, independently of the firewall;
+    * the kernel breaker trips during the storm and RE-CLOSES after the
+      faults clear (quarantine-evict → half-open re-compile probe → closed);
+    * validation-firewall overhead on the clean path stays < 5% of round
+      p50 (ABBA on solver_validation_enabled, no faults active).
+    """
+    import statistics as _st
+
+    from karpenter_tpu.api import ObjectMeta, Pod, Provisioner, Resources
+    from karpenter_tpu.api.requirements import Requirements
+    from karpenter_tpu.api.settings import Settings
+    from karpenter_tpu.api.taints import tolerates_all
+    from karpenter_tpu.cloudprovider import FakeCloudProvider, generate_catalog
+    from karpenter_tpu.controllers.provisioning import ProvisioningController
+    from karpenter_tpu.solver.solver import KERNEL_BOARD, TPUSolver
+    from karpenter_tpu.state import Cluster
+    from karpenter_tpu.utils import faults
+
+    catalog = generate_catalog(n_types=n_types)
+    seq = itertools.count()
+
+    def one_round(validation_on=True):
+        """A fresh cluster + controller, one full reconcile of ``n_pods``
+        identically-shaped pods. Fresh per round so bind accumulation can't
+        skew the ABBA comparison; the AOT executable cache (and the kernel
+        breaker board) are process-global, so the race path stays warm."""
+        cluster = Cluster()
+        provider = FakeCloudProvider(catalog=catalog)
+        solver = TPUSolver(dispatch_timeout_s=0.5)
+        solver._race_retry_interval_s = 0.2
+        controller = ProvisioningController(
+            cluster, provider, solver=solver,
+            settings=Settings(
+                batch_idle_duration=0, batch_max_duration=0,
+                solver_validation_enabled=validation_on,
+            ),
+        )
+        cluster.add_provisioner(Provisioner(meta=ObjectMeta(name="default")))
+        tag = next(seq)
+        for i in range(n_pods):
+            cluster.add_pod(
+                Pod(meta=ObjectMeta(name=f"df{tag}-{i}", owner_kind="ReplicaSet"),
+                    requests=Resources(cpu="250m", memory="512Mi"))
+            )
+        t0 = time.perf_counter()
+        result = controller.reconcile()
+        return cluster, controller, result, time.perf_counter() - t0
+
+    def audit_invalid_bindings(cluster, result) -> int:
+        """Independent post-bind audit: re-derive every bound node's load
+        from CLUSTER STATE and check allocatable/taints/label surface —
+        the scenario's own oracle, sharing no code path with the firewall."""
+        bad = 0
+        by_node = {}
+        for pod in cluster.pods.values():
+            if pod.node_name is not None:
+                by_node.setdefault(pod.node_name, []).append(pod)
+        for node_name, pods in by_node.items():
+            node = cluster.nodes.get(node_name)
+            if node is None:
+                bad += len(pods)
+                continue
+            total = Resources(pods=len(pods))
+            surface = Requirements.from_labels(node.meta.labels)
+            for pod in pods:
+                total = total + pod.requests
+                if not tolerates_all(list(pod.tolerations), tuple(node.taints)):
+                    bad += 1
+                elif not any(
+                    surface.compatible(t)
+                    for t in pod.scheduling_requirement_terms()
+                ):
+                    bad += 1
+            if not total.fits(node.allocatable):
+                bad += 1
+        return bad
+
+    prev_threshold = KERNEL_BOARD.failure_threshold
+    prev_recovery = KERNEL_BOARD.recovery_timeout_s
+    KERNEL_BOARD.configure(failure_threshold=3, recovery_timeout_s=1.0)
+    faults.install_device_faults(None)
+    report = {}
+    try:
+        # -- warm lane: resident bucket executable + RTT probe -------------
+        one_round()
+        from karpenter_tpu.solver.jax_solver import AOT_CACHE
+
+        AOT_CACHE.wait_idle(60)
+        one_round()  # dispatches warm; records the bucket EWMA
+
+        # -- fault storm ----------------------------------------------------
+        storm_kinds = [
+            "garbage-result", "nan-result", "garbage-result",
+            "dispatch-hang", "device-oom", "staging-corruption",
+        ]
+        completed = invalid = 0
+        storm_times = []
+        fired = 0
+        tripped = False
+        for r in range(storm_rounds):
+            plan = faults.DeviceFaultPlan()
+            kind = storm_kinds[r % len(storm_kinds)]
+            if kind == "dispatch-hang":
+                plan.dispatch_hang(seconds=5.0, n=1)
+            else:
+                plan.script([faults.DeviceFault(kind=kind)])
+            faults.install_device_faults(plan)
+            cluster, _, result, dt = one_round()
+            faults.install_device_faults(None)
+            fired += len(plan.log)
+            storm_times.append(dt)
+            if len(result.bound) == n_pods and not result.unschedulable:
+                completed += 1
+            invalid += audit_invalid_bindings(cluster, result)
+            if any(s != "closed" for s in KERNEL_BOARD.states().values()):
+                tripped = True
+
+        # -- recovery: faults cleared, breaker must re-close ---------------
+        reclosed = KERNEL_BOARD.health() == 1.0
+        for _ in range(10):
+            if reclosed:
+                break
+            time.sleep(0.3)  # past the 1.0s recovery timeout + warm compile
+            AOT_CACHE.wait_idle(60)
+            one_round()
+            reclosed = KERNEL_BOARD.health() == 1.0
+
+        # -- clean-path validator overhead (no faults) ----------------------
+        # gated on the DIRECT measurement — the firewall's own evaluation
+        # wall time as a share of its round — because an ABBA differential
+        # at realistic round times is noise-dominated (run-to-run drift of
+        # a full reconcile dwarfs a ~1ms validation); the ABBA p50s stay in
+        # the report as the sanity reference.
+        on_times, off_times, shares = [], [], []
+        for flip in (True, False, False, True) * max(1, overhead_repeats // 4):
+            _, controller, _, dt = one_round(validation_on=flip)
+            (on_times if flip else off_times).append(dt)
+            if flip and dt > 0:
+                shares.append(100.0 * controller._fw_eval_s / dt)
+        on_p50, off_p50 = _st.median(on_times), _st.median(off_times)
+        overhead_pct = _st.median(shares) if shares else 0.0
+        report = {
+            "pods": n_pods,
+            "storm_rounds": storm_rounds,
+            "faults_fired": fired,
+            "rounds_completed": completed,
+            "invalid_bindings": invalid,
+            "fallback_p50_ms": round(_st.median(storm_times) * 1e3, 3),
+            "breaker_tripped": tripped,
+            "breaker_reclosed": bool(reclosed),
+            "round_p50_ms_validation_on": round(on_p50 * 1e3, 3),
+            "round_p50_ms_validation_off": round(off_p50 * 1e3, 3),
+            "validator_overhead_pct": round(overhead_pct, 2),
+            "validator_within_budget": bool(overhead_pct < 5.0),
+        }
+    finally:
+        faults.install_device_faults(None)
+        # restore the PRIOR thresholds (configure() without args would keep
+        # this scenario's 1.0s recovery and silently speed up every later
+        # scenario's breaker), with a fresh clean board either way
+        KERNEL_BOARD.configure(
+            failure_threshold=prev_threshold,
+            recovery_timeout_s=prev_recovery,
+        )
+    return report
+
+
 def bench_decision_overhead(repeats=10, n_pods=300):
     """Decision-audit + trace-propagation overhead guard: a full provisioning
     round (solve + launch + bind) with the decision ring recording vs.
@@ -2692,6 +2871,12 @@ def _run_details(dry_run: bool = False) -> dict:
             )
         except Exception as e:
             details["device_staging"] = {"error": f"{type(e).__name__}: {e}"}
+        try:
+            details["device_faults"] = bench_device_faults(
+                n_pods=600, storm_rounds=3, overhead_repeats=4, n_types=8
+            )
+        except Exception as e:
+            details["device_faults"] = {"error": f"{type(e).__name__}: {e}"}
         # the soak spawns (and kills) real operator processes — minutes, not
         # seconds: dry-run keeps the summary-line CONTRACT (the soak_* keys
         # appear, null) without running it; the slow gate runs the real thing
@@ -2719,6 +2904,9 @@ def _run_details(dry_run: bool = False) -> dict:
         ("gang_preemption", bench_gang_preemption),
         ("gang_topology", bench_gang_topology),
         ("spot_churn", bench_spot_churn),
+        # solver fault domain (ISSUE 15): scripted device-fault storm +
+        # validator-overhead guard
+        ("device_faults", bench_device_faults),
         # the 500k synthetic: sharded rounds only (a flat 500k solve per
         # round is the O(cluster) cost the cells exist to escape), with a
         # 50k flat reference cluster timed for the acceptance comparison
@@ -2815,6 +3003,7 @@ def main(argv=None):
     race_topo = details.get("kernel_race_topology", {})
     aot = details.get("aot_cache") or {}
     soak = details.get("soak", {})
+    devfault = details.get("device_faults", {})
     dev_n, cpu_n = _device_counts()
     summary = {
         "metric": line["metric"],
@@ -2855,6 +3044,18 @@ def main(argv=None):
         "gangtopo_preempt_replay_match": gangtopo.get("preempt_replay_match"),
         "gangtopo_gang_moves_whole": gangtopo.get("gang_moves_whole"),
         "gangtopo_gang_move_savings": gangtopo.get("gang_move_savings"),
+        # solver fault domain (ISSUE 15): scripted device-fault storm —
+        # every round must complete via host fallback with zero invalid
+        # bindings, the kernel breaker must re-close after the faults
+        # clear, and the clean-path firewall overhead must stay < 5%
+        "devfault_rounds_completed": devfault.get("rounds_completed"),
+        "devfault_rounds_total": devfault.get("storm_rounds"),
+        "devfault_invalid_bindings": devfault.get("invalid_bindings"),
+        "devfault_fallback_p50_ms": devfault.get("fallback_p50_ms"),
+        "devfault_breaker_reclosed": devfault.get("breaker_reclosed"),
+        "devfault_validator_overhead_pct": devfault.get(
+            "validator_overhead_pct"
+        ),
         # spot-churn robustness (ISSUE 7): the trajectory JSON tracks
         # correctness-under-reclamation, not just latency
         "spot_reclaims_survived": spot.get("reclaims_survived"),

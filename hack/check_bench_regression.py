@@ -43,6 +43,12 @@ floor:
   zero-partial invariant intact; at least one consolidation action must
   move a gang WHOLE, and the scripted preempt-or-launch round must choose
   eviction AND replay byte-identically from its capsule.
+* ``device_faults`` (ISSUE 15): a scripted device-fault storm (garbage/NaN
+  kernel plans, dispatch hangs, device OOM, staging corruption) must leave
+  ZERO invalid bindings, every storm round must complete via host fallback,
+  the kernel breaker must trip AND re-close after the faults clear
+  (quarantine-evict → half-open re-compile probe), and the validation
+  firewall's clean-path overhead must stay < 5% of round p50.
 * ``soak`` (ISSUE 11): the scaled chaos soak (sustained churn over the
   real-HTTP stack incl. one operator SIGKILL+restart and one apiserver
   restart) must finish with ZERO invariant violations — which covers the
@@ -152,6 +158,9 @@ def run_checks(full: bool = False) -> list:
         n_pods=20_000, n_cells=8, rounds=8, n_types=30, flat_compare=False
     )
     staging = bench.bench_device_staging()
+    devfault = bench.bench_device_faults(
+        n_pods=20_000 if full else 2_000, n_types=30
+    )
     gangtopo = bench.bench_gang_topology()
     race = bench.bench_kernel_race()
     race_topo = bench.bench_kernel_race_topology()
@@ -168,7 +177,7 @@ def run_checks(full: bool = False) -> list:
         "delta_reconcile": delta, "consolidation_sweep": sweep,
         "spot_churn": churn, "cell_decompose": cells,
         "cell_fleet": cells_fleet, "gang_topology": gangtopo,
-        "device_staging": staging,
+        "device_staging": staging, "device_faults": devfault,
         "cold_solve": cold, "kernel_race": race,
         "kernel_race_topology": race_topo,
         "kernel_race_topology_50k": race_topo_50k,
@@ -407,6 +416,40 @@ def run_checks(full: bool = False) -> list:
             failures.append(
                 f"{label} produced {r.get('violations')} constraint violations"
             )
+    # -- device-faults gate (ISSUE 15) ---------------------------------------
+    if devfault.get("invalid_bindings", 1) != 0:
+        failures.append(
+            f"device_faults: {devfault.get('invalid_bindings')} INVALID "
+            "bindings reached cluster state under the fault storm (the "
+            "validation firewall's zero-invalid-bindings contract broke)"
+        )
+    if devfault.get("rounds_completed", 0) < devfault.get("storm_rounds", 1):
+        failures.append(
+            f"device_faults: only {devfault.get('rounds_completed')}/"
+            f"{devfault.get('storm_rounds')} storm rounds completed via "
+            "host fallback (a device fault failed a round)"
+        )
+    if devfault.get("breaker_reclosed") is not True:
+        failures.append(
+            "device_faults: the kernel breaker did not re-close after the "
+            "faults cleared (half-open re-compile probe regressed)"
+        )
+    if devfault.get("breaker_tripped") is not True:
+        failures.append(
+            "device_faults: the storm never tripped the kernel breaker — "
+            "the scenario regressed, the gate is vacuous"
+        )
+    if devfault.get("faults_fired", 0) < 3:
+        failures.append(
+            f"device_faults: only {devfault.get('faults_fired')} scripted "
+            "faults actually fired — the injection seams regressed"
+        )
+    vo = devfault.get("validator_overhead_pct")
+    if vo is None or vo >= 5.0:
+        failures.append(
+            f"device_faults: validation-firewall clean-path overhead {vo}% "
+            ">= the 5% budget of round p50"
+        )
     # -- chaos soak gate (ISSUE 11) ------------------------------------------
     if soak.get("skipped_busy_box"):
         # the PR 12 contention note, made explicit (ISSUE 14): a box already

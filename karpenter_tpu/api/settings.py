@@ -185,6 +185,30 @@ class Settings:
     # leave off when the workload re-solves identical problems through the
     # device path (race memory usually absorbs those either way).
     aot_donate_inputs: bool = False
+    # placement validation firewall (solver/validate.py validate_bind_plan):
+    # every solver plan — whatever backend produced it — is re-checked
+    # against cluster-level hard constraints (resource fit incl. daemonset
+    # overhead, requirements/taints, gang atomicity, slice-adjacency pins,
+    # spot-diversification caps) before any bind; an invalid plan is
+    # rejected with per-violation DecisionRecords and the round re-solves
+    # on the fallback backend. Off trusts the backends (the pre-fault-domain
+    # behavior); the clean-path overhead is gated < 5% of round p50.
+    solver_validation_enabled: bool = True
+    # hard deadline on a synchronous kernel dispatch fetch: a hung device
+    # answer raises after this long and the host fallback completes the
+    # round instead of blocking it. 0 disables the deadline.
+    kernel_dispatch_timeout_s: float = 2.0
+    # consecutive device-path failures (invalid/non-finite plans, dispatch
+    # timeouts, compile errors) before an executable bucket's kernel
+    # breaker opens — the suspect executable is evicted (quarantine) and
+    # solves degrade to host-lp/greedy until the half-open re-compile probe
+    # proves the backend healthy again.
+    kernel_breaker_failure_threshold: int = 3
+    # scripted device-fault timeline (utils/faults.py DeviceFaultPlan.parse
+    # wire format: "t=SECONDS,kind=KIND[,n=N][,hang=S];...") installed at
+    # operator boot — the chaos soak's device-path fault storms. Empty (the
+    # production state) installs nothing.
+    device_fault_script: str = ""
     # leader election (utils/leaderelection.py): when enabled the operator
     # blocks on the lease before running reconcile loops and releases it on
     # clean shutdown, so a standby replica takes over within the lease TTL.
@@ -274,6 +298,16 @@ class Settings:
             raise ValueError("aotCacheCapacity must be >= 1")
         if self.device_staging_capacity_mb < 1:
             raise ValueError("deviceStagingCapacityMb must be >= 1")
+        if self.kernel_dispatch_timeout_s < 0:
+            raise ValueError(
+                "kernelDispatchTimeoutS must be >= 0 (0 disables the deadline)"
+            )
+        if self.kernel_breaker_failure_threshold < 1:
+            raise ValueError("kernelBreakerFailureThreshold must be >= 1")
+        if self.device_fault_script:
+            from ..utils.faults import DeviceFaultPlan
+
+            DeviceFaultPlan.parse(self.device_fault_script)  # loud on malformed
         if self.leader_election_enabled and not self.leader_election_lease_path:
             raise ValueError(
                 "leaderElectionLeasePath is required when leader election is enabled"

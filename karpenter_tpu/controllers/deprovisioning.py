@@ -118,6 +118,7 @@ class DeprovisioningController:
                 quality_sync=False,
                 device_staging=self.solver._stager.enabled,
                 staging_capacity_mb=self.solver._stager.capacity_bytes >> 20,
+                dispatch_timeout_s=self.solver.dispatch_timeout_s,
             )
             self.quality_solver.risk_penalty = self.solver.risk_penalty
         # sweep solves attributed by winning backend (observability for the
@@ -486,6 +487,7 @@ class DeprovisioningController:
                 quality_sync=s.quality_sync,
                 device_staging=s._stager.enabled,
                 staging_capacity_mb=s._stager.capacity_bytes >> 20,
+                dispatch_timeout_s=s.dispatch_timeout_s,
             )
         elif isinstance(s, GreedySolver):
             clone = GreedySolver()

@@ -30,11 +30,16 @@ from ..cloudprovider.types import InstanceType
 from ..solver import diversify
 from ..solver import gang as gangmod
 from ..solver import topology
+from ..solver.validate import (
+    PlanViolation,
+    scripted_next as fw_scripted_next,
+    validate_bind_plan,
+)
 from ..solver.encode import ExistingNode
 from ..solver.gang import Gang
 from ..solver.result import NewNodeSpec, SolveResult
 from ..solver.session import EncodeSession
-from ..solver.solver import Solver, TPUSolver
+from ..solver.solver import GreedySolver, Solver, TPUSolver
 from ..state.cluster import Cluster
 from ..utils import metrics
 from ..utils.decisions import DECISIONS
@@ -143,6 +148,11 @@ class ProvisioningResult:
     # or no atomic placement) — deliberately NOT in ``unschedulable``, which
     # carries per-pod infeasibility; gangs wait by design
     gang_deferred: List[str] = field(default_factory=list)
+    # placement-validation firewall events, one per evaluation this round
+    # (verdict accepted/rejected/rejected-final, backend, violations) —
+    # captured into flight-recorder capsules and compared by replay, so a
+    # backend-degraded round reproduces including the fallback decision
+    validation_events: List[Dict] = field(default_factory=list)
 
 
 @dataclass
@@ -228,6 +238,16 @@ class ProvisioningController:
         # count as a single wait
         self._gang_wait: Dict[str, int] = {}
         self._gang_wait_ticked: set = set()
+        # placement-validation firewall state: the per-reconcile event list
+        # (shared by reference with the round's ProvisioningResult), the
+        # fallback backend a rejected plan re-solves on, and the identity of
+        # the last plan the backend-level check accepted (the pre-bind check
+        # skips re-validating an object it already cleared — the clean path
+        # pays ONE validation per round, the <5%-overhead budget)
+        self._fw_events: List[Dict] = []
+        self._fw_fallback: Optional[GreedySolver] = None
+        self._fw_clean: Optional[SolveResult] = None
+        self._fw_eval_s: float = 0.0
         self.preemption = PreemptionPlanner(cluster, self.solver, self.recorder)
         # victim-gang restart boost (thrash budget): gang name -> reconciles
         # of +1-tier protection left. Set when a plan evicts a gang whole,
@@ -345,7 +365,14 @@ class ProvisioningController:
         t0 = time.perf_counter()
         batch_gen = self.batcher.generation
         pods = self.cluster.pending_pods()
-        result = ProvisioningResult(machines=[], nodes=[], bound={}, unschedulable=[])
+        self._fw_events = []
+        self._fw_clean = None
+        self._fw_eval_s = 0.0
+        result = ProvisioningResult(
+            machines=[], nodes=[], bound={}, unschedulable=[],
+            # shared by reference: firewall evaluations below append here
+            validation_events=self._fw_events,
+        )
         if not pods:
             self.batcher.reset(upto_generation=batch_gen)
             return result
@@ -553,6 +580,20 @@ class ProvisioningController:
                     solve = div.solve
                     div_masked |= div.mask
                     div_stripped = True
+            # placement validation firewall, pre-bind layer: the GATED plan
+            # (gang gate, preempt-or-launch, diversification strips applied)
+            # is the one about to bind — re-verify the post-gate invariants
+            # (gang atomicity, slice-adjacency pins, diversification caps)
+            # plus, for any object the backend layer did not already clear,
+            # the full fit checks. A violation here binds NOTHING: zero
+            # invalid bindings is the contract, a wasted round the cost.
+            solve = self._prebind_firewall(
+                solve, batch, round_provs, round_existing, daemonsets,
+                gangs, div_units,
+                check_div=(
+                    div_retries < self._DIVERSIFY_RETRIES and not div_fallback
+                ),
+            )
             limit_hit, ice_failed = self._apply_solve(solve, result, round_provs)
             retry_ice = bool(ice_failed) and ice_retries < self._ICE_RETRIES
             if retry_ice:
@@ -638,6 +679,14 @@ class ProvisioningController:
                 reason=unsched_reason.get(name, "no feasible instance offering"),
                 value=float(len(result.unschedulable)) if i == 0 else 0.0,
             )
+        if cap is not None and any(
+            e["verdict"] != "accepted" for e in self._fw_events
+        ):
+            # a rejected plan is exactly the forensic moment the flight
+            # recorder exists for: auto-dump the capsule
+            from ..utils.flightrecorder import TRIGGER_VALIDATION
+
+            cap.note_anomaly(TRIGGER_VALIDATION)
         metrics.PODS_UNSCHEDULABLE.set(float(len(result.unschedulable)))
         metrics.PROVISIONING_DURATION.observe(time.perf_counter() - t0)
         self.batcher.reset(upto_generation=batch_gen)
@@ -677,6 +726,225 @@ class ProvisioningController:
                             return True
         return False
 
+    # -- placement validation firewall (solver fault domain, layer 1) -------
+    @staticmethod
+    def _backend_name(solve: SolveResult) -> str:
+        stats = solve.stats or {}
+        if stats.get("fallback"):
+            return "greedy"
+        # backend stamp values: 0=greedy oracle, 1=kernel, 2=host LP/topo,
+        # 3=host FFD (see the solver backends' stats contracts)
+        code = stats.get("backend")
+        if code == 1.0:
+            return "kernel"
+        if code == 0.0:
+            return "greedy"
+        return "host"
+
+    def _firewall_eval(
+        self, solve, batch, round_provs, round_existing, daemonsets,
+        *, check_fit: bool = True, gangs=None, div_units=(), check_div=False,
+    ) -> List[PlanViolation]:
+        """One firewall evaluation: the recorded verdict when a replay
+        script is active (transient device faults cannot be recomputed
+        offline — the capsule's decision IS the input), the real
+        cluster-level re-check otherwise. Overhead lands in
+        solve_phase_seconds{phase="validate"}."""
+        scripted = fw_scripted_next()
+        if scripted is not None:
+            if scripted.get("verdict") == "accepted":
+                return []
+            return [
+                PlanViolation(
+                    code=v.get("code", ""), detail=v.get("detail", ""),
+                    pod=v.get("pod", ""), node=v.get("node", ""),
+                )
+                for v in scripted.get("violations", [])
+            ]
+        t0 = time.perf_counter()
+        violations = validate_bind_plan(
+            solve,
+            batch=batch,
+            round_provs=round_provs,
+            round_existing=round_existing,
+            daemonsets=daemonsets,
+            cluster=self.cluster,
+            gangs=gangs,
+            check_gangs=bool(gangs),
+            slice_topology=self.settings.slice_topology_enabled,
+            div_units=div_units,
+            check_diversification=check_div,
+            check_fit=check_fit,
+        )
+        spent = time.perf_counter() - t0
+        self._fw_eval_s += spent
+        metrics.SOLVE_PHASE.observe(spent, {"phase": "validate", "mode": "full"})
+        return violations
+
+    def _note_fw_event(
+        self, verdict: str, backend: str, violations, fallback: str = "",
+    ) -> None:
+        event: Dict = {
+            "round": len(self._fw_events), "verdict": verdict,
+            "backend": backend,
+        }
+        if violations:
+            event["violations"] = [v.to_dict() for v in violations]
+        if fallback:
+            event["fallback"] = fallback
+        self._fw_events.append(event)
+        metrics.SOLVER_VALIDATION.inc({"outcome": verdict})
+        for i, v in enumerate(violations):
+            metrics.VALIDATION_VIOLATIONS.inc({"code": v.code})
+            DECISIONS.record(
+                "validation", "rejected", pod=v.pod, node=v.node,
+                reason=f"{v.code}: {v.detail}", details=v.to_dict(),
+                value=float(len(violations)) if i == 0 else 0.0,
+            )
+
+    def _backend_firewall(
+        self, solve, batch, round_provs, round_existing, daemonsets, cap,
+    ) -> SolveResult:
+        """Reject a backend answer that violates hard constraints and
+        re-solve the round on the fallback backend (greedy oracle); a
+        kernel-produced invalid plan also indicts its executable bucket on
+        the kernel breaker. Both backends invalid → the round binds nothing
+        (pods stay pending; next reconcile runs against a quarantined
+        kernel, so the host paths answer)."""
+        if not self.settings.solver_validation_enabled:
+            return solve
+        backend = self._backend_name(solve)
+        violations = self._firewall_eval(
+            solve, batch, round_provs, round_existing, daemonsets
+        )
+        if not violations:
+            self._note_fw_event("accepted", backend, [])
+            # a STRONG reference, never a bare id(): the gates may drop
+            # the accepted object, and a recycled id on its replacement
+            # would falsely skip the pre-bind fit checks
+            self._fw_clean = solve
+            return solve
+        bucket = (solve.stats or {}).get("aot_bucket")
+        if backend == "kernel" and isinstance(bucket, str):
+            # plausible-but-invalid kernel plan that slipped past the
+            # count-level validator: quarantine the executable bucket
+            from ..solver.solver import KERNEL_BOARD
+
+            KERNEL_BOARD.fail(bucket, "invalid-plan")
+        self._note_fw_event("rejected", backend, violations, fallback="greedy")
+        self.recorder.publish(
+            "PlanRejected",
+            f"{backend} plan rejected by the validation firewall "
+            f"({len(violations)} violations); re-solving on greedy",
+            type="Warning",
+        )
+        fb = self._fw_fallback
+        if fb is None:
+            fb = self._fw_fallback = GreedySolver()
+        fb.risk_penalty = getattr(self.solver, "risk_penalty", 0.0)
+        solve2 = fb.solve_pods(
+            batch, round_provs, existing=round_existing, daemonsets=daemonsets
+        )
+        if cap is not None:
+            cap.add_digest(solve2.problem_digest, stats=solve2.stats)
+        violations2 = self._firewall_eval(
+            solve2, batch, round_provs, round_existing, daemonsets
+        )
+        if violations2:
+            self._note_fw_event("rejected-final", "greedy", violations2)
+            self.recorder.publish(
+                "PlanRejected",
+                "fallback plan rejected too — binding nothing this round",
+                type="Warning",
+            )
+            return SolveResult(
+                unschedulable=[p.name for p in batch],
+                stats={"validation_rejected": 1.0},
+            )
+        self._note_fw_event("accepted", "greedy", [])
+        self._fw_clean = solve2
+        solve2.stats["validation_fallback"] = 1.0
+        return solve2
+
+    def _prebind_firewall(
+        self, solve, batch, round_provs, round_existing, daemonsets,
+        gangs, div_units, check_div: bool,
+    ) -> SolveResult:
+        """Last fence before ``_apply_solve`` binds: the gates only STRIP
+        placements, so an object the backend layer cleared needs only the
+        post-gate invariants (gang atomicity, slice-adjacency pins,
+        diversification caps) re-verified; a swapped/rebuilt object gets the
+        full fit checks too. Any violation refuses the bind wholesale —
+        an invalid binding must never reach cluster state."""
+        if not self.settings.solver_validation_enabled:
+            return solve
+        check_fit = solve is not self._fw_clean
+        if not check_fit and not gangs and not div_units:
+            return solve  # already cleared; nothing post-gate to verify
+        violations = self._firewall_eval(
+            solve, batch, round_provs, round_existing, daemonsets,
+            check_fit=check_fit, gangs=gangs, div_units=div_units,
+            check_div=check_div,
+        )
+        if not violations:
+            self._note_fw_event("accepted", "gated", [])
+            return solve
+        self._note_fw_event("rejected-final", "gated", violations)
+        self.recorder.publish(
+            "PlanRejected",
+            f"gated plan rejected pre-bind ({len(violations)} violations); "
+            "binding nothing this round",
+            type="Warning",
+        )
+        names = {n for spec in solve.new_nodes for n in spec.pod_names}
+        for assigned in solve.existing_assignments.values():
+            names.update(assigned)
+        return SolveResult(
+            unschedulable=sorted(set(solve.unschedulable) | names),
+            stats={**(solve.stats or {}), "validation_rejected": 1.0},
+        )
+
+    def _trial_firewall(
+        self, plan, batch: Sequence[Pod], base_existing=None,
+    ) -> bool:
+        """Validate a preemption trial BEFORE its victims are evicted: the
+        trial binds through ``_apply_solve`` with no fit re-check, and an
+        eviction cannot be undone — so a fault-corrupted trial plan must be
+        refused here, which costs the preemptor one deferred round, never
+        an invalid binding. Capacity is judged against the freed-capacity
+        view (victims' requests handed back) over the SAME base the trial
+        solved onto: ``base_existing`` is the in-cascade consumed-net view
+        (existing capacity minus the round's still-unbound assignments);
+        the post-cascade path passes nothing, where live cluster capacity
+        — binds already applied — IS that view."""
+        if not self.settings.solver_validation_enabled:
+            return True
+        from .preemption import freed_existing_view
+
+        freed = freed_existing_view(
+            base_existing if base_existing is not None
+            else self.cluster.existing_capacity(),
+            set(plan.victim_names),
+        )
+        round_provs = [
+            (p, self.provider.get_instance_types(p))
+            for p in self.cluster.provisioners.values()
+        ]
+        violations = self._firewall_eval(
+            plan.result, batch, round_provs, freed, self.cluster.daemonsets()
+        )
+        if not violations:
+            self._note_fw_event("accepted", "trial", [])
+            return True
+        self._note_fw_event("rejected-final", "trial", violations)
+        self.recorder.publish(
+            "PlanRejected",
+            f"preemption trial rejected by the validation firewall "
+            f"({len(violations)} violations); victims NOT evicted",
+            type="Warning",
+        )
+        return False
+
     # -- cell-sharded solve path -------------------------------------------
     def _solve_round(
         self, batch, provisioners, round_provs, round_existing, daemonsets, cap
@@ -693,9 +961,17 @@ class ProvisioningController:
             )
             if cap is not None:
                 cap.add_digest(solve.problem_digest, stats=solve.stats)
-            return solve
-        return self._solve_round_sharded(
-            batch, provisioners, round_provs, round_existing, daemonsets, cap
+        else:
+            solve = self._solve_round_sharded(
+                batch, provisioners, round_provs, round_existing, daemonsets,
+                cap,
+            )
+        # placement validation firewall, backend layer: whatever backend
+        # answered (kernel, host LP, greedy, the sharded merge), the plan is
+        # re-checked against cluster-level hard constraints before the gates
+        # consume it; an invalid plan re-solves on the fallback backend
+        return self._backend_firewall(
+            solve, batch, round_provs, round_existing, daemonsets, cap
         )
 
     def _solve_round_sharded(
@@ -1129,6 +1405,10 @@ class ProvisioningController:
         if st is not None and hasattr(clone, "_stager"):
             clone._stager.enabled = st.enabled
             clone._stager.capacity_bytes = st.capacity_bytes
+        if hasattr(self.solver, "dispatch_timeout_s") and hasattr(
+            clone, "dispatch_timeout_s"
+        ):
+            clone.dispatch_timeout_s = self.solver.dispatch_timeout_s
         return clone
 
     # -- /debug/cells -------------------------------------------------------
@@ -1715,6 +1995,12 @@ class ProvisioningController:
                     },
                 )
                 continue
+            # validated against the SAME consumed-net base the trial solved
+            # onto: the round's still-unbound existing assignments bind with
+            # no fit re-check after this, so judging against raw cluster
+            # capacity would miss exactly the overcommit class at stake
+            if not self._trial_firewall(plan, g.pods, base_existing=base):
+                continue  # invalid trial: keep the launch specs instead
             # eviction wins: execute, bind the trial, cancel the launches
             self.preemption.execute(plan)
             self._note_gang_evicted(plan)
@@ -1820,6 +2106,8 @@ class ProvisioningController:
                            "enough compatible capacity",
                 )
                 continue
+            if not self._trial_firewall(plan, pre.pods):
+                continue  # invalid trial: the demand stays deferred
             self.preemption.execute(plan)
             self._note_gang_evicted(plan)
             # last-resort regime: no launch plan existed for this demand, so

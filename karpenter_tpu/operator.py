@@ -134,7 +134,24 @@ class Operator:
             aot_donate=settings.aot_donate_inputs,
             device_staging=settings.device_staging_enabled,
             staging_capacity_mb=settings.device_staging_capacity_mb,
+            dispatch_timeout_s=settings.kernel_dispatch_timeout_s,
         )
+        # kernel-backend circuit breaker thresholds (process-global board —
+        # sweep worker clones share both the AOT cache and its quarantines)
+        from .solver.solver import KERNEL_BOARD
+
+        KERNEL_BOARD.configure(
+            failure_threshold=settings.kernel_breaker_failure_threshold,
+        )
+        # scripted device-fault timeline (chaos/soak only; empty in
+        # production) — armed from boot so the soak's wall-clock bursts
+        # land inside the solver seams of THIS process
+        if settings.device_fault_script:
+            from .utils.faults import DeviceFaultPlan, install_device_faults
+
+            install_device_faults(
+                DeviceFaultPlan.parse(settings.device_fault_script)
+            )
         provisioning = ProvisioningController(
             cluster, provider, solver=solver, settings=settings, recorder=recorder
         )

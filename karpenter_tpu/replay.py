@@ -491,6 +491,21 @@ def _decision_keys(decisions: List[Dict]) -> List[Tuple]:
     return sorted(out)
 
 
+def _validation_keys(events) -> List[Tuple]:
+    """Replay-comparable identity of a firewall evaluation: verdict,
+    fallback decision, and the violation list — NOT the backend that
+    produced the judged plan (cache state moves race winners between
+    processes; the firewall's decisions must still reproduce)."""
+    out = []
+    for e in events or []:
+        out.append((
+            e.get("verdict", ""),
+            e.get("fallback", ""),
+            json.dumps(e.get("violations", []), sort_keys=True),
+        ))
+    return out
+
+
 def _placement_key(entry: Dict) -> Tuple:
     if entry.get("existing"):
         return ("existing", entry.get("node", ""))
@@ -563,8 +578,8 @@ def replay_capsule(
         "recorded": {
             k: recorded.get(k)
             for k in ("problem_digests", "placements", "unschedulable",
-                      "gang_deferred", "action", "planned", "decisions",
-                      "rebalance_actions")
+                      "gang_deferred", "validation_events", "action",
+                      "planned", "decisions", "rebalance_actions")
             if k in recorded
         },
     }
@@ -617,6 +632,20 @@ def replay_capsule(
             sorted(recorded.get("gang_deferred", []))
             == sorted(replayed.get("gang_deferred", []))
         )
+        # validator verdicts + backend-degradation events are round OUTPUTS:
+        # a replay that validated a different number of plans, or degraded
+        # on a different round, diverged even when placements agree. The
+        # `backend` field is EXCLUDED from the comparison like the aot
+        # stats: which backend won a round's race legitimately varies with
+        # executable-cache state across processes, while the verdict
+        # sequence and the violations must not. Pre-firewall capsules lack
+        # the key — skipped, not failed.
+        rec_val = recorded.get("validation_events")
+        diffs["validation_match"] = (
+            True if rec_val is None
+            else _validation_keys(rec_val)
+            == _validation_keys(replayed.get("validation_events"))
+        )
         rec_keys = _decision_keys(recorded.get("decisions", []))
         rep_keys = _decision_keys(replayed.get("decisions", []))
         diffs["decisions_match"] = rec_keys == rep_keys
@@ -631,6 +660,7 @@ def replay_capsule(
                 and diffs["placements_match"]
                 and diffs["unschedulable_match"]
                 and diffs["gang_deferred_match"]
+                and diffs["validation_match"]
             )
     elif controller_kind == "rebalance":
         # rebalance rounds compare the full ordered action list — pool,
@@ -671,7 +701,10 @@ def _actions_equal(a: Optional[Dict], b: Optional[Dict]) -> bool:
 
 
 def _replay_provisioning(capsule, cluster, provider, solver, settings) -> Dict:
+    from contextlib import nullcontext
+
     from .controllers.provisioning import MachineNameSeq, ProvisioningController
+    from .solver.validate import scripted_verdicts
     from .utils.flightrecorder import provisioning_outputs
 
     controller = ProvisioningController(
@@ -680,7 +713,30 @@ def _replay_provisioning(capsule, cluster, provider, solver, settings) -> Dict:
     # launched-node names reproduce the recorded sequence (they feed later
     # solve rounds' digests and the placement records)
     controller.machine_ids = MachineNameSeq(capsule.get("machine_seq", 1))
-    result = controller.reconcile()
+    # the firewall's fallback re-solves add digests to the recorded stream
+    # (cap.add_digest on the live side): route the replay's fallback solver
+    # through a tap SHARING the main tap's list, so the replayed digest
+    # sequence interleaves in the same call order
+    if isinstance(solver, _DigestTapSolver):
+        from .solver.solver import GreedySolver as _Greedy
+
+        fallback_tap = _DigestTapSolver(_Greedy())
+        fallback_tap.digests = solver.digests
+        controller._fw_fallback = fallback_tap
+    # a recorded firewall REJECTION came from a transient device fault the
+    # offline replay cannot reproduce — install the recorded verdict
+    # sequence so the firewall consumes it in call order and the round's
+    # fallback decision (and every digest downstream) replays
+    # byte-identically. All-accepted capsules validate live: the real
+    # computation is itself deterministic then.
+    recorded_events = capsule.get("outputs", {}).get("validation_events") or []
+    script = (
+        scripted_verdicts(recorded_events)
+        if any(e.get("verdict") != "accepted" for e in recorded_events)
+        else nullcontext()
+    )
+    with script:
+        result = controller.reconcile()
     return provisioning_outputs(result, cluster)
 
 
@@ -959,6 +1015,11 @@ def _print_summary(report: Dict) -> None:
         print(f"  gang_deferred: recorded={len(rec.get('gang_deferred') or [])} "
               f"replayed={len(rep.get('gang_deferred') or [])} "
               f"equal={diffs.get('gang_deferred_match')}")
+        rec_val = rec.get("validation_events") or []
+        rejected = sum(1 for e in rec_val if e.get("verdict") != "accepted")
+        print(f"  validation: recorded={len(rec_val)} events "
+              f"({rejected} rejected) "
+              f"equal={diffs.get('validation_match')}")
         print(f"  decisions: equal={diffs.get('decisions_match')}")
     elif report["controller"] == "rebalance":
         rep = report.get("replayed", {})

@@ -53,6 +53,7 @@ KINDS = (
     "drift",            # touch labels on k nodes (watch-stream churn)
     "price-spike",      # multiply a spot pool's live price
     "rpc-fault-burst",  # script N transient errors on a cloud endpoint
+    "device-fault-burst",  # script N device-path faults in the operator's solver
     "apiserver-restart",  # bounce the apiserver listener (store survives)
     "operator-restart",   # SIGKILL (crash) or SIGTERM (clean) + respawn
 )
@@ -174,6 +175,10 @@ class ChurnScript:
             return self._add("rpc-fault-burst", endpoint=endpoint, n=n,
                              status=status)
 
+        def device_fault_burst(self, fault_kind: str = "garbage-result",
+                               n: int = 2) -> "ChurnScript":
+            return self._add("device-fault-burst", fault_kind=fault_kind, n=n)
+
         def apiserver_restart(self) -> "ChurnScript":
             return self._add("apiserver-restart")
 
@@ -219,6 +224,22 @@ class ChurnScript:
             "span_s": round(self.last_t(), 3),
         }
 
+    def device_fault_script(self) -> str:
+        """The timeline's device-fault bursts in ``DeviceFaultPlan.parse``
+        wire format (settings.device_fault_script): the soak harness hands
+        it to the spawned operator process, whose solver seams consume the
+        faults — device chaos cannot be injected over HTTP, it lives inside
+        the solver's address space."""
+        parts = []
+        for e in self.events:
+            if e.kind != "device-fault-burst":
+                continue
+            parts.append(
+                f"t={e.t:g},kind={e.get('fault_kind', 'garbage-result')}"
+                f",n={int(e.get('n', 1))}"
+            )
+        return ";".join(parts)
+
     # -- projections onto the legacy fault shapes ----------------------------
     def interruption_schedule(self, round_s: float = 1.0) -> InterruptionSchedule:
         """Project reclaim/price events onto PR 7's round-keyed
@@ -260,6 +281,7 @@ class ChurnScript:
         drift_every_s: float = 2.0,
         spike_every_s: float = 25.0,
         rpc_burst_every_s: float = 10.0,
+        device_fault_every_s: float = 20.0,
         operator_restarts: Sequence[Tuple[float, str]] = ((0.35, "kill"),),
         apiserver_restarts: Sequence[float] = (0.65,),
         clock: Callable[[], float] = time.monotonic,
@@ -360,6 +382,20 @@ class ChurnScript:
                     ),
                     n=rng.randint(2, 4),
                     status=rng.choice([500, 503, 0]),
+                ),
+            ))
+        for t in cadence(device_fault_every_s):
+            # device-path chaos rides the same timeline: the harness hands
+            # these to the operator as its settings.device_fault_script, so
+            # the solver seams fire them by wall-clock inside that process
+            events.append(ChurnEvent(
+                t=t, kind="device-fault-burst",
+                params=_params(
+                    fault_kind=rng.choice([
+                        "garbage-result", "nan-result", "compile-error",
+                        "device-oom", "staging-corruption",
+                    ]),
+                    n=rng.randint(1, 3),
                 ),
             ))
         for frac, sig in operator_restarts:

@@ -286,6 +286,14 @@ class SoakHarness:
             # the harness's reclaim ops inject messages into it over the wire
             "KARPENTER_TPU_INTERRUPTION_QUEUE_NAME": "soak-queue",
         })
+        # device-path chaos: the timeline's device-fault bursts install as
+        # the operator's scripted DeviceFaultPlan (solver-side seams; no
+        # HTTP surface can reach them). A respawned operator re-arms the
+        # remaining timeline from ITS boot — chaos precision is secondary
+        # to the faults actually firing under churn.
+        dev_script = self.script.device_fault_script()
+        if dev_script:
+            env["KARPENTER_TPU_DEVICE_FAULT_SCRIPT"] = dev_script
         env.update(self.cfg.extra_env)
         log_path = os.path.join(self.dump_dir, f"operator-{self._incarnation}.log")
         self._incarnation += 1
@@ -434,6 +442,12 @@ class SoakHarness:
                 status=int(event.get("status", 503)),
             )
             self._count(kind, int(event.get("n", 2)))
+        elif kind == "device-fault-burst":
+            # the operator process owns this fault surface: its boot env
+            # carried the WHOLE device-fault timeline (device_fault_script),
+            # so the burst fires inside its solver seams on schedule — the
+            # harness only accounts the event
+            self._count(kind, int(event.get("n", 1)))
         elif kind == "apiserver-restart":
             self.restart_apiserver()
         elif kind == "operator-restart":

@@ -870,6 +870,16 @@ class AOTCache:
                 return entry.exe
             self._compiling.add(ck)
         try:
+            # device-fault seam: a scripted compile failure surfaces exactly
+            # where a real XLA miscompile/abort would — callers (the kernel
+            # breaker, the background warm worker) classify it identically
+            from ..utils import faults as _faults
+
+            fault = _faults.device_fault("compile")
+            if fault is not None:
+                raise _faults.InjectedDeviceError(
+                    f"injected compile failure for bucket {key.label()}"
+                )
             self._maybe_enable_persistence()
             specs = _bucket_specs(key, mesh=mesh)
             t0 = time.perf_counter()
@@ -901,6 +911,22 @@ class AOTCache:
             self._entries.popitem(last=False)
             self.stats["evictions"] += 1
             self._count("evict")
+
+    def evict_bucket(self, label: str) -> int:
+        """Quarantine eviction: drop EVERY variant (donate/mesh) of the
+        bucket with this shape label. The kernel breaker calls this when a
+        bucket's executable produced an invalid or non-finite plan — the
+        half-open probe then necessarily runs a fresh compile instead of
+        re-dispatching the suspect binary. Returns how many entries dropped."""
+        with self._lock:
+            victims = [ck for ck in self._entries if ck[0].label() == label]
+            for ck in victims:
+                del self._entries[ck]
+            if victims:
+                self.stats["evictions"] += len(victims)
+            for _ in victims:
+                self._count("evict")
+            return len(victims)
 
     @staticmethod
     def _count(event: str) -> None:

@@ -64,6 +64,219 @@ def _observe_phase(problem: EncodedProblem, phase: str, seconds: float) -> None:
 _IBIG = 1 << 30
 
 
+# ---------------------------------------------------------------------------
+# Kernel-backend circuit breaker (solver fault domain, layer 3)
+# ---------------------------------------------------------------------------
+
+class KernelDispatchTimeout(Exception):
+    """A kernel dispatch missed its deadline — the buffer never became
+    ready. The host paths own the round; the breaker books the evidence."""
+
+
+class KernelBreakerBoard:
+    """Per-executable-bucket circuit breakers for the device path, riding
+    ``utils.resilience``'s closed→open→half-open machinery.
+
+    Evidence: a bucket whose executable produced an INVALID plan (the
+    count-level validator or the placement firewall rejected it), a
+    NON-FINITE plan (NaN/Inf costs), a dispatch timeout/exception, or a
+    compile failure records a failure; a validated answer records success.
+    When a bucket's breaker OPENS, its executable is evicted from the AOT
+    cache (quarantine — the binary itself is suspect), so the half-open
+    probe after ``recovery_timeout_s`` necessarily runs a fresh compile.
+    The health gauge (karpenter_tpu_kernel_backend_health) is the fraction
+    of consulted buckets currently closed; degradation to host-lp/greedy
+    and recovery are both automatic.
+
+    Process-global like the AOT cache it guards: bucket evidence from any
+    solver instance (sweep worker clones included) indicts the shared
+    executable. ``configure``/``reset`` serve the operator and tests.
+    """
+
+    def __init__(self, failure_threshold: int = 3, recovery_timeout_s: float = 30.0):
+        self._lock = threading.Lock()
+        self._make(failure_threshold, recovery_timeout_s, time.monotonic)
+
+    def _make(self, failure_threshold, recovery_timeout_s, clock) -> None:
+        from ..utils.resilience import BreakerSet
+
+        self.failure_threshold = int(failure_threshold)
+        self.recovery_timeout_s = float(recovery_timeout_s)
+        self._clock = clock
+        self._set = BreakerSet(
+            "kernel",
+            failure_threshold=self.failure_threshold,
+            recovery_timeout_s=self.recovery_timeout_s,
+            clock=clock,
+        )
+
+    def configure(
+        self,
+        failure_threshold: Optional[int] = None,
+        recovery_timeout_s: Optional[float] = None,
+        clock=None,
+    ) -> None:
+        """Rebuild the board with new thresholds (operator settings / test
+        clock injection). Existing breaker state is dropped deliberately —
+        thresholds apply uniformly, never per-era."""
+        with self._lock:
+            self._make(
+                failure_threshold if failure_threshold is not None
+                else self.failure_threshold,
+                recovery_timeout_s if recovery_timeout_s is not None
+                else self.recovery_timeout_s,
+                clock if clock is not None else self._clock,
+            )
+        self._publish()
+
+    def reset(self) -> None:
+        self.configure()
+
+    def allows(self, label: str) -> bool:
+        """True when the bucket may dispatch: breaker closed, or half-open
+        (the dispatch is the re-compile probe — the executable was evicted
+        at quarantine time, so a fresh compile backs it)."""
+        allowed = self._set.get(label).state != "open"
+        self._publish()
+        return allowed
+
+    def state(self, label: str) -> str:
+        return self._set.get(label).state
+
+    def ok(self, label: str) -> None:
+        """A validated, finite kernel answer from this bucket. Ignored while
+        the breaker is OPEN: a stale in-flight answer from the
+        pre-quarantine executable must not short-circuit the recovery
+        timeout — only a half-open probe (which the quarantine eviction
+        forces through a fresh compile) may re-close the circuit. (Reading
+        ``state`` transitions open→half-open once the timeout elapses, so a
+        genuine probe success still lands here as half-open.)"""
+        breaker = self._set.get(label)
+        if breaker.state != "open":
+            breaker.record_success()
+        self._publish()
+
+    def fail(self, label: str, kind: str) -> None:
+        """Device-path failure evidence; opens quarantine the executable."""
+        metrics.KERNEL_FAULTS.inc({"kind": kind})
+        breaker = self._set.get(label)
+        before = breaker.state
+        breaker.record_failure()
+        if breaker.state == "open" and before != "open":
+            # quarantine: the suspect binary must never dispatch again —
+            # the half-open probe recompiles from scratch
+            AOT_CACHE.evict_bucket(label)
+        self._publish()
+
+    def health(self) -> float:
+        """Fraction of consulted buckets whose breaker is closed (1.0 when
+        nothing has ever been consulted — a healthy idle backend)."""
+        breakers = self._set.breakers()
+        if not breakers:
+            return 1.0
+        closed = sum(1 for b in breakers.values() if b.state == "closed")
+        return closed / len(breakers)
+
+    def states(self) -> dict:
+        return {label: b.state for label, b in self._set.breakers().items()}
+
+    def _publish(self) -> None:
+        metrics.KERNEL_BACKEND_HEALTH.set(self.health())
+
+
+#: process-wide board — one quarantine truth per shared AOT cache
+KERNEL_BOARD = KernelBreakerBoard()
+
+
+class _HungBuffer:
+    """Injected dispatch-hang wrapper: the underlying device buffer reports
+    un-ready until the scripted hang elapses. Pure test/chaos artifact —
+    production buffers are never wrapped."""
+
+    def __init__(self, inner, until: float):
+        self._inner = inner
+        self._until = until
+
+    def is_ready(self) -> bool:
+        if time.perf_counter() < self._until:
+            return False
+        return self._inner.is_ready()
+
+    def __array__(self, dtype=None):
+        remaining = self._until - time.perf_counter()
+        if remaining > 0:
+            time.sleep(remaining)
+        arr = np.asarray(self._inner)
+        return arr if dtype is None else arr.astype(dtype)
+
+
+def _apply_dispatch_fault(buf):
+    """Dispatch-site fault seam: raises on injected device OOM, wraps the
+    buffer on an injected hang; returns the buffer untouched otherwise."""
+    from ..utils import faults as _faults
+
+    fault = _faults.device_fault("dispatch")
+    if fault is None:
+        return buf
+    if fault.kind == "device-oom":
+        raise _faults.InjectedDeviceError(
+            "injected RESOURCE_EXHAUSTED: device out of memory"
+        )
+    hang = fault.hang_s if fault.hang_s == fault.hang_s else float("inf")
+    until = time.perf_counter() + min(hang, 3600.0)
+    return _HungBuffer(buf, until)
+
+
+def _apply_result_fault(unpacked):
+    """Result-site fault seam, applied to the UNPACKED kernel answer
+    (order, unplaced, costs, exhausted, new_opt, new_active, ys):
+
+    * ``nan-result``     — costs become non-finite (the breaker's
+      nonfinite-plan detection must refuse to decode it);
+    * ``garbage-result`` — assignment counts are corrupted into a
+      plausible-shaped overpack (the count validator / placement firewall
+      must reject it)."""
+    from ..utils import faults as _faults
+
+    fault = _faults.device_fault("result")
+    if fault is None:
+        return unpacked
+    order, unplaced, costs, exhausted, new_opt, new_active, ys = unpacked
+    if fault.kind == "nan-result":
+        costs = np.full_like(np.asarray(costs, dtype=np.float64), np.nan)
+    elif fault.kind == "garbage-result":
+        ys = np.asarray(ys).copy()
+        ys[ys > 0] = ys[ys > 0] * 3 + 1  # overpacks every used slot
+        unplaced = 0  # "everything placed" — the plausible-but-invalid shape
+        # ...and impossibly cheap: a miscompiled kernel CLAIMING a great
+        # plan must win the cost race and be stopped by the validator, not
+        # lose quietly on price
+        costs = np.full_like(np.asarray(costs, dtype=np.float64), 1e-6)
+    return order, unplaced, costs, exhausted, new_opt, new_active, ys
+
+
+def _fetch_bounded(buf, timeout_s: float) -> np.ndarray:
+    """Fetch a dispatched device buffer to host with a deadline: polls
+    readiness and raises :class:`KernelDispatchTimeout` instead of blocking
+    the round on a hung device. ``timeout_s <= 0`` disables the deadline
+    (the legacy blocking fetch)."""
+    if timeout_s <= 0:
+        return np.asarray(buf)
+    deadline = time.perf_counter() + timeout_s
+    try:
+        ready = buf.is_ready()
+    except AttributeError:
+        return np.asarray(buf)  # plain arrays (tests/stubs): nothing to wait on
+    while not ready:
+        if time.perf_counter() >= deadline:
+            raise KernelDispatchTimeout(
+                f"kernel dispatch not ready within {timeout_s}s"
+            )
+        time.sleep(0.0005)
+        ready = buf.is_ready()
+    return np.asarray(buf)
+
+
 def _water_fill(count: int, seeds: np.ndarray, avail: np.ndarray) -> np.ndarray:
     """Distribute ``count`` new pods over available zones so final levels
     (seed + new) are as equal as possible — the DoNotSchedule-optimal split
@@ -889,6 +1102,11 @@ def stage_fleet(
                 pred = owner.device_rtt()
             if pred >= owner.latency_budget_s:
                 continue
+            if not KERNEL_BOARD.allows(fleet_key.label()):
+                # quarantined fleet bucket (it produced invalid/non-finite
+                # rows): cells race per-cell — the B=1 bucket has its own
+                # breaker — until the half-open recompile probe clears it
+                continue
             # get(), not ready(): the lookup IS the fleet's use decision —
             # a cold fleet bucket counts as a miss and queues a background
             # build; its cells race per-cell this round
@@ -1085,6 +1303,7 @@ class TPUSolver(Solver):
         aot_donate: bool = False,
         device_staging: bool = True,
         staging_capacity_mb: int = 256,
+        dispatch_timeout_s: float = 2.0,
     ):
         self.portfolio = portfolio
         self.seed = seed
@@ -1135,6 +1354,13 @@ class TPUSolver(Solver):
         from .staging import DeviceStager
 
         self._stager = DeviceStager(staging_capacity_mb, enabled=device_staging)
+        # hard deadline on a SYNCHRONOUS kernel fetch (the topology/quality
+        # paths, where the device answer is waited on inline): a hung
+        # dispatch raises KernelDispatchTimeout after this long and the host
+        # fallback answers the round instead of blocking it. 0 disables
+        # (the legacy blocking fetch). The async race path has its own
+        # budget-bounded poll and never blocks regardless.
+        self.dispatch_timeout_s = dispatch_timeout_s
         self._fallback = GreedySolver()
         # Device-resident input cache: repeated solves of the same encoded problem
         # (benchmarks, consolidation candidate sweeps) pay zero re-upload. The
@@ -1709,13 +1935,35 @@ class TPUSolver(Solver):
                         AOT_CACHE.warm([grown], donate=self._donate(), mesh=mesh)
                     return None
                 key = grown
+            if not KERNEL_BOARD.allows(key.label()):
+                # quarantined bucket: its executable produced invalid or
+                # non-finite plans; the host path owns this shape until the
+                # breaker's half-open probe (a fresh compile — the binary
+                # was evicted at open) proves the backend healthy again
+                return None
             t_dispatch = time.perf_counter()
-            buf = exe(
-                self._stage_inputs(inputs), orders_d, alphas_d, looks_d,
-                rsvs_d, swaps_d,
-            )
+            staged = self._stage_inputs(inputs)
+            try:
+                buf = _apply_dispatch_fault(exe(
+                    staged, orders_d, alphas_d, looks_d, rsvs_d, swaps_d,
+                ))
+            except Exception as e:
+                # the DISPATCH itself failed (real XLA OOM/runtime error, or
+                # an injected one): breaker evidence on the race path too —
+                # without this a persistently failing device pays the doomed
+                # dispatch every round with no quarantine
+                from ..utils.faults import InjectedDeviceError
+
+                KERNEL_BOARD.fail(
+                    key.label(),
+                    "device-oom" if isinstance(e, InjectedDeviceError)
+                    else "dispatch-error",
+                )
+                return None
             return (buf, orders, swaps, s_new, n_zones, inputs, key, t_dispatch)
         except Exception:
+            # host-side preparation failed (staging/bucket bookkeeping):
+            # not device evidence — the host path answers this round
             return None
 
     def _stage_inputs(self, inputs):
@@ -1802,19 +2050,41 @@ class TPUSolver(Solver):
                     donate=self._donate(), mesh=self._ensure_mesh(),
                 )
                 problem.__dict__["_dispatch_s"] = ready_at - t_dispatch
-            order, unplaced, costs, exhausted, new_opt, new_active, ys = unpack_solve_fused(
-                raw, k, s_new, Gp, Ep, orders, swaps
+            order, unplaced, costs, exhausted, new_opt, new_active, ys = (
+                _apply_result_fault(unpack_solve_fused(
+                    raw, k, s_new, Gp, Ep, orders, swaps
+                ))
             )
+            if not np.isfinite(np.asarray(costs, dtype=np.float64)).all():
+                # non-finite answer: numerically degenerate (or corrupted)
+                # kernel output — breaker evidence BEFORE any comparison,
+                # because decode recomputes cost from real prices and would
+                # otherwise launder a garbage plan into a plausible one
+                KERNEL_BOARD.fail(key.label(), "nonfinite-plan")
+                self._mark_kernel_lost(problem)
+                return None
             if unplaced > 0 or costs.min() >= host_cost:
                 # the device DID answer and lost on quality: remember per
                 # problem, so repeat solves return the host answer without
                 # re-paying this wait (distinct from a missed deadline, which
-                # the breaker handles — a late kernel might still win later)
+                # the breaker handles — a late kernel might still win later).
+                # A half-open breaker still needs its probe SETTLED: a
+                # finite, in-time, count-valid answer is health evidence
+                # even when the host plan is cheaper — without this, a
+                # quarantined bucket whose probes keep losing on cost would
+                # stay half-open forever.
+                if KERNEL_BOARD.state(key.label()) != "closed":
+                    if validate_counts(problem, order, new_opt, new_active, ys):
+                        KERNEL_BOARD.fail(key.label(), "invalid-plan")
+                    else:
+                        KERNEL_BOARD.ok(key.label())
                 self._mark_kernel_lost(problem)
                 return None  # decode + validation would be wasted host time
             if validate_counts(problem, order, new_opt, new_active, ys):
+                KERNEL_BOARD.fail(key.label(), "invalid-plan")
                 self._mark_kernel_lost(problem)
                 return None
+            KERNEL_BOARD.ok(key.label())
             result = self._decode(problem, order, new_opt, new_active, ys)
             result.stats["backend"] = 1.0
             idx = int(np.argmin(costs))
@@ -1828,6 +2098,10 @@ class TPUSolver(Solver):
             result.stats["aot_bucket"] = key.label()
             return result
         except Exception:
+            # materialize/unpack/decode blew up on an in-flight dispatch:
+            # device-path evidence (a real runtime error surfaces exactly
+            # here on the race path)
+            KERNEL_BOARD.fail(key.label(), "dispatch-error")
             return None
 
     def _poll_fleet(
@@ -1876,16 +2150,29 @@ class TPUSolver(Solver):
             k = slot.orders.shape[0]
             key = shared.key
             order, unplaced, costs, exhausted, new_opt, new_active, ys = (
-                unpack_solve_fused(
+                _apply_result_fault(unpack_solve_fused(
                     raw, k, slot.s_new, key.G, key.E, slot.orders, slot.swaps
-                )
+                ))
             )
+            if not np.isfinite(np.asarray(costs, dtype=np.float64)).all():
+                KERNEL_BOARD.fail(key.label(), "nonfinite-plan")
+                self._mark_kernel_lost(problem)
+                return None
             if unplaced > 0 or costs.min() >= host_cost:
+                # same half-open settle rule as the per-cell poll: a valid
+                # losing probe answer still closes the breaker
+                if KERNEL_BOARD.state(key.label()) != "closed":
+                    if validate_counts(problem, order, new_opt, new_active, ys):
+                        KERNEL_BOARD.fail(key.label(), "invalid-plan")
+                    else:
+                        KERNEL_BOARD.ok(key.label())
                 self._mark_kernel_lost(problem)
                 return None
             if validate_counts(problem, order, new_opt, new_active, ys):
+                KERNEL_BOARD.fail(key.label(), "invalid-plan")
                 self._mark_kernel_lost(problem)
                 return None
+            KERNEL_BOARD.ok(key.label())
             result = self._decode(problem, order, new_opt, new_active, ys)
             result.stats["backend"] = 1.0
             idx = int(np.argmin(costs))
@@ -1899,6 +2186,7 @@ class TPUSolver(Solver):
             result.stats["fleet_b"] = float(key.B)
             return result
         except Exception:
+            KERNEL_BOARD.fail(slot.shared.key.label(), "dispatch-error")
             return None
 
     def _solve_kernel_quality(self, problem: EncodedProblem) -> Optional[SolveResult]:
@@ -1921,6 +2209,8 @@ class TPUSolver(Solver):
         return None
 
     def _solve_kernel(self, problem: EncodedProblem) -> Optional[SolveResult]:
+        from ..utils.faults import InjectedDeviceError
+
         t0 = time.perf_counter()
         (inputs, orders, swaps, orders_d, alphas_d, looks_d, rsvs_d, swaps_d,
          s_new, n_zones) = self._device_inputs(problem)
@@ -1928,48 +2218,87 @@ class TPUSolver(Solver):
         Gp = inputs.count.shape[0]
         Ep = inputs.ex_valid.shape[0]
         aot_hit = True
-        while True:
-            # ONE device call, ONE host fetch: two-phase portfolio eval (K
-            # members + K winner-seeded perturbations) with on-device argmin,
-            # the winner's assignments packed into one int32 buffer. The call
-            # goes through the bucket's AOT executable — a resident bucket
-            # costs a dispatch; a cold one compiles inline (and lands in the
-            # cache, and on disk, for every later process/solve).
-            key = self._bucket_key(problem, s_new)
-            exe, hit, inputs_run = self._aot_exe(key, inputs, block=True)
-            aot_hit = aot_hit and hit
-            t_dispatch = time.perf_counter()
-            buf = np.asarray(
-                exe(inputs_run, orders_d, alphas_d, looks_d, rsvs_d, swaps_d)
+        label = self._bucket_key(problem, s_new).label()
+        try:
+            while True:
+                # ONE device call, ONE host fetch: two-phase portfolio eval (K
+                # members + K winner-seeded perturbations) with on-device argmin,
+                # the winner's assignments packed into one int32 buffer. The call
+                # goes through the bucket's AOT executable — a resident bucket
+                # costs a dispatch; a cold one compiles inline (and lands in the
+                # cache, and on disk, for every later process/solve).
+                key = self._bucket_key(problem, s_new)
+                label = key.label()
+                if not KERNEL_BOARD.allows(label):
+                    # quarantined bucket: degrade to the host paths until the
+                    # half-open probe (a fresh compile — the suspect binary
+                    # was evicted at open) re-proves the backend
+                    return None
+                exe, hit, inputs_run = self._aot_exe(key, inputs, block=True)
+                aot_hit = aot_hit and hit
+                t_dispatch = time.perf_counter()
+                buf = _fetch_bounded(
+                    _apply_dispatch_fault(
+                        exe(inputs_run, orders_d, alphas_d, looks_d, rsvs_d,
+                            swaps_d)
+                    ),
+                    self.dispatch_timeout_s,
+                )
+                AOT_CACHE.note_dispatch(
+                    key, time.perf_counter() - t_dispatch,
+                    donate=self._donate(), mesh=self._ensure_mesh(),
+                )
+                problem.__dict__["_dispatch_s"] = time.perf_counter() - t_dispatch
+                order, unplaced, costs, exhausted, new_opt, new_active, ys = (
+                    _apply_result_fault(unpack_solve_fused(
+                        buf, k, s_new, Gp, Ep, orders, swaps
+                    ))
+                )
+                # Grow S only when members actually ran out of slots; leftover pods
+                # with free slots are genuinely unschedulable and re-running can't help.
+                if exhausted.any() and unplaced > 0 and s_new < self.max_slots:
+                    s_new *= 2
+                    with self._cache_lock:
+                        self._device_cache[id(problem)] = (
+                            problem, inputs, orders, swaps, orders_d, alphas_d,
+                            looks_d, rsvs_d, swaps_d, s_new, n_zones,
+                        )
+                    continue
+                break
+        except KernelDispatchTimeout:
+            # hedged host fallback: the dispatch hung past its deadline —
+            # the caller's host path answers this round instead of blocking
+            KERNEL_BOARD.fail(label, "dispatch-timeout")
+            return None
+        except InjectedDeviceError as e:
+            KERNEL_BOARD.fail(
+                label,
+                "device-oom" if "RESOURCE_EXHAUSTED" in str(e)
+                else "compile-error",
             )
-            AOT_CACHE.note_dispatch(
-                key, time.perf_counter() - t_dispatch,
-                donate=self._donate(), mesh=self._ensure_mesh(),
-            )
-            problem.__dict__["_dispatch_s"] = time.perf_counter() - t_dispatch
-            order, unplaced, costs, exhausted, new_opt, new_active, ys = unpack_solve_fused(
-                buf, k, s_new, Gp, Ep, orders, swaps
-            )
-            # Grow S only when members actually ran out of slots; leftover pods
-            # with free slots are genuinely unschedulable and re-running can't help.
-            if exhausted.any() and unplaced > 0 and s_new < self.max_slots:
-                s_new *= 2
-                with self._cache_lock:
-                    self._device_cache[id(problem)] = (
-                        problem, inputs, orders, swaps, orders_d, alphas_d,
-                        looks_d, rsvs_d, swaps_d, s_new, n_zones,
-                    )
-                continue
-            break
+            return None
+        except Exception:
+            # any other device-path failure (real XLA compile abort, runtime
+            # error mid-dispatch): breaker evidence + graceful degradation —
+            # the round must complete on a host backend, never crash
+            KERNEL_BOARD.fail(label, "dispatch-error")
+            return None
+        if not np.isfinite(np.asarray(costs, dtype=np.float64)).all():
+            # refuse to decode a non-finite plan: decode recomputes cost
+            # from real prices and would launder the degeneracy invisible
+            KERNEL_BOARD.fail(label, "nonfinite-plan")
+            return None
         t_solve = time.perf_counter() - t0
         # Count-level validation on the raw kernel output: same invariants as
         # the name-level validator, no 10k-pod name expansion on the hot path.
         violations = validate_counts(problem, order, new_opt, new_active, ys)
         if violations:
+            KERNEL_BOARD.fail(label, "invalid-plan")
             result = self._fallback.solve(problem)
             result.stats["fallback"] = 1.0
             result.stats["tpu_violations"] = float(len(violations))
             return result
+        KERNEL_BOARD.ok(label)
         result = self._decode(problem, order, new_opt, new_active, ys)
         result.stats["solve_s"] = t_solve
         result.stats["backend"] = 1.0

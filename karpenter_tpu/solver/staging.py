@@ -87,8 +87,30 @@ class DeviceStager:
         the padded shape (bucket dims, portfolio K, fleet width)."""
         import jax.numpy as jnp
 
+        from ..utils import faults as _faults
         from ..utils import metrics
 
+        fault = _faults.device_fault("staging")
+        if fault is not None:
+            # staged-tensor corruption: the device solves a DIFFERENT problem
+            # than the host encoded (a torn DMA / bad buffer reuse). The
+            # caller's dict is left untouched; the corrupted values flow to
+            # this dispatch, whose plan the host-side validators must then
+            # reject. (The byte-equality residency contract self-heals: the
+            # next clean round's true bytes differ from the corrupted host
+            # copy, so the leaf restages.) alloc is the canonical victim —
+            # an inflated node capacity makes the kernel overpack, a
+            # violation no cost comparison can mask.
+            leaves = dict(leaves)
+            victim = "alloc" if "alloc" in leaves else next(
+                (k for k, v in leaves.items()
+                 if np.asarray(v).dtype.kind == "f" and np.asarray(v).size),
+                None,
+            )
+            if victim is not None:
+                corrupted = np.asarray(leaves[victim]).copy()
+                corrupted *= 4.0
+                leaves[victim] = corrupted
         if not self.enabled:
             return {k: jnp.asarray(v) for k, v in leaves.items()}
         round_info: Dict[str, object] = {

@@ -230,6 +230,235 @@ class InterruptionSchedule:
         return max(rounds) if rounds else -1
 
 
+# ---------------------------------------------------------------------------
+# Device-path fault injection (the solver fault domain's chaos surface):
+# scripted failures of the JAX kernel path — compile errors, dispatch
+# hangs, device OOM, NaN/garbage results, staging corruption — consumed by
+# the seams in solver/jax_solver.py (AOTCache.compile), solver/solver.py
+# (dispatch + result fetch) and solver/staging.py (DeviceStager.stage).
+# Same ordered-queue discipline as FaultPlan: "2 garbage plans then clean"
+# is a script, not a probability, so every breaker/validator behavior is
+# testable deterministically.
+# ---------------------------------------------------------------------------
+
+#: injection sites the solver seams consult
+DEVICE_SITES = ("compile", "dispatch", "result", "staging")
+
+#: fault kinds per site — the seams refuse unknown kinds loudly
+DEVICE_KINDS = {
+    "compile": ("compile-error",),
+    "dispatch": ("dispatch-hang", "device-oom"),
+    "result": ("nan-result", "garbage-result"),
+    "staging": ("staging-corruption",),
+}
+
+
+class InjectedDeviceError(RuntimeError):
+    """Carrier for injected compile/OOM failures — shaped like the
+    RuntimeError XLA raises, distinguishable in fault-domain tests."""
+
+
+@dataclass(frozen=True)
+class DeviceFault:
+    """One scripted device-path failure.
+
+    kind:
+      * ``"compile-error"``       — AOTCache.compile raises (miscompile/XLA abort)
+      * ``"dispatch-hang"``       — the dispatched buffer stays un-ready for
+        ``hang_s`` seconds (inf = forever; the dispatch deadline must rescue)
+      * ``"device-oom"``          — the dispatch raises RESOURCE_EXHAUSTED
+      * ``"nan-result"``          — the kernel answer's costs come back non-finite
+      * ``"garbage-result"``      — the assignment counts come back corrupted
+        (a plausible-shaped but invalid plan — the validator must catch it)
+      * ``"staging-corruption"``  — one staged problem tensor is perturbed on
+        its way to the device (the plan solves a DIFFERENT problem)
+    """
+
+    kind: str = "garbage-result"
+    hang_s: float = float("inf")
+    reason: str = "injected"
+
+    @property
+    def site(self) -> str:
+        for site, kinds in DEVICE_KINDS.items():
+            if self.kind in kinds:
+                return site
+        raise ValueError(f"unknown device fault kind {self.kind!r}")
+
+
+class DeviceFaultPlan:
+    """Scripted per-site device-fault queues, with optional timed arming.
+
+    ``script(faults)`` appends to each fault's site queue (consumed in
+    order by the solver seams via :func:`device_fault`); ``at(t, fault)``
+    schedules a fault to ARM ``t`` seconds after :meth:`start` — the soak's
+    wall-clock bursts. ``log``/``timeline`` record firings like FaultPlan's.
+    """
+
+    def __init__(
+        self,
+        sleep: Callable[[float], None] = time.sleep,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self._queues: Dict[str, List[DeviceFault]] = {s: [] for s in DEVICE_SITES}
+        self._timed: List[Tuple[float, DeviceFault]] = []
+        self._lock = threading.Lock()
+        self.sleep = sleep
+        self.clock = clock
+        self._t0: Optional[float] = None
+        self.log: List[Tuple[str, DeviceFault]] = []
+        self.timeline: List[Tuple[float, str, DeviceFault]] = []
+
+    # -- building -----------------------------------------------------------
+    def script(self, faults: Sequence[DeviceFault]) -> "DeviceFaultPlan":
+        with self._lock:
+            for f in faults:
+                self._queues[f.site].append(f)
+        return self
+
+    def compile_error(self, n: int = 1) -> "DeviceFaultPlan":
+        return self.script([DeviceFault(kind="compile-error")] * n)
+
+    def dispatch_hang(self, seconds: float = float("inf"), n: int = 1) -> "DeviceFaultPlan":
+        return self.script([DeviceFault(kind="dispatch-hang", hang_s=seconds)] * n)
+
+    def device_oom(self, n: int = 1) -> "DeviceFaultPlan":
+        return self.script([DeviceFault(kind="device-oom")] * n)
+
+    def nan_result(self, n: int = 1) -> "DeviceFaultPlan":
+        return self.script([DeviceFault(kind="nan-result")] * n)
+
+    def garbage_result(self, n: int = 1) -> "DeviceFaultPlan":
+        return self.script([DeviceFault(kind="garbage-result")] * n)
+
+    def staging_corruption(self, n: int = 1) -> "DeviceFaultPlan":
+        return self.script([DeviceFault(kind="staging-corruption")] * n)
+
+    def at(self, t: float, fault: DeviceFault) -> "DeviceFaultPlan":
+        """Arm ``fault`` ``t`` seconds after :meth:`start` — it joins its
+        site's queue the first time the elapsed clock passes ``t``."""
+        with self._lock:
+            self._timed.append((t, fault))
+            self._timed.sort(key=lambda e: e[0])
+        return self
+
+    def start(self) -> "DeviceFaultPlan":
+        with self._lock:
+            self._t0 = self.clock()
+        return self
+
+    def elapsed(self) -> float:
+        if self._t0 is None:
+            return 0.0
+        return self.clock() - self._t0
+
+    # -- consumption --------------------------------------------------------
+    def next(self, site: str) -> Optional[DeviceFault]:
+        """Pop the next scripted fault for ``site``; None when drained.
+        Timed entries whose offset has elapsed arm into their queues first."""
+        if site not in DEVICE_SITES:
+            raise ValueError(f"unknown device fault site {site!r}")
+        with self._lock:
+            if self._timed and self._t0 is not None:
+                now = self.clock() - self._t0
+                while self._timed and self._timed[0][0] <= now:
+                    _, fault = self._timed.pop(0)
+                    self._queues[fault.site].append(fault)
+            queue = self._queues[site]
+            if not queue:
+                return None
+            fault = queue.pop(0)
+            self.log.append((site, fault))
+            self.timeline.append((self.elapsed(), site, fault))
+            return fault
+
+    def pending(self, site: Optional[str] = None) -> int:
+        with self._lock:
+            timed = len(self._timed) if site is None else sum(
+                1 for _, f in self._timed if f.site == site
+            )
+            if site is not None:
+                return len(self._queues[site]) + timed
+            return sum(len(q) for q in self._queues.values()) + timed
+
+    def clear(self, site: Optional[str] = None) -> int:
+        """Drop un-fired faults (one site, or everything incl. timed
+        entries); returns how many were dropped. The firing log survives."""
+        with self._lock:
+            if site is not None:
+                dropped = len(self._queues[site])
+                dropped += sum(1 for _, f in self._timed if f.site == site)
+                self._queues[site] = []
+                self._timed = [e for e in self._timed if e[1].site != site]
+                return dropped
+            dropped = sum(len(q) for q in self._queues.values()) + len(self._timed)
+            for q in self._queues.values():
+                q.clear()
+            self._timed.clear()
+            return dropped
+
+    # -- wire format (settings/env plumbing for the soak operator) ----------
+    def serialize(self) -> str:
+        """``t=SECONDS,kind=KIND[,n=N][,hang=S]`` entries joined by ``;`` —
+        the shape :meth:`parse` reads back (timed entries only: the soak
+        hands a full timeline to a freshly spawned operator process)."""
+        with self._lock:
+            parts = []
+            for t, f in self._timed:
+                p = f"t={t:g},kind={f.kind}"
+                if f.kind == "dispatch-hang" and f.hang_s != float("inf"):
+                    p += f",hang={f.hang_s:g}"
+                parts.append(p)
+            return ";".join(parts)
+
+    @classmethod
+    def parse(cls, script: str) -> "DeviceFaultPlan":
+        """Inverse of :meth:`serialize`; ``n=`` repeats an entry. Raises on
+        malformed input — a silently dropped chaos script is worse than a
+        loud boot failure."""
+        plan = cls()
+        for part in filter(None, (p.strip() for p in script.split(";"))):
+            kv = dict(
+                item.split("=", 1) for item in part.split(",") if "=" in item
+            )
+            if "kind" not in kv:
+                raise ValueError(f"device fault entry missing kind=: {part!r}")
+            fault = DeviceFault(
+                kind=kv["kind"],
+                hang_s=float(kv.get("hang", "inf")),
+            )
+            fault.site  # validate the kind loudly at parse time
+            t = float(kv.get("t", "0"))
+            for _ in range(int(kv.get("n", "1"))):
+                plan.at(t, fault)
+        return plan
+
+
+#: the process-global injection point the solver seams consult; None (the
+#: production state) short-circuits every seam to a single attribute read
+_DEVICE_PLAN: Optional[DeviceFaultPlan] = None
+
+
+def install_device_faults(plan: Optional[DeviceFaultPlan]) -> Optional[DeviceFaultPlan]:
+    """Install (or, with None, remove) the process-global device-fault plan;
+    returns the previous one. The plan's timed entries arm from install."""
+    global _DEVICE_PLAN
+    previous = _DEVICE_PLAN
+    _DEVICE_PLAN = plan
+    if plan is not None:
+        plan.start()
+    return previous
+
+
+def device_fault(site: str) -> Optional[DeviceFault]:
+    """The solver seams' accessor: pop the next scripted fault for ``site``
+    (None when no plan is installed or its queue is drained)."""
+    plan = _DEVICE_PLAN
+    if plan is None:
+        return None
+    return plan.next(site)
+
+
 class ScriptedTransport:
     """A fake HTTP transport for the client retry tests: wraps a real
     transport callable and applies a FaultPlan in front of it, raising the

@@ -59,6 +59,7 @@ TRIGGER_UNSCHEDULABLE = "unschedulable-pods"
 TRIGGER_FULL_ENCODE = "full-encode-fallback"
 TRIGGER_BREAKER = "breaker-open"
 TRIGGER_GANG_DEFERRED = "gang-deferred"
+TRIGGER_VALIDATION = "validation-rejected"
 
 #: full-encode reasons that are NORMAL operation, not an anomaly: the first
 #: encode of a session, the periodic backstop, and a disabled delta path
@@ -377,6 +378,14 @@ def provisioning_outputs(result, cluster) -> Dict:
         "placements": placements,
         "unschedulable": sorted(set(result.unschedulable)),
         "gang_deferred": sorted(set(getattr(result, "gang_deferred", []) or [])),
+        # validation-firewall evaluations in call order (verdict, backend,
+        # violations): replay installs this sequence as scripted verdicts —
+        # a rejection caused by a transient device fault cannot be
+        # recomputed offline, but its downstream fallback decision must
+        # still replay byte-identically — and the match verdict compares it
+        "validation_events": list(
+            getattr(result, "validation_events", []) or []
+        ),
         "new_nodes": [
             {
                 "name": m.meta.name,

@@ -342,10 +342,12 @@ SOLVE_DURATION = Histogram(
 )
 SOLVE_PHASE = Histogram(
     "karpenter_tpu_solve_phase_seconds",
-    help="Solver phase latency (encode/presolve/stage/solve/decode), "
-         "labeled by phase and by the round's encode mode (delta/full) — "
-         "the continuous view of the incremental-encode win; {phase=stage} "
-         "separates host-to-device staging from encode and solve.",
+    help="Solver phase latency (encode/presolve/stage/solve/decode/"
+         "validate), labeled by phase and by the round's encode mode "
+         "(delta/full) — the continuous view of the incremental-encode "
+         "win; {phase=stage} separates host-to-device staging from encode "
+         "and solve, and {phase=validate} is the placement-validation "
+         "firewall's per-evaluation cost (budgeted < 5% of round p50).",
     registry=REGISTRY,
 )
 RECONCILE_DURATION = Histogram(
@@ -439,6 +441,41 @@ AOT_CACHE_EVENTS = Counter(
          "served by a resident bucket executable), miss (bucket not "
          "resident), compile (an executable was built — or loaded from the "
          "on-disk compilation cache), evict (LRU capacity eviction).",
+    registry=REGISTRY,
+)
+# solver fault domain (solver/validate.py firewall + the kernel-backend
+# circuit breaker in solver/solver.py)
+SOLVER_VALIDATION = Counter(
+    "karpenter_tpu_solver_validation_total",
+    help="Placement-validation firewall verdicts on solver plans before "
+         "bind, labeled by outcome: accepted, rejected (the plan violated a "
+         "hard constraint and the round re-solved on the fallback backend), "
+         "rejected-final (the fallback plan was ALSO invalid — the round "
+         "bound nothing).",
+    registry=REGISTRY,
+)
+VALIDATION_VIOLATIONS = Counter(
+    "karpenter_tpu_validation_violations_total",
+    help="Individual firewall violations by code: capacity, compat, "
+         "taints, double-placement, unknown-pod, unknown-node, gang-split, "
+         "slice-adjacency, diversification, launch-limits.",
+    registry=REGISTRY,
+)
+KERNEL_FAULTS = Counter(
+    "karpenter_tpu_kernel_faults_total",
+    help="Device-path failures observed by the kernel backend, labeled by "
+         "kind: compile-error, dispatch-timeout, dispatch-error, "
+         "device-oom, invalid-plan (count-level validation rejected the "
+         "kernel answer), nonfinite-plan (NaN/Inf costs).",
+    registry=REGISTRY,
+)
+KERNEL_BACKEND_HEALTH = Gauge(
+    "karpenter_tpu_kernel_backend_health",
+    help="Health score of the kernel backend: the fraction of consulted "
+         "executable-bucket breakers currently closed (1.0 = fully "
+         "healthy; 0.0 = every bucket quarantined, all solves degraded to "
+         "the host paths). Per-bucket breaker state is in "
+         "karpenter_tpu_rpc_breaker_state{service=\"kernel\"}.",
     registry=REGISTRY,
 )
 # delta-aware device staging (solver/staging.py DeviceStager): problem

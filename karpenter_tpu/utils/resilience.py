@@ -335,6 +335,12 @@ class BreakerSet:
                 )
             return b
 
+    def breakers(self) -> Dict[str, CircuitBreaker]:
+        """Snapshot of the lazily-created per-endpoint breakers — the
+        kernel-backend health score aggregates their states."""
+        with self._lock:
+            return dict(self._breakers)
+
 
 def resilient_call(
     fn: Callable[[], object],

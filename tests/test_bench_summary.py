@@ -56,6 +56,13 @@ def test_dry_run_last_stdout_line_is_json_summary():
                 "soak_replay_all_matched", "soak_duplicate_launches"):
         assert key in summary
         assert summary[key] is None  # dry-run skips the soak
+    # the ISSUE-15 solver-fault-domain fields ride the summary; the tiny
+    # fault storm RUNS in dry-run, so the verdicts are concrete
+    assert summary["devfault_invalid_bindings"] == 0
+    assert summary["devfault_rounds_completed"] == summary["devfault_rounds_total"]
+    assert summary["devfault_breaker_reclosed"] is True
+    assert summary["devfault_fallback_p50_ms"] is not None
+    assert "devfault_validator_overhead_pct" in summary
     # every stdout line is valid JSON on its own (no partial fragments)
     for ln in lines:
         json.loads(ln)
@@ -129,6 +136,26 @@ class TestArtifactWriter:
         rt = json.loads(json.dumps(artifact, allow_nan=False))["parsed"]
         assert rt["soak_replay_all_matched"] is True
         assert rt["soak_events_per_s"] == 1042.5
+
+    def test_devfault_summary_fields_round_trip(self):
+        # ISSUE-15 satellite: the device-fault-storm verdicts (invalid
+        # bindings, rounds completed, breaker recovery, validator overhead)
+        # survive the artifact writer byte-for-byte
+        summary = json.dumps({
+            "metric": "m", "summary": True,
+            "devfault_rounds_completed": 6,
+            "devfault_rounds_total": 6,
+            "devfault_invalid_bindings": 0,
+            "devfault_fallback_p50_ms": 358.4,
+            "devfault_breaker_reclosed": True,
+            "devfault_validator_overhead_pct": 2.66,
+        })
+        artifact = bench_artifact.build_artifact(15, "cmd", 0, summary + "\n")
+        assert artifact["parsed"] == json.loads(summary)
+        rt = json.loads(json.dumps(artifact, allow_nan=False))["parsed"]
+        assert rt["devfault_breaker_reclosed"] is True
+        assert rt["devfault_invalid_bindings"] == 0
+        assert rt["devfault_validator_overhead_pct"] == 2.66
 
     def test_end_to_end_subprocess_write(self, tmp_path):
         fake = tmp_path / "fakebench.py"

@@ -1,0 +1,875 @@
+"""Solver fault domain (ISSUE 15): placement validation firewall,
+device-path fault injection, and kernel-backend circuit breaking.
+
+Three coupled layers under test:
+
+* ``solver/validate.py`` ``validate_bind_plan`` — the cluster-level
+  firewall every solver plan passes before any bind (plus the property
+  that it NEVER false-rejects a plan a real backend produced);
+* ``utils/faults.py`` ``DeviceFaultPlan`` — scripted compile errors,
+  dispatch hangs, device OOM, NaN/garbage kernel results, staging
+  corruption, consumed by the seams in jax_solver/solver/staging;
+* the kernel-backend circuit breaker (``solver.KERNEL_BOARD``) — per-bucket
+  quarantine of executables that produced invalid/non-finite plans, with a
+  re-compile probe on half-open, degrading to host-lp/greedy and
+  recovering automatically.
+"""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from helpers import make_pod, make_pods, make_provisioner, setup, small_catalog
+
+from karpenter_tpu.api import (
+    ObjectMeta,
+    Pod,
+    Provisioner,
+    Requirement,
+    Resources,
+    Taint,
+    Toleration,
+)
+from karpenter_tpu.api import labels as wk
+from karpenter_tpu.api.settings import Settings
+from karpenter_tpu.cloudprovider import FakeCloudProvider, generate_catalog
+from karpenter_tpu.controllers.provisioning import ProvisioningController
+from karpenter_tpu.solver.encode import encode
+from karpenter_tpu.solver.result import NewNodeSpec, SolveResult
+from karpenter_tpu.solver.solver import (
+    KERNEL_BOARD,
+    GreedySolver,
+    KernelBreakerBoard,
+    TPUSolver,
+)
+from karpenter_tpu.solver.validate import (
+    scripted_verdicts,
+    validate_bind_plan,
+)
+from karpenter_tpu.state import Cluster
+from karpenter_tpu.utils import faults
+from karpenter_tpu.utils.cache import FakeClock
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_state():
+    """Every test starts with no installed device faults and a fresh
+    kernel breaker board; both are process-global."""
+    faults.install_device_faults(None)
+    KERNEL_BOARD.configure(failure_threshold=3, recovery_timeout_s=30.0)
+    yield
+    faults.install_device_faults(None)
+    KERNEL_BOARD.configure(failure_threshold=3, recovery_timeout_s=30.0)
+
+
+# ---------------------------------------------------------------------------
+# DeviceFaultPlan
+# ---------------------------------------------------------------------------
+
+class TestDeviceFaultPlan:
+    def test_site_queues_pop_in_order(self):
+        plan = (
+            faults.DeviceFaultPlan()
+            .garbage_result(2)
+            .nan_result(1)
+            .compile_error(1)
+        )
+        assert plan.pending("result") == 3
+        assert plan.pending("compile") == 1
+        assert plan.next("result").kind == "garbage-result"
+        assert plan.next("result").kind == "garbage-result"
+        assert plan.next("result").kind == "nan-result"
+        assert plan.next("result") is None
+        assert plan.next("compile").kind == "compile-error"
+        assert [s for s, _ in plan.log] == ["result"] * 3 + ["compile"]
+
+    def test_unknown_site_and_kind_are_loud(self):
+        plan = faults.DeviceFaultPlan()
+        with pytest.raises(ValueError):
+            plan.next("nonsense")
+        with pytest.raises(ValueError):
+            faults.DeviceFault(kind="nonsense").site
+
+    def test_clear_drops_unfired(self):
+        plan = faults.DeviceFaultPlan().device_oom(2).staging_corruption(1)
+        assert plan.clear("dispatch") == 2
+        assert plan.pending("dispatch") == 0
+        assert plan.pending() == 1
+        assert plan.clear() == 1
+
+    def test_timed_arming_against_injected_clock(self):
+        clock = FakeClock(100.0)
+        plan = faults.DeviceFaultPlan(clock=clock.now)
+        plan.at(5.0, faults.DeviceFault(kind="garbage-result"))
+        plan.start()
+        assert plan.next("result") is None  # not armed yet
+        clock.step(6.0)
+        assert plan.next("result").kind == "garbage-result"
+        assert plan.next("result") is None
+
+    def test_serialize_parse_round_trip(self):
+        plan = faults.DeviceFaultPlan()
+        plan.at(1.5, faults.DeviceFault(kind="compile-error"))
+        plan.at(3.0, faults.DeviceFault(kind="dispatch-hang", hang_s=0.25))
+        wire = plan.serialize()
+        back = faults.DeviceFaultPlan.parse(wire)
+        assert back.serialize() == wire
+        # n= repeats
+        multi = faults.DeviceFaultPlan.parse("t=0,kind=device-oom,n=3")
+        assert multi.pending("dispatch") == 3
+        with pytest.raises(ValueError):
+            faults.DeviceFaultPlan.parse("t=0,kind=bogus")
+        with pytest.raises(ValueError):
+            faults.DeviceFaultPlan.parse("t=0")
+
+    def test_install_and_global_accessor(self):
+        plan = faults.DeviceFaultPlan().nan_result(1)
+        prev = faults.install_device_faults(plan)
+        assert prev is None
+        assert faults.device_fault("result").kind == "nan-result"
+        assert faults.device_fault("result") is None
+        faults.install_device_faults(None)
+        assert faults.device_fault("result") is None
+
+    def test_settings_validate_rejects_malformed_script(self):
+        with pytest.raises(ValueError):
+            Settings(device_fault_script="t=0,kind=bogus").validate()
+        Settings(device_fault_script="t=0,kind=nan-result,n=2").validate()
+
+
+# ---------------------------------------------------------------------------
+# validate_bind_plan
+# ---------------------------------------------------------------------------
+
+def _greedy_plan(pods, provs, existing=(), daemonsets=()):
+    solver = GreedySolver()
+    result = solver.solve_pods(
+        pods, provs, existing=existing, daemonsets=daemonsets
+    )
+    return result
+
+
+class TestValidateBindPlan:
+    def test_accepts_real_greedy_plan_with_daemonsets(self):
+        provs = setup()
+        ds = [make_pod("ds-agent", cpu="100m", daemonset=True)]
+        pods = make_pods(24, cpu="500m", memory="1Gi")
+        result = _greedy_plan(pods, provs, daemonsets=ds)
+        assert result.new_nodes and not result.unschedulable
+        assert validate_bind_plan(
+            result, batch=pods, round_provs=provs, daemonsets=ds
+        ) == []
+
+    def test_rejects_overpacked_spec(self):
+        provs = setup()
+        pods = make_pods(6, cpu="500m")
+        result = _greedy_plan(pods, provs)
+        spec = result.new_nodes[0]
+        # corrupt the plan: cram far more pods onto the spec than its
+        # instance can hold (the garbage-kernel shape)
+        big = make_pods(4000, prefix="extra", cpu="500m")
+        bad = SolveResult(
+            new_nodes=[NewNodeSpec(option=spec.option,
+                                   pod_names=[p.name for p in big])],
+        )
+        violations = validate_bind_plan(
+            bad, batch=big, round_provs=provs
+        )
+        assert any(v.code == "capacity" for v in violations)
+
+    def test_rejects_zone_selector_mismatch(self):
+        provs = setup()
+        pods = make_pods(4, node_selector={wk.ZONE: "zone-a"})
+        result = _greedy_plan(pods, provs)
+        spec = next(s for s in result.new_nodes)
+        assert spec.option.zone == "zone-a"
+        # find a zone-b option surface by re-solving pinned pods
+        pods_b = make_pods(4, prefix="b", node_selector={wk.ZONE: "zone-b"})
+        result_b = _greedy_plan(pods_b, provs)
+        spec_b = result_b.new_nodes[0]
+        bad = SolveResult(
+            new_nodes=[NewNodeSpec(option=spec_b.option,
+                                   pod_names=[p.name for p in pods])],
+        )
+        violations = validate_bind_plan(bad, batch=pods, round_provs=provs)
+        assert any(v.code == "compat" for v in violations)
+
+    def test_rejects_intolerated_taint(self):
+        tainted = make_provisioner(
+            name="tainted", taints=[Taint(key="gpu", value="true",
+                                          effect="NoSchedule")],
+        )
+        provs = [(tainted, small_catalog())]
+        tol = Toleration(key="gpu", operator="Equal", value="true",
+                         effect="NoSchedule")
+        ok_pods = make_pods(3, tolerations=[tol])
+        result = _greedy_plan(ok_pods, provs)
+        assert result.new_nodes
+        assert validate_bind_plan(
+            result, batch=ok_pods, round_provs=provs
+        ) == []
+        # same placements, but pods WITHOUT the toleration
+        bare = make_pods(3, prefix="bare")
+        bad = SolveResult(new_nodes=[
+            NewNodeSpec(option=result.new_nodes[0].option,
+                        pod_names=[p.name for p in bare]),
+        ])
+        violations = validate_bind_plan(bad, batch=bare, round_provs=provs)
+        assert any(v.code == "taints" for v in violations)
+
+    def test_rejects_double_placement_and_unknown_refs(self):
+        provs = setup()
+        pods = make_pods(4)
+        result = _greedy_plan(pods, provs)
+        opt = result.new_nodes[0].option
+        bad = SolveResult(
+            new_nodes=[
+                NewNodeSpec(option=opt, pod_names=[pods[0].name, pods[0].name]),
+                NewNodeSpec(option=opt, pod_names=["ghost-pod"]),
+            ],
+            existing_assignments={"ghost-node": [pods[1].name]},
+        )
+        codes = {v.code for v in validate_bind_plan(
+            bad, batch=pods, round_provs=provs
+        )}
+        assert "double-placement" in codes
+        assert "unknown-pod" in codes
+        assert "unknown-node" in codes
+
+    def test_existing_node_over_remaining(self):
+        provs = setup()
+        cluster = Cluster()
+        provider = FakeCloudProvider(catalog=small_catalog())
+        controller = ProvisioningController(
+            cluster, provider, solver=GreedySolver(),
+            settings=Settings(batch_idle_duration=0, batch_max_duration=0),
+        )
+        cluster.add_provisioner(provs[0][0])
+        for p in make_pods(4, prefix="seed", cpu="1"):
+            cluster.add_pod(p)
+        controller.reconcile()
+        existing = cluster.existing_capacity()
+        assert existing
+        node = existing[0]
+        flood = make_pods(500, prefix="flood", cpu="1")
+        bad = SolveResult(existing_assignments={
+            node.name: [p.name for p in flood]
+        })
+        violations = validate_bind_plan(
+            bad, batch=flood, round_provs=provs, round_existing=existing,
+        )
+        assert any(v.code == "capacity" for v in violations)
+
+    def test_gang_split_and_atomic_accepted(self):
+        from karpenter_tpu.solver.gang import collect_gangs
+
+        provs = setup()
+        members = [
+            make_pod(f"g-{i}", labels={},
+                     cpu="250m")
+            for i in range(4)
+        ]
+        for p in members:
+            p.meta.annotations = {wk.POD_GROUP: "g",
+                                  wk.POD_GROUP_MIN_MEMBERS: "4"}
+        gangs = collect_gangs(members)
+        result = _greedy_plan(members, provs)
+        assert validate_bind_plan(
+            result, batch=members, round_provs=provs,
+            gangs=gangs, check_gangs=True,
+        ) == []
+        opt = result.new_nodes[0].option
+        split = SolveResult(new_nodes=[
+            NewNodeSpec(option=opt, pod_names=[members[0].name,
+                                               members[1].name]),
+        ])
+        violations = validate_bind_plan(
+            split, batch=members, round_provs=provs,
+            gangs=gangs, check_gangs=True,
+        )
+        assert any(v.code == "gang-split" for v in violations)
+
+    def test_diversification_cap_violation(self):
+        from karpenter_tpu.solver import diversify
+
+        prov = make_provisioner()
+        catalog = generate_catalog(n_types=10)
+        provs = [(prov, catalog)]
+        pods = make_pods(8, prefix="srv", cpu="100m")
+        units = diversify.collect_units(pods, {}, 0.5)
+        assert units and units[0].size == 8
+        spot_opt = None
+        result = _greedy_plan(pods, provs)
+        # build a spot option by probing the encoder directly
+        problem = encode(pods, provs)
+        for o in problem.options:
+            if o.capacity_type == wk.CAPACITY_TYPE_SPOT:
+                spot_opt = o
+                break
+        if spot_opt is None:
+            pytest.skip("catalog generated no spot offerings")
+        cluster = Cluster()
+        concentrated = SolveResult(new_nodes=[
+            NewNodeSpec(option=spot_opt, pod_names=[p.name for p in pods]),
+        ])
+        violations = validate_bind_plan(
+            concentrated, batch=pods, round_provs=provs, cluster=cluster,
+            div_units=units, check_diversification=True,
+        )
+        assert any(v.code == "diversification" for v in violations)
+
+    def test_launch_limits_check(self):
+        prov = make_provisioner(limits=Resources(cpu="4"))
+        provs = [(prov, small_catalog())]
+        cluster = Cluster()
+        pods = make_pods(64, cpu="1")
+        result = _greedy_plan(pods, provs)
+        violations = validate_bind_plan(
+            result, batch=pods, round_provs=provs, cluster=cluster,
+            check_limits=True,
+        )
+        assert any(v.code == "launch-limits" for v in violations)
+        # the cascade path deliberately leaves limits to _apply_solve
+        assert validate_bind_plan(
+            result, batch=pods, round_provs=provs, cluster=cluster,
+        ) == []
+
+    def test_preference_shedding_not_false_rejected(self):
+        # a pod with a PREFERRED zone whose placement landed elsewhere is
+        # legal (solve_pods relaxation sheds preferences); only hard
+        # constraints may reject
+        provs = setup()
+        pods = make_pods(
+            4,
+            requirements=[Requirement.in_values(wk.ZONE, ["zone-a"])],
+        )
+        plain = make_pods(4, prefix="plain", node_selector={wk.ZONE: "zone-b"})
+        result = _greedy_plan(plain, provs)
+        spec = result.new_nodes[0]
+        assert spec.option.zone == "zone-b"
+        # REQUIRED zone-a pods on a zone-b option: hard violation
+        bad = SolveResult(new_nodes=[
+            NewNodeSpec(option=spec.option, pod_names=[p.name for p in pods]),
+        ])
+        violations = validate_bind_plan(bad, batch=pods, round_provs=provs)
+        assert any(v.code == "compat" for v in violations)
+        # ...but the SAME placement of pods whose zone-a wish is merely
+        # PREFERRED is legal: relaxation sheds preferences, and the firewall
+        # judges hard constraints only
+        from karpenter_tpu.api import Requirements as Reqs
+
+        soft = make_pods(4, prefix="soft")
+        for p in soft:
+            p.preferred_affinity_terms = [
+                (1, Reqs([Requirement.in_values(wk.ZONE, ["zone-a"])]))
+            ]
+        soft_plan = SolveResult(new_nodes=[
+            NewNodeSpec(option=spec.option, pod_names=[p.name for p in soft]),
+        ])
+        assert validate_bind_plan(
+            soft_plan, batch=soft, round_provs=provs
+        ) == []
+
+
+class TestNoFalseRejectionsProperty:
+    """The firewall must accept EVERY plan a real backend produces, over
+    random constraint mixes — a false rejection burns a fallback re-solve
+    per round forever."""
+
+    def _random_batch(self, rng, n):
+        pods = []
+        zones = ["zone-a", "zone-b", "zone-c"]
+        for i in range(n):
+            kw = {}
+            r = rng.random()
+            if r < 0.25:
+                kw["node_selector"] = {wk.ZONE: rng.choice(zones)}
+            elif r < 0.4:
+                kw["requirements"] = [
+                    Requirement.in_values(
+                        wk.ZONE, rng.sample(zones, rng.randint(1, 2))
+                    )
+                ]
+            cpu = rng.choice(["100m", "250m", "500m", "1"])
+            mem = rng.choice(["128Mi", "512Mi", "1Gi"])
+            pods.append(make_pod(f"prop-{i}", cpu=cpu, memory=mem, **kw))
+        return pods
+
+    @pytest.mark.parametrize("seed", [0, 1, 2, 3])
+    def test_every_backend_plan_validates(self, seed):
+        import random
+
+        rng = random.Random(seed)
+        provs = setup(n_types=12)
+        ds = [make_pod("ds-prop", cpu="50m", daemonset=True)]
+        pods = self._random_batch(rng, 30)
+        for solver in (GreedySolver(), TPUSolver(latency_budget_s=30.0)):
+            result = solver.solve_pods(pods, provs, daemonsets=ds)
+            violations = validate_bind_plan(
+                result, batch=pods, round_provs=provs, daemonsets=ds,
+            )
+            assert violations == [], (
+                f"false rejection of {type(solver).__name__}: "
+                f"{[v.to_dict() for v in violations]}"
+            )
+
+
+# ---------------------------------------------------------------------------
+# Kernel breaker board
+# ---------------------------------------------------------------------------
+
+class TestKernelBreakerBoard:
+    def test_lifecycle_with_injected_clock(self):
+        clock = FakeClock(0.0)
+        board = KernelBreakerBoard()
+        board.configure(
+            failure_threshold=2, recovery_timeout_s=5.0, clock=clock.now
+        )
+        label = "testbucket"
+        assert board.allows(label) and board.health() == 1.0
+        board.fail(label, "invalid-plan")
+        assert board.allows(label)
+        board.fail(label, "nonfinite-plan")
+        assert board.state(label) == "open"
+        assert not board.allows(label)
+        assert board.health() == 0.0
+        clock.step(6.0)
+        assert board.allows(label)  # half-open probe admitted
+        assert board.state(label) == "half-open"
+        board.ok(label)
+        assert board.state(label) == "closed"
+        assert board.health() == 1.0
+
+    def test_open_quarantines_the_bucket_executable(self, monkeypatch):
+        from karpenter_tpu.solver import solver as solver_mod
+
+        evicted = []
+        monkeypatch.setattr(
+            solver_mod.AOT_CACHE, "evict_bucket",
+            lambda label: evicted.append(label) or 1,
+        )
+        board = KernelBreakerBoard()
+        board.configure(failure_threshold=2)
+        board.fail("bkt", "invalid-plan")
+        assert evicted == []
+        board.fail("bkt", "invalid-plan")  # opens: quarantine fires once
+        assert evicted == ["bkt"]
+        board.fail("bkt", "invalid-plan")  # already open: no re-evict
+        assert evicted == ["bkt"]
+
+
+# ---------------------------------------------------------------------------
+# Device-path faults through the real kernel (quality solver, sync compile)
+# ---------------------------------------------------------------------------
+
+def _quality_solver(**kw):
+    kw.setdefault("latency_budget_s", 30.0)
+    return TPUSolver(**kw)
+
+
+def _fresh_batch(tag, n=40):
+    return make_pods(n, prefix=f"df-{tag}", cpu="1", memory="1Gi")
+
+
+class TestDeviceFaultSeams:
+    def test_garbage_result_rejected_and_breaker_trips(self):
+        KERNEL_BOARD.configure(failure_threshold=2)
+        provs = setup(n_types=6)
+        solver = _quality_solver()
+        plan = faults.DeviceFaultPlan().garbage_result(3)
+        faults.install_device_faults(plan)
+        states = []
+        for k in range(3):
+            result = solver.solve_pods(_fresh_batch(f"g{k}"), provs)
+            # whatever backend answered, the round completed validly
+            assert not result.unschedulable
+            states.append(set(KERNEL_BOARD.states().values()))
+        # two invalid plans opened the breaker; the third round never
+        # dispatched (the bucket is quarantined), so one fault is unfired
+        assert "open" in states[-1]
+        assert len(plan.log) == 2
+        assert plan.pending("result") == 1
+
+    def test_breaker_recloses_with_recompile_probe(self):
+        from karpenter_tpu.solver.jax_solver import AOT_CACHE
+
+        KERNEL_BOARD.configure(failure_threshold=1, recovery_timeout_s=0.2)
+        provs = setup(n_types=6)
+        solver = _quality_solver()
+        faults.install_device_faults(
+            faults.DeviceFaultPlan().garbage_result(1)
+        )
+        solver.solve_pods(_fresh_batch("r0"), provs)
+        faults.install_device_faults(None)
+        assert "open" in set(KERNEL_BOARD.states().values())
+        compiles0 = AOT_CACHE.stats["compiles"]
+        time.sleep(0.25)  # past the recovery timeout: half-open
+        result = solver.solve_pods(_fresh_batch("r1"), provs)
+        assert not result.unschedulable
+        assert set(KERNEL_BOARD.states().values()) == {"closed"}
+        # the quarantine evicted the executable, so the probe re-compiled
+        assert AOT_CACHE.stats["compiles"] > compiles0
+
+    def test_nan_result_counts_nonfinite_fault(self):
+        from karpenter_tpu.utils import metrics
+
+        def kernel_faults():
+            with metrics.KERNEL_FAULTS._lock:
+                return dict(metrics.KERNEL_FAULTS._values)
+
+        before = kernel_faults().get((("kind", "nonfinite-plan"),), 0.0)
+        provs = setup(n_types=6)
+        solver = _quality_solver()
+        faults.install_device_faults(faults.DeviceFaultPlan().nan_result(1))
+        result = solver.solve_pods(_fresh_batch("nan"), provs)
+        assert not result.unschedulable
+        after = kernel_faults().get((("kind", "nonfinite-plan"),), 0.0)
+        assert after == before + 1
+
+    def test_dispatch_hang_hits_deadline_and_host_answers(self):
+        provs = setup(n_types=6)
+        solver = _quality_solver(dispatch_timeout_s=0.3)
+        # warm the bucket first so the hang round isn't dominated by compile
+        solver.solve_pods(_fresh_batch("warm"), provs)
+        faults.install_device_faults(
+            faults.DeviceFaultPlan().dispatch_hang(seconds=10.0, n=1)
+        )
+        t0 = time.perf_counter()
+        result = solver.solve_pods(_fresh_batch("hang"), provs)
+        elapsed = time.perf_counter() - t0
+        assert not result.unschedulable
+        assert elapsed < 5.0  # rescued by the deadline, not the 10s hang
+        states = KERNEL_BOARD.states()
+        assert states  # the bucket was consulted
+
+    def test_device_oom_degrades_gracefully(self):
+        provs = setup(n_types=6)
+        solver = _quality_solver()
+        faults.install_device_faults(faults.DeviceFaultPlan().device_oom(1))
+        result = solver.solve_pods(_fresh_batch("oom"), provs)
+        assert not result.unschedulable
+
+    def test_compile_error_degrades_gracefully(self):
+        from karpenter_tpu.solver.jax_solver import AOT_CACHE
+
+        provs = setup(n_types=6)
+        solver = _quality_solver()
+        # resolve the batch's bucket, then QUARANTINE-EVICT it so the next
+        # solve must compile — which the injected fault fails
+        clean = solver.solve_pods(_fresh_batch("ce0"), provs)
+        label = clean.stats.get("aot_bucket")
+        if label:
+            AOT_CACHE.evict_bucket(label)
+        faults.install_device_faults(
+            faults.DeviceFaultPlan().compile_error(1)
+        )
+        result = solver.solve_pods(_fresh_batch("ce1"), provs)
+        assert not result.unschedulable  # a host backend completed the round
+        # the seam itself surfaces the injected error loudly to compile()
+        # (injection fires before any XLA work, so a never-used key is cheap)
+        from karpenter_tpu.solver.jax_solver import bucket_key
+
+        faults.install_device_faults(
+            faults.DeviceFaultPlan().compile_error(1)
+        )
+        with pytest.raises(faults.InjectedDeviceError):
+            AOT_CACHE.compile(bucket_key(4, 4, 0, 8, 2, 2, 4))
+
+    def test_staging_corruption_caught_by_validation(self):
+        provs = setup(n_types=6)
+        solver = _quality_solver()
+        faults.install_device_faults(
+            faults.DeviceFaultPlan().staging_corruption(1)
+        )
+        result = solver.solve_pods(_fresh_batch("st"), provs)
+        # the corrupted-tensor plan must never surface: the count validator
+        # (or the cost race) rejects it and a host path answers
+        assert not result.unschedulable
+        plan_log = faults._DEVICE_PLAN
+        faults.install_device_faults(None)
+
+
+# ---------------------------------------------------------------------------
+# Controller firewall: rejection, fallback, refusal
+# ---------------------------------------------------------------------------
+
+class _CorruptingSolver(GreedySolver):
+    """Solves for real, then doubles the first spec's pod list — a
+    plausible-shaped plan with double placements + overpacking (what a
+    miscompiled kernel that passes no count validation would emit)."""
+
+    def __init__(self):
+        super().__init__()
+        self.corrupt_rounds = 1
+
+    def solve_pods(self, pods, provisioners, **kw):
+        result = super().solve_pods(pods, provisioners, **kw)
+        if self.corrupt_rounds > 0 and result.new_nodes:
+            self.corrupt_rounds -= 1
+            spec = result.new_nodes[0]
+            names = list(spec.pod_names)
+            result.new_nodes[0] = NewNodeSpec(
+                option=spec.option, pod_names=names + names,
+            )
+        return result
+
+
+def _controller(solver=None, n_types=12, validation=True, cluster=None):
+    cluster = cluster or Cluster()
+    provider = FakeCloudProvider(catalog=generate_catalog(n_types=n_types))
+    controller = ProvisioningController(
+        cluster, provider, solver=solver or GreedySolver(),
+        settings=Settings(
+            batch_idle_duration=0, batch_max_duration=0,
+            solver_validation_enabled=validation,
+        ),
+    )
+    cluster.add_provisioner(make_provisioner())
+    return cluster, controller
+
+
+class TestControllerFirewall:
+    def test_clean_round_records_accepted_event(self):
+        cluster, controller = _controller()
+        for p in make_pods(10, prefix="cln"):
+            cluster.add_pod(p)
+        result = controller.reconcile()
+        assert len(result.bound) == 10
+        assert result.validation_events
+        assert all(e["verdict"] == "accepted" for e in result.validation_events)
+
+    def test_invalid_plan_rejected_and_fallback_binds(self):
+        from karpenter_tpu.utils.decisions import DECISIONS
+
+        solver = _CorruptingSolver()
+        cluster, controller = _controller(solver=solver)
+        for p in make_pods(10, prefix="rej"):
+            cluster.add_pod(p)
+        result = controller.reconcile()
+        # the corrupted plan never bound: the fallback re-solve placed
+        # every pod exactly once
+        assert len(result.bound) == 10
+        assert not result.unschedulable
+        verdicts = [e["verdict"] for e in result.validation_events]
+        assert "rejected" in verdicts
+        assert verdicts[-1] == "accepted"  # the fallback plan cleared
+        rejected = next(
+            e for e in result.validation_events if e["verdict"] == "rejected"
+        )
+        assert any(
+            v["code"] in ("double-placement", "capacity")
+            for v in rejected["violations"]
+        )
+        # per-violation decision records landed in the audit log
+        recs = DECISIONS.query(kind="validation")
+        assert any(r.outcome == "rejected" for r in recs)
+        # no pod is bound twice on the actual cluster
+        nodes_of = [p.node_name for p in cluster.pods.values()]
+        assert len(nodes_of) == len(set(p.name for p in cluster.pods.values()))
+
+    def test_validation_disabled_trusts_backends(self):
+        solver = _CorruptingSolver()
+        cluster, controller = _controller(solver=solver, validation=False)
+        for p in make_pods(6, prefix="off"):
+            cluster.add_pod(p)
+        result = controller.reconcile()
+        assert result.validation_events == []
+
+    def test_scripted_double_rejection_binds_nothing(self):
+        cluster, controller = _controller()
+        for p in make_pods(6, prefix="fin"):
+            cluster.add_pod(p)
+        script = [
+            {"round": 0, "verdict": "rejected", "backend": "kernel",
+             "violations": [{"code": "capacity", "detail": "scripted"}],
+             "fallback": "greedy"},
+            {"round": 1, "verdict": "rejected-final", "backend": "greedy",
+             "violations": [{"code": "capacity", "detail": "scripted"}]},
+        ]
+        with scripted_verdicts(script):
+            result = controller.reconcile()
+        assert result.bound == {}
+        assert len(result.unschedulable) == 6
+        # the pods are still pending — the next (clean) round places them
+        result2 = controller.reconcile()
+        assert len(result2.bound) == 6
+
+
+# ---------------------------------------------------------------------------
+# Sustained fault storm (soak-style): zero invalid bindings, zero
+# permanently-unschedulable pods, breaker recovery
+# ---------------------------------------------------------------------------
+
+class TestFaultStorm:
+    def _audit(self, cluster):
+        """Independent post-bind audit (same oracle the bench uses)."""
+        from karpenter_tpu.api.requirements import Requirements
+        from karpenter_tpu.api.taints import tolerates_all
+
+        bad = 0
+        by_node = {}
+        for pod in cluster.pods.values():
+            if pod.node_name is not None:
+                by_node.setdefault(pod.node_name, []).append(pod)
+        for node_name, pods in by_node.items():
+            node = cluster.nodes.get(node_name)
+            if node is None:
+                bad += len(pods)
+                continue
+            total = Resources(pods=len(pods))
+            surface = Requirements.from_labels(node.meta.labels)
+            for pod in pods:
+                total = total + pod.requests
+                if not tolerates_all(list(pod.tolerations), tuple(node.taints)):
+                    bad += 1
+                elif not any(
+                    surface.compatible(t)
+                    for t in pod.scheduling_requirement_terms()
+                ):
+                    bad += 1
+            if not total.fits(node.allocatable):
+                bad += 1
+        return bad
+
+    def test_storm_yields_zero_invalid_bindings_and_recovers(self):
+        KERNEL_BOARD.configure(failure_threshold=2, recovery_timeout_s=0.2)
+        solver = _quality_solver()
+        cluster, controller = _controller(solver=solver)
+        storm = [
+            faults.DeviceFaultPlan().garbage_result(1),
+            faults.DeviceFaultPlan().nan_result(1),
+            faults.DeviceFaultPlan().staging_corruption(1),
+            faults.DeviceFaultPlan().device_oom(1),
+            faults.DeviceFaultPlan().dispatch_hang(seconds=5.0, n=1),
+            faults.DeviceFaultPlan().compile_error(1),
+        ]
+        solver.dispatch_timeout_s = 0.3
+        tripped = False
+        for r, plan in enumerate(storm):
+            for p in make_pods(30, prefix=f"storm{r}", cpu="1", memory="1Gi"):
+                cluster.add_pod(p)
+            faults.install_device_faults(plan)
+            controller.reconcile()
+            faults.install_device_faults(None)
+            assert self._audit(cluster) == 0, f"invalid binding in round {r}"
+            if any(s != "closed" for s in KERNEL_BOARD.states().values()):
+                tripped = True
+        assert tripped, "the storm never tripped the kernel breaker"
+        # zero permanently-unschedulable: everything pending drains once the
+        # faults clear
+        time.sleep(0.25)
+        for _ in range(3):
+            if not cluster.pending_pods():
+                break
+            controller.reconcile()
+        assert cluster.pending_pods() == []
+        assert self._audit(cluster) == 0
+        # and the breaker re-closes on clean solves
+        for k in range(3):
+            for p in make_pods(30, prefix=f"rec{k}", cpu="1", memory="1Gi"):
+                cluster.add_pod(p)
+            controller.reconcile()
+            if KERNEL_BOARD.health() == 1.0:
+                break
+            time.sleep(0.25)
+        assert KERNEL_BOARD.health() == 1.0
+
+
+# ---------------------------------------------------------------------------
+# Flight recorder + replay: a degraded round reproduces byte-identically
+# ---------------------------------------------------------------------------
+
+class TestDegradedRoundReplay:
+    def test_rejected_round_capsule_replays_byte_identically(self):
+        from karpenter_tpu.replay import replay_capsule
+        from karpenter_tpu.utils.flightrecorder import (
+            FLIGHT,
+            TRIGGER_VALIDATION,
+        )
+
+        FLIGHT.configure(8)
+        try:
+            solver = _CorruptingSolver()
+            cluster, controller = _controller(solver=solver)
+            for p in make_pods(8, prefix="cap"):
+                cluster.add_pod(p)
+            result = controller.reconcile()
+            assert len(result.bound) == 8
+            capsule = FLIGHT.latest("provisioning")
+            assert capsule is not None
+            assert TRIGGER_VALIDATION in capsule["anomalies"]
+            events = capsule["outputs"]["validation_events"]
+            assert any(e["verdict"] == "rejected" for e in events)
+            # two digests: the rejected solve + the fallback re-solve
+            assert len(capsule["outputs"]["problem_digests"]) >= 2
+            # replay offline on the greedy backend: the scripted verdicts
+            # force the same rejection, the fallback decision reproduces,
+            # and the whole round matches byte-for-byte
+            report = replay_capsule(capsule, solver="greedy")
+            assert report["diffs"]["validation_match"], report["diffs"]
+            assert report["match"], report
+        finally:
+            FLIGHT.clear()
+
+    def test_clean_round_capsule_carries_accepted_events(self):
+        from karpenter_tpu.replay import replay_capsule
+        from karpenter_tpu.utils.flightrecorder import FLIGHT
+
+        FLIGHT.configure(8)
+        try:
+            cluster, controller = _controller()
+            for p in make_pods(5, prefix="cl"):
+                cluster.add_pod(p)
+            controller.reconcile()
+            capsule = FLIGHT.latest("provisioning")
+            events = capsule["outputs"]["validation_events"]
+            assert events and all(e["verdict"] == "accepted" for e in events)
+            report = replay_capsule(capsule, solver="greedy")
+            assert report["match"], report
+        finally:
+            FLIGHT.clear()
+
+
+# ---------------------------------------------------------------------------
+# Churn-script integration
+# ---------------------------------------------------------------------------
+
+class TestChurnDeviceFaults:
+    def test_generate_includes_bursts_and_script_round_trips(self):
+        from karpenter_tpu.soak.churn import ChurnScript
+
+        script = ChurnScript.generate(
+            seed=7, duration_s=60.0, rate_hz=20.0, live_pods=30,
+            device_fault_every_s=10.0,
+        )
+        bursts = [e for e in script.events if e.kind == "device-fault-burst"]
+        assert bursts
+        wire = script.device_fault_script()
+        assert wire
+        plan = faults.DeviceFaultPlan.parse(wire)
+        assert plan.pending() == sum(int(b.get("n", 1)) for b in bursts)
+        # determinism: the same seed derives the same bursts
+        script2 = ChurnScript.generate(
+            seed=7, duration_s=60.0, rate_hz=20.0, live_pods=30,
+            device_fault_every_s=10.0,
+        )
+        assert script2.device_fault_script() == wire
+
+    def test_operator_installs_plan_from_settings(self):
+        from karpenter_tpu.operator import Operator
+
+        op = Operator.new(
+            settings=Settings(
+                batch_idle_duration=0, batch_max_duration=0,
+                device_fault_script="t=0,kind=garbage-result,n=2",
+            ),
+        )
+        try:
+            plan = faults._DEVICE_PLAN
+            assert plan is not None
+            assert plan.pending("result") == 2
+        finally:
+            op.close()
+            faults.install_device_faults(None)
