@@ -49,6 +49,14 @@ floor:
   the kernel breaker must trip AND re-close after the faults clear
   (quarantine-evict → half-open re-compile probe), and the validation
   firewall's clean-path overhead must stay < 5% of round p50.
+* ``lifecycle_overhead`` (ISSUE 16): the pod-lifecycle stage tracker's
+  stamping cost must stay < 5% of round p50. The verdict uses the
+  deterministic arm (measured per-pod mark-sequence cost scaled to the
+  scenario's pod count) because the ~2% true effect is below round-to-round
+  ABBA noise; the raw ABBA pct is reported alongside. The tracked rounds
+  must actually produce waterfalls, and the per-stage durations must sum
+  to the end-to-end pod-ready latency (ratio ~1.0 — the attribution
+  accounts for the FULL latency by construction).
 * ``soak`` (ISSUE 11): the scaled chaos soak (sustained churn over the
   real-HTTP stack incl. one operator SIGKILL+restart and one apiserver
   restart) must finish with ZERO invariant violations — which covers the
@@ -162,6 +170,9 @@ def run_checks(full: bool = False) -> list:
         n_pods=20_000 if full else 2_000, n_types=30
     )
     gangtopo = bench.bench_gang_topology()
+    lifecycle = bench.bench_lifecycle_overhead(
+        repeats=6, n_pods=2_000 if full else 300
+    )
     race = bench.bench_kernel_race()
     race_topo = bench.bench_kernel_race_topology()
     # the chaos soak arm: acceptance-length (>=60 s churn) either way — the
@@ -178,6 +189,7 @@ def run_checks(full: bool = False) -> list:
         "spot_churn": churn, "cell_decompose": cells,
         "cell_fleet": cells_fleet, "gang_topology": gangtopo,
         "device_staging": staging, "device_faults": devfault,
+        "lifecycle_overhead": lifecycle,
         "cold_solve": cold, "kernel_race": race,
         "kernel_race_topology": race_topo,
         "kernel_race_topology_50k": race_topo_50k,
@@ -449,6 +461,31 @@ def run_checks(full: bool = False) -> list:
         failures.append(
             f"device_faults: validation-firewall clean-path overhead {vo}% "
             ">= the 5% budget of round p50"
+        )
+    # -- lifecycle-attribution gate (ISSUE 16) --------------------------------
+    lo = lifecycle.get("stamping_overhead_est_pct")
+    if lo is None or lo >= 5.0:
+        failures.append(
+            f"lifecycle_overhead: tracker stamping cost {lo}% of round p50 "
+            f"(deterministic per-pod arm, "
+            f"{lifecycle.get('stamping_per_pod_us')}us/pod) >= the 5% budget"
+        )
+    if (lifecycle.get("waterfalls") or 0) < 1:
+        failures.append(
+            "lifecycle_overhead: tracked rounds produced no completed "
+            "waterfalls — the scenario regressed, the gate is vacuous"
+        )
+    ratio = lifecycle.get("stage_sum_over_e2e")
+    if ratio is None or abs(ratio - 1.0) > 0.05:
+        failures.append(
+            f"lifecycle_overhead: per-stage durations sum to {ratio}x the "
+            "end-to-end pod-ready latency (must be ~1.0: the waterfall "
+            "attribution is leaking unaccounted time)"
+        )
+    if not lifecycle.get("dominant_stage"):
+        failures.append(
+            "lifecycle_overhead: no dominant stage named — stage "
+            "attribution produced no segments"
         )
     # -- chaos soak gate (ISSUE 11) ------------------------------------------
     if soak.get("skipped_busy_box"):

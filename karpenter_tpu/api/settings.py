@@ -227,6 +227,23 @@ class Settings:
     # (reference: 5m). Soak/chaos runs shrink it so instances orphaned by an
     # operator crash are adopted or collected within the run.
     garbage_collect_interval: float = 300.0
+    # pod-lifecycle latency attribution (utils/lifecycle.py,
+    # /debug/lifecycle): per-pod stage waterfalls from watch intake to bind,
+    # feeding karpenter_tpu_pod_lifecycle_stage_seconds{stage} and
+    # karpenter_tpu_pod_ready_seconds. Off disables all marks (the bench
+    # overhead guard's control arm).
+    lifecycle_tracking_enabled: bool = True
+    # completed waterfalls retained for /debug/lifecycle?pod= and the soak
+    # monitor's dominant-stage attribution; 0 keeps none (histograms and the
+    # SLO engine still observe every completion).
+    lifecycle_retention: int = 4096
+    # pod-ready SLO objective (utils/slo.py): a completed pod counts GOOD
+    # when its intake-to-bind latency is <= this many seconds...
+    slo_pod_ready_p99_s: float = 60.0
+    # ...and the objective targets this fraction of pods good; the error
+    # budget is (1 - target), burned as karpenter_tpu_slo_burn_rate{slo,
+    # window} over fast (5m) and slow (1h) windows.
+    slo_pod_ready_target_frac: float = 0.99
 
     def validate(self) -> None:
         if not self.cluster_name:
@@ -316,6 +333,14 @@ class Settings:
             raise ValueError("watchQueueCapacity must be >= 1")
         if self.garbage_collect_interval <= 0:
             raise ValueError("garbageCollectInterval must be > 0")
+        if self.lifecycle_retention < 0:
+            raise ValueError(
+                "lifecycleRetention must be >= 0 (0 keeps no completed waterfalls)"
+            )
+        if self.slo_pod_ready_p99_s <= 0:
+            raise ValueError("sloPodReadyP99S must be > 0")
+        if not 0 < self.slo_pod_ready_target_frac < 1:
+            raise ValueError("sloPodReadyTargetFrac must be in (0, 1)")
 
     # -- config system (reference: karpenter-global-settings ConfigMap,
     # settings.go:40-93; env/flag ingestion in the operator bootstrap) -------

@@ -43,6 +43,7 @@ from ..solver.solver import GreedySolver, Solver, TPUSolver
 from ..state.cluster import Cluster
 from ..utils import metrics
 from ..utils.decisions import DECISIONS
+from ..utils.lifecycle import LIFECYCLE, track_cluster_for_pruning
 from ..utils.events import Recorder
 from ..utils.resilience import RetryPolicy, retry_policy_from_settings
 from .preemption import MAX_PREEMPTORS_PER_ROUND, PreemptionPlanner, Preemptor
@@ -255,6 +256,9 @@ class ProvisioningController:
         # by construction (every entry starts at gang_restart_boost_rounds).
         self._gang_restart_boost: Dict[str, int] = {}
         cluster.watch(self._on_event)
+        # lifecycle pruning: in-flight waterfalls for pods this cluster no
+        # longer holds as pending are swept pre-scrape (deleted mid-flight)
+        track_cluster_for_pruning(cluster)
 
     @property
     def _intake(self):
@@ -311,6 +315,9 @@ class ProvisioningController:
                 if obj.name not in self._pending_seen:
                     self._pending_seen.add(obj.name)
                     self.batcher.note_arrival()
+                # first-seen-wins: the HTTP applier may have stamped it
+                # already; in-process mode this IS the intake boundary
+                LIFECYCLE.intake(obj.name)
             else:
                 self._pending_seen.discard(obj.name)
 
@@ -327,6 +334,7 @@ class ProvisioningController:
                 if pod.name not in self._pending_seen:
                     self._pending_seen.add(pod.name)
                     self.batcher.note_arrival()
+                LIFECYCLE.intake(pod.name)
 
     # -- the reconcile loop body -------------------------------------------
     def reconcile(self) -> ProvisioningResult:
@@ -351,6 +359,10 @@ class ProvisioningController:
                     result = self._reconcile(cap)
                     if cap.captured:
                         cap.set_outputs_provisioning(result, self.cluster)
+                        # the round's completed lifecycle waterfalls ride
+                        # the capsule as forensic output (excluded from the
+                        # replay byte-match like aot_solves)
+                        cap.set_lifecycle_marks(LIFECYCLE.drain_round())
                 except BaseException as e:
                     # finish() must ALWAYS run (it releases the builder's
                     # thread-local decision tee) — including for
@@ -364,7 +376,21 @@ class ProvisioningController:
     def _reconcile(self, cap=None) -> ProvisioningResult:
         t0 = time.perf_counter()
         batch_gen = self.batcher.generation
+        batch_armed = self.batcher._first
         pods = self.cluster.pending_pods()
+        if pods:
+            if batch_armed is not None:
+                # the pod batch window's arming delay — the single largest
+                # known pod-ready contributor, finally visible on /metrics
+                metrics.BATCH_WAIT.observe(
+                    max(0.0, time.monotonic() - batch_armed), {"batcher": "pod"}
+                )
+            names = [p.name for p in pods]
+            for n in names:
+                # backstop for pods seeded before the watch delivered them
+                # (idempotent: first-seen-wins)
+                LIFECYCLE.intake(n)
+            LIFECYCLE.mark_many(names, "batch_flushed")
         self._fw_events = []
         self._fw_clean = None
         self._fw_eval_s = 0.0
@@ -594,6 +620,7 @@ class ProvisioningController:
                     div_retries < self._DIVERSIFY_RETRIES and not div_fallback
                 ),
             )
+            LIFECYCLE.mark_many([p.name for p in batch], "validated")
             limit_hit, ice_failed = self._apply_solve(solve, result, round_provs)
             retry_ice = bool(ice_failed) and ice_retries < self._ICE_RETRIES
             if retry_ice:
@@ -954,6 +981,8 @@ class ProvisioningController:
         batch into cells, fans per-cell solves out over a host worker pool
         (per-cell solver clones + EncodeSessions), then runs the global
         arbitration pass over the residue."""
+        batch_names = [p.name for p in batch]
+        LIFECYCLE.mark_many(batch_names, "solve_dispatch")
         if self.cells is None:
             solve = self.solver.solve_pods(
                 batch, round_provs, existing=round_existing,
@@ -970,9 +999,16 @@ class ProvisioningController:
         # answered (kernel, host LP, greedy, the sharded merge), the plan is
         # re-checked against cluster-level hard constraints before the gates
         # consume it; an invalid plan re-solves on the fallback backend
-        return self._backend_firewall(
+        solve = self._backend_firewall(
             solve, batch, round_provs, round_existing, daemonsets, cap
         )
+        # the backend that produced the plan the gates will consume — a
+        # firewall fallback re-solve stamps the FALLBACK backend, the one
+        # whose answer actually placed the pod
+        LIFECYCLE.mark_many(
+            batch_names, "solve_result", backend=self._backend_name(solve)
+        )
+        return solve
 
     def _solve_round_sharded(
         self, batch, provisioners, round_provs, round_existing, daemonsets, cap
@@ -997,6 +1033,7 @@ class ProvisioningController:
         t0 = time.perf_counter()
         router = self.cells
         plan = router.plan_round(batch, provisioners)
+        LIFECYCLE.mark_many([p.name for p in batch], "cell_routed")
         if (
             self.settings.cell_max_pods
             and plan.max_cell_pods > self.settings.cell_max_pods
@@ -2223,7 +2260,7 @@ class ProvisioningController:
             )
             self._gang_wait.pop(name, None)
 
-    def _bind(self, pod_name: str, node_name: str) -> None:
+    def _bind(self, pod_name: str, node_name: str) -> bool:
         """Bind a pod and synchronously retire it from the delta session's
         encoded set. The controller must not depend on watch delivery to
         learn about its OWN binds: cascade re-solves within one reconcile
@@ -2241,18 +2278,21 @@ class ProvisioningController:
         try:
             self.cluster.bind_pod(pod_name, node_name)
         except KeyError:
-            return  # in-process store: pod gone
+            LIFECYCLE.discard(pod_name)
+            return False  # in-process store: pod gone
         except RuntimeError as e:
             if "404" in str(e):
                 # HTTP-mode not-found; retire it from the session too — the
                 # DELETED watch event may have been consumed pre-quiesce
                 self._pending_seen.discard(pod_name)
-                return
+                LIFECYCLE.discard(pod_name)
+                return False
             raise
         pod = self.cluster.pods.get(pod_name)
         if pod is not None:
             self._intake.pod_event("DELETED", pod)
         self._pending_seen.discard(pod_name)
+        return True
 
     def _apply_solve(
         self,
@@ -2267,14 +2307,17 @@ class ProvisioningController:
         Every verdict lands in the decision audit log (utils/decisions.py)."""
         for node_name, pod_names in solve.existing_assignments.items():
             names = list(pod_names)
+            bound_here = []
             for i, pod_name in enumerate(names):
-                self._bind(pod_name, node_name)
+                if self._bind(pod_name, node_name):
+                    bound_here.append(pod_name)
                 result.bound[pod_name] = node_name
                 metrics.PODS_SCHEDULED.inc()
                 DECISIONS.record(
                     "placement", "existing-node", pod=pod_name, node=node_name,
                     value=float(len(names)) if i == 0 else 0.0,
                 )
+            LIFECYCLE.complete_many(bound_here, node=node_name)
 
         # limits phase is serial: accounting is order-dependent
         usage: Dict[str, Resources] = {}
@@ -2313,6 +2356,8 @@ class ProvisioningController:
         # launch phase: concurrent workers feed the provider's CreateFleet
         # batcher, so same-shape machines coalesce into one cloud call
         # (reference: parallel machine launches + createfleet.go batching)
+        for spec in launchable:
+            LIFECYCLE.mark_many(spec.pod_names, "launch_issued")
         outcomes = self._launch_all(launchable)
         ice_failed: set = set()
         for spec, outcome in zip(launchable, outcomes):
@@ -2358,6 +2403,7 @@ class ProvisioningController:
             result.nodes.append(node)
             metrics.NODES_CREATED.inc({"provisioner": prov.name})
             pods = list(spec.pod_names)
+            LIFECYCLE.mark_many(pods, "node_ready")
             # one placement explanation per SPEC, shared by its pods: the
             # chosen offering plus the top-k rejected cheaper alternatives
             # with reject reasons — the "/debug/decisions?pod=" answer to
@@ -2380,8 +2426,10 @@ class ProvisioningController:
                 "nomination", "launched", node=node.name,
                 details={**details, "pods": len(pods)},
             )
+            bound_here = []
             for i, pod_name in enumerate(pods):
-                self._bind(pod_name, node.name)
+                if self._bind(pod_name, node.name):
+                    bound_here.append(pod_name)
                 result.bound[pod_name] = node.name
                 metrics.PODS_SCHEDULED.inc()
                 DECISIONS.record(
@@ -2389,6 +2437,7 @@ class ProvisioningController:
                     details=details,
                     value=float(len(pods)) if i == 0 else 0.0,
                 )
+            LIFECYCLE.complete_many(bound_here, node=node.name)
         return limit_hit, ice_failed
 
     def _launch(self, spec: NewNodeSpec, create_fn=None) -> Tuple[Machine, Node]:

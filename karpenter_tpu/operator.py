@@ -98,6 +98,24 @@ class Operator:
             settings.flight_recorder_capacity,
             dump_dir=settings.flight_recorder_dump_dir or None,
         )
+        # pod-lifecycle attribution tracker + SLO burn-rate engine (both
+        # process-global like DECISIONS/FLIGHT): the tracker stamps per-pod
+        # stage waterfalls, completions feed the pod_ready objective, and a
+        # pre-scrape refresher exports the burn/budget gauges
+        from .utils import slo
+        from .utils.lifecycle import LIFECYCLE
+
+        LIFECYCLE.configure(
+            enabled=settings.lifecycle_tracking_enabled,
+            retention=settings.lifecycle_retention,
+        )
+        slo.SLO.configure({
+            "pod_ready_p99": (
+                settings.slo_pod_ready_p99_s,
+                settings.slo_pod_ready_target_frac,
+            ),
+        })
+        slo.install_exporter()
         # risk-aware spot capacity pools: the risk cache feeds offering
         # interruption probabilities (provider stamping), the solver's risk
         # penalty, and the rebalance controller's pool choices

@@ -545,7 +545,10 @@ def replay_capsule(
     # (Process-local metrics ARE still touched by a replayed round; run the
     # CLI out-of-process when pristine gauges matter.)
     replay_log = DecisionLog()
-    with guard, flightrecorder.suppressed(), redirect_decisions(replay_log), \
+    from .utils import lifecycle as _lifecycle
+
+    with guard, flightrecorder.suppressed(), _lifecycle.suppressed(), \
+            redirect_decisions(replay_log), \
             tee_decisions() as decision_tee, log_context(reconcile_id=rid):
         cluster = build_cluster(capsule)
         provider = CapsuleCloudProvider(capsule)

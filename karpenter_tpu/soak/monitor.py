@@ -136,6 +136,12 @@ class InvariantMonitor:
         self.mem_samples: List[Tuple[float, float, float]] = []
         self.loop_lag_max_s = 0.0
         self.backpressure: Dict[str, float] = {}
+        # lifecycle stage attribution, scraped from the operator's
+        # karpenter_tpu_pod_lifecycle_stage_seconds histogram: cumulative
+        # _sum/_count per stage label (max across scrapes — counters only
+        # grow within one incarnation)
+        self.stage_sums: Dict[str, float] = {}
+        self.stage_counts: Dict[str, float] = {}
         self.start_times_seen: set = set()
         self.scrape_failures = 0
         self._cluster = None
@@ -204,6 +210,16 @@ class InvariantMonitor:
                 action = labels.get("action", "")
                 self.backpressure[action] = max(
                     self.backpressure.get(action, 0.0), value
+                )
+            elif name == "karpenter_tpu_pod_lifecycle_stage_seconds_sum":
+                stage = labels.get("stage", "")
+                self.stage_sums[stage] = max(
+                    self.stage_sums.get(stage, 0.0), value
+                )
+            elif name == "karpenter_tpu_pod_lifecycle_stage_seconds_count":
+                stage = labels.get("stage", "")
+                self.stage_counts[stage] = max(
+                    self.stage_counts.get(stage, 0.0), value
                 )
         if rss is not None and start is not None:
             self.mem_samples.append((now, start, rss))
@@ -281,10 +297,23 @@ class InvariantMonitor:
         slope, segments = memory_slope_bps(self.mem_samples)
         p50 = _percentile(self.ready_latencies, 0.50)
         p99 = _percentile(self.ready_latencies, 0.99)
+        # dominant lifecycle stage: where the aggregate pod wall-clock went
+        # (scraped stage _sum totals) — a p99 violation names its suspect
+        # instead of just tripping
+        dominant = (
+            max(self.stage_sums, key=self.stage_sums.get)
+            if self.stage_sums else ""
+        )
         violations: List[str] = []
         if p99 is not None and p99 > self.ready_p99_budget_s:
+            blame = (
+                f" (dominant stage: {dominant}, "
+                f"{self.stage_sums[dominant]:.1f}s total)"
+                if dominant else ""
+            )
             violations.append(
-                f"pod-ready p99 {p99:.1f}s > budget {self.ready_p99_budget_s}s"
+                f"pod-ready p99 {p99:.1f}s > budget "
+                f"{self.ready_p99_budget_s}s{blame}"
             )
         if self.loop_lag_max_s > self.loop_lag_budget_s:
             violations.append(
@@ -330,6 +359,13 @@ class InvariantMonitor:
             "pod_ready_samples": len(self.ready_latencies),
             "pod_ready_p50_s": round(p50, 3) if p50 is not None else None,
             "pod_ready_p99_s": round(p99, 3) if p99 is not None else None,
+            "dominant_stage": dominant,
+            "stage_totals_s": {
+                k: round(v, 3) for k, v in sorted(self.stage_sums.items())
+            },
+            "stage_counts": {
+                k: int(v) for k, v in sorted(self.stage_counts.items())
+            },
             "loop_lag_max_s": round(self.loop_lag_max_s, 3),
             "mem_slope_bytes_per_s": round(slope, 1),
             "mem_segments": segments,

@@ -259,6 +259,13 @@ class EncodeSession:
         risk_penalty: float = 0.0,
     ) -> EncodedProblem:
         t0 = time.perf_counter()
+        # lifecycle marks: encode_wait ends (the batch reached the encoder)
+        # / encode ends below — no-ops for untracked pods (deprovisioning
+        # what-if simulations re-encode BOUND pods through here)
+        from ..utils.lifecycle import LIFECYCLE
+
+        pod_names = [p.name for p in pods]
+        LIFECYCLE.mark_many(pod_names, "encode_start")
         with self._lock, ENCODE_LOCK:
             _maybe_compact_vocab()
             problem = None
@@ -295,6 +302,7 @@ class EncodeSession:
                 time.perf_counter() - t0,
                 {"phase": "encode", "mode": self.last_mode},
             )
+            LIFECYCLE.mark_many(pod_names, "encode_done")
             return problem
 
     def _note_shape(self, problem: EncodedProblem) -> None:

@@ -332,6 +332,17 @@ class HTTPCluster(Cluster):
             else:
                 obj = decode(wire)
                 coll[name] = obj
+        if kind == "pods":
+            # lifecycle intake at the applier — the earliest boundary a
+            # pending pod crosses in this process (the controller callback
+            # stamps it too, but first-seen wins); a delete before bind
+            # retires its in-flight waterfall immediately
+            from ..utils.lifecycle import LIFECYCLE
+
+            if event == "DELETED":
+                LIFECYCLE.discard(name)
+            elif obj.is_pending() and obj.meta.deletion_timestamp is None:
+                LIFECYCLE.intake(name)
         self._emit(event, obj)
 
     def _watch_loop(self) -> None:

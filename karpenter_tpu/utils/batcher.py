@@ -15,6 +15,8 @@ import threading
 from dataclasses import dataclass, field
 from typing import Callable, Dict, Generic, Hashable, List, Optional, Sequence, TypeVar
 
+from . import metrics
+
 Req = TypeVar("Req")
 Resp = TypeVar("Resp")
 
@@ -99,6 +101,7 @@ class _Bucket(Generic[Req, Resp]):
         self.on_done: Callable[[], None] = lambda: None
         self._lock = threading.Lock()
         self._requests: List[Req] = []
+        self._put_times: List[float] = []
         self._waiters: List[_Waiter[Resp]] = []
         self._trigger = threading.Event()
         self.closed = False
@@ -113,6 +116,7 @@ class _Bucket(Generic[Req, Resp]):
                 return None
             waiter: _Waiter[Resp] = _Waiter()
             self._requests.append(request)
+            self._put_times.append(_now())
             self._waiters.append(waiter)
             self._trigger.set()
             if len(self._requests) >= self._options.max_items:
@@ -139,8 +143,14 @@ class _Bucket(Generic[Req, Resp]):
         with self._lock:
             self.closed = True
             requests = list(self._requests)
+            put_times = list(self._put_times)
             waiters = list(self._waiters)
         self.on_done()
+        # per-request window queue time, observed as the merged call starts
+        # (karpenter_tpu_batch_wait_seconds{batcher="rpc"})
+        start = _now()
+        for t in put_times:
+            metrics.BATCH_WAIT.observe(max(0.0, start - t), {"batcher": "rpc"})
         try:
             responses = self._executor(requests)
             if len(responses) != len(requests):

@@ -115,6 +115,11 @@ class CapsuleBuilder:
         # host-backend / untracked solves), aligned with _digests
         self._aot: List[Optional[Dict]] = []
         self._batch_order: Optional[List[str]] = None
+        # the round's completed pod-lifecycle waterfalls (utils/lifecycle.py)
+        # — forensic output like aot_solves, excluded from every replay
+        # comparison (a replay re-runs under lifecycle suppression and
+        # cannot reproduce wall-clock timings)
+        self._lifecycle: List[Dict] = []
         self._anomalies: List[str] = []
         self._meta: Dict = {}
         self._finished = False
@@ -273,6 +278,12 @@ class CapsuleBuilder:
         if result.unschedulable:
             self.note_anomaly(TRIGGER_UNSCHEDULABLE)
 
+    def set_lifecycle_marks(self, records: List[Dict]) -> None:
+        """The round's completed lifecycle waterfalls (pod, per-stage
+        durations, e2e, backend) — the forensic 'where did this pod's
+        latency go' answer attached to the capsule that placed it."""
+        self._lifecycle = list(records)
+
     def set_outputs_rebalance(self, actions: List[Dict]) -> None:
         """Rebalance-round outputs: the ordered action list (replacement
         launches, gated drains, deadline fallbacks) with pool + replacement
@@ -326,6 +337,9 @@ class CapsuleBuilder:
                     if any(a is not None for a in self._aot)
                     else {}
                 ),
+                # lifecycle waterfalls: forensic like aot_solves, excluded
+                # from every replay comparison — wall-clock is not an input
+                **({"lifecycle": list(self._lifecycle)} if self._lifecycle else {}),
                 "decisions": [r.to_dict() for r in self._decision_tee.records],
                 "error": f"{type(error).__name__}: {error}" if error else None,
             },

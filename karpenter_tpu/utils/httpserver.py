@@ -32,6 +32,15 @@ Debug surface (the pprof-flag analogue, always on and cheap):
   and — with ``?pod=<name>`` — which cell owns a pod and why (feasible
   provisioners, zone pin, gang, residue reason). ``{"enabled": false}``
   while ``cell_sharding_enabled`` is off.
+* ``/debug/lifecycle`` — the pod-lifecycle attribution tracker
+  (utils/lifecycle.py): recent completed waterfalls plus aggregate stage
+  totals and the dominant stage; ``?pod=<name>`` renders ONE pod's stage
+  waterfall (intake -> batch -> solve -> validate -> launch -> bind, wait
+  vs in-stage decomposition) cross-linked to its trace_id, reconcile_id
+  and DecisionRecords.
+* ``/debug/slo`` — the SLO burn-rate engine (utils/slo.py): per objective,
+  the configured threshold/target, per-window (fast/slow) good/bad traffic
+  and burn rate, and error budget remaining.
 """
 
 from __future__ import annotations
@@ -44,7 +53,9 @@ from urllib.parse import parse_qs
 
 from .decisions import DECISIONS, DecisionLog
 from .flightrecorder import FLIGHT, FlightRecorder
+from .lifecycle import LIFECYCLE
 from .metrics import REGISTRY, Registry
+from .slo import SLO
 from .tracing import TRACER, Tracer
 
 
@@ -181,6 +192,39 @@ class OperatorHTTPServer:
                         else {"enabled": False, "cells": []}
                     )
                     body = json.dumps(payload, default=str).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                elif path == "/debug/lifecycle":
+                    q = parse_qs(query)
+                    pod = q.get("pod", [None])[0]
+                    if pod:
+                        waterfall = LIFECYCLE.waterfall(pod)
+                        if waterfall is None:
+                            body = json.dumps(
+                                {"error": f"no lifecycle timeline for pod {pod!r}"}
+                            ).encode()
+                            self.send_response(404)
+                        else:
+                            # cross-link: the pod's audit-log verdicts join
+                            # the waterfall to WHY it landed where it did
+                            waterfall["decisions"] = [
+                                r.to_dict()
+                                for r in outer.decisions.query(pod=pod, limit=32)
+                            ]
+                            body = json.dumps(waterfall, default=str).encode()
+                            self.send_response(200)
+                    else:
+                        try:
+                            limit = max(0, int(q.get("limit", ["64"])[0]))
+                        except ValueError:
+                            limit = 64
+                        body = json.dumps(
+                            LIFECYCLE.snapshot(limit=limit), default=str
+                        ).encode()
+                        self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                elif path == "/debug/slo":
+                    body = json.dumps(SLO.snapshot(), default=str).encode()
                     self.send_response(200)
                     self.send_header("Content-Type", "application/json")
                 elif path == "/debug/events":

@@ -694,6 +694,49 @@ BACKPRESSURE_EVENTS = Counter(
     registry=REGISTRY,
 )
 
+# -- pod lifecycle attribution (utils/lifecycle.py, utils/slo.py) ------------
+POD_LIFECYCLE_STAGE = Histogram(
+    "karpenter_tpu_pod_lifecycle_stage_seconds",
+    help="Per-stage duration of a completed pod's lifecycle waterfall "
+         "(intake -> batch -> solve -> validate -> launch -> bind), labeled "
+         "by stage; wait stages (batch_wait/solve_wait/encode_wait/"
+         "launch_wait) are time spent queued BETWEEN stages, the rest time "
+         "inside one. Stage durations sum to pod_ready_seconds by "
+         "construction.",
+    buckets=_LATENCY_BUCKETS,
+    registry=REGISTRY,
+)
+POD_READY = Histogram(
+    "karpenter_tpu_pod_ready_seconds",
+    help="End-to-end pod-ready latency: watch intake first-seen to bind, "
+         "observed once per completed lifecycle waterfall "
+         "(utils/lifecycle.py) — the streaming-frontier product metric.",
+    buckets=_LATENCY_BUCKETS,
+    registry=REGISTRY,
+)
+BATCH_WAIT = Histogram(
+    "karpenter_tpu_batch_wait_seconds",
+    help="Time requests spend waiting in a batch window before execution, "
+         "labeled by batcher: 'pod' is the provisioning batch window's "
+         "arming delay (the largest known pod-ready contributor), 'rpc' the "
+         "cloud-API request batcher's per-request queue time.",
+    buckets=_LATENCY_BUCKETS,
+    registry=REGISTRY,
+)
+SLO_BURN_RATE = Gauge(
+    "karpenter_tpu_slo_burn_rate",
+    help="Error-budget burn rate per SLO and window (fast=5m, slow=1h): "
+         "bad-fraction / (1 - target); 1.0 spends the budget exactly at "
+         "exhaustion rate, >1 is overspend, idle traffic reads 0.",
+    registry=REGISTRY,
+)
+SLO_BUDGET_REMAINING = Gauge(
+    "karpenter_tpu_slo_budget_remaining",
+    help="Fraction of the SLO's error budget left over the slow window "
+         "(1.0 untouched, 0 spent, negative overspent).",
+    registry=REGISTRY,
+)
+
 # -- event stream ------------------------------------------------------------
 EVENTS_TOTAL = Counter(
     "karpenter_tpu_events_total",

@@ -1,0 +1,494 @@
+"""Pod-lifecycle attribution (utils/lifecycle.py) + SLO burn rate (utils/slo.py).
+
+Three tiers:
+
+* tracker unit tests under a FakeClock — segment attribution, the
+  stages-sum-to-e2e invariant, suppression, retention, and the pre-scrape
+  pruner's grace window;
+* SLO engine math under an injected clock — burn-rate normalization,
+  window roll-off, budget exhaustion and recovery, idle-is-zero-burn;
+* e2e over real HTTP — a provisioned pod's ``/debug/lifecycle`` waterfall
+  stages sum to its recorded pod-ready latency and join its DecisionRecords
+  by trace id, and ``/debug/slo`` serves the configured objective.
+"""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from karpenter_tpu.api.settings import Settings
+from karpenter_tpu.cloudprovider import FakeCloudProvider, generate_catalog
+from karpenter_tpu.operator import Operator
+from karpenter_tpu.utils import lifecycle, metrics
+from karpenter_tpu.utils.cache import FakeClock
+from karpenter_tpu.utils.httpserver import OperatorHTTPServer
+from karpenter_tpu.utils.lifecycle import (
+    LIFECYCLE,
+    WAIT_STAGES,
+    LifecycleTracker,
+    track_cluster_for_pruning,
+)
+from karpenter_tpu.utils.slo import WINDOWS, SloEngine
+
+from helpers import make_pods, make_provisioner
+
+
+def _tracker(clock, **kw):
+    t = LifecycleTracker()
+    t.configure(clock=clock.now, **kw)
+    return t
+
+
+def _full_timeline(t, clock, pod="p0", node="n0"):
+    """Stamp the complete new-node mark sequence with known step sizes;
+    returns the expected per-stage durations."""
+    t.intake(pod)
+    steps = [
+        ("batch_flushed", 1.0, "batch_wait"),
+        ("solve_dispatch", 0.5, "solve_wait"),
+        ("cell_routed", 0.25, "route"),
+        ("encode_start", 0.25, "encode_wait"),
+        ("encode_done", 2.0, "encode"),
+        ("solve_result", 3.0, "solve"),
+        ("validated", 0.5, "validate"),
+        ("launch_issued", 0.25, "launch_wait"),
+        ("node_ready", 4.0, "launch"),
+    ]
+    expected = {}
+    for mark, dt, stage in steps:
+        clock.step(dt)
+        if mark == "solve_result":
+            t.mark(pod, mark, backend="kernel")
+        else:
+            t.mark(pod, mark)
+        expected[stage] = dt
+    clock.step(0.25)
+    expected["bind"] = 0.25
+    record = t.complete(pod, node=node)
+    return record, expected
+
+
+class TestSegmentAttribution:
+    def test_stages_sum_to_e2e_exactly(self):
+        clock = FakeClock(start=100.0)
+        t = _tracker(clock)
+        record, expected = _full_timeline(t, clock)
+        assert record is not None
+        assert record["stages"] == pytest.approx(expected)
+        assert sum(record["stages"].values()) == pytest.approx(record["e2e_s"])
+        assert record["e2e_s"] == pytest.approx(12.0)
+        assert record["backend"] == "kernel"
+        assert record["node"] == "n0"
+        # marks are relative to intake and monotone
+        rel = [t_ for _, t_ in record["marks"]]
+        assert rel[0] == 0.0 and rel == sorted(rel)
+        assert record["marks"][-1][0] == "bound"
+
+    def test_wait_work_decomposition(self):
+        clock = FakeClock(start=0.0)
+        t = _tracker(clock)
+        record, expected = _full_timeline(t, clock)
+        want_wait = sum(v for k, v in expected.items() if k in WAIT_STAGES)
+        assert record["wait_s"] == pytest.approx(want_wait)
+        assert record["work_s"] == pytest.approx(record["e2e_s"] - want_wait)
+
+    def test_unknown_mark_folds_into_other(self):
+        clock = FakeClock(start=0.0)
+        t = _tracker(clock)
+        t.intake("p")
+        clock.step(1.0)
+        t.mark("p", "some_future_mark")
+        clock.step(0.5)
+        record = t.complete("p")
+        assert record["stages"]["other"] == pytest.approx(1.0)
+        assert record["stages"]["bind"] == pytest.approx(0.5)
+        assert sum(record["stages"].values()) == pytest.approx(record["e2e_s"])
+
+    def test_intake_first_wins(self):
+        clock = FakeClock(start=0.0)
+        t = _tracker(clock)
+        t.intake("p")
+        clock.step(5.0)
+        t.intake("p")  # the applier AND the controller both stamp — no reset
+        clock.step(1.0)
+        record = t.complete("p")
+        assert record["e2e_s"] == pytest.approx(6.0)
+
+    def test_untracked_pod_is_a_noop(self):
+        clock = FakeClock(start=0.0)
+        t = _tracker(clock)
+        t.mark("ghost", "batch_flushed")
+        t.mark_many(["ghost"], "solve_result", backend="kernel")
+        assert t.complete("ghost") is None
+        assert t.waterfall("ghost") is None
+
+    def test_existing_node_pod_skips_launch_stages(self):
+        clock = FakeClock(start=0.0)
+        t = _tracker(clock)
+        t.intake("p")
+        clock.step(1.0)
+        t.mark("p", "validated")
+        clock.step(0.5)
+        record = t.complete("p")
+        assert "launch" not in record["stages"]
+        assert "launch_wait" not in record["stages"]
+        assert record["stages"]["bind"] == pytest.approx(0.5)
+
+
+class TestTrackerHygiene:
+    def test_disabled_tracker_stamps_nothing(self):
+        clock = FakeClock(start=0.0)
+        t = _tracker(clock, enabled=False)
+        t.intake("p")
+        assert t.complete("p") is None
+        assert t.completed_count() == 0
+
+    def test_suppressed_context_blocks_marks(self):
+        clock = FakeClock(start=0.0)
+        t = _tracker(clock)
+        with lifecycle.suppressed():
+            t.intake("p")
+            assert t.complete("p") is None
+        # and restores: marks work again after exit
+        t.intake("p")
+        assert t.complete("p") is not None
+
+    def test_suppressed_nests(self):
+        with lifecycle.suppressed():
+            with lifecycle.suppressed():
+                pass
+            clock = FakeClock(start=0.0)
+            t = _tracker(clock)
+            t.intake("p")
+            assert t.complete("p") is None
+
+    def test_retention_bounds_completed_ring(self):
+        clock = FakeClock(start=0.0)
+        t = _tracker(clock, retention=2)
+        for name in ("a", "b", "c"):
+            t.intake(name)
+            clock.step(1.0)
+            t.complete(name)
+        assert t.completed_count() == 2
+        assert t.waterfall("a") is None  # oldest evicted
+        assert t.waterfall("c") is not None
+
+    def test_discard_drops_inflight(self):
+        clock = FakeClock(start=0.0)
+        t = _tracker(clock)
+        t.intake("p")
+        t.discard("p")
+        assert t.complete("p") is None
+
+    def test_prune_grace_protects_recent_marks(self):
+        clock = FakeClock(start=0.0)
+        t = _tracker(clock)
+        t.intake("fresh")
+        t.intake("stale")
+        clock.step(60.0)
+        t.mark("fresh", "batch_flushed")  # recent activity: mid-flight
+        # neither is in keep, but only the quiet one is prunable
+        assert t.prune_inflight([], grace_s=30.0) == 1
+        assert t.waterfall("fresh") is not None
+        assert t.waterfall("stale") is None
+
+    def test_prune_keeps_pending_set(self):
+        clock = FakeClock(start=0.0)
+        t = _tracker(clock)
+        t.intake("pending")
+        clock.step(60.0)
+        assert t.prune_inflight(["pending"], grace_s=30.0) == 0
+        assert t.waterfall("pending") is not None
+
+    def test_drain_round_returns_and_clears(self):
+        clock = FakeClock(start=0.0)
+        t = _tracker(clock)
+        t.intake("p")
+        clock.step(1.0)
+        t.complete("p")
+        drained = t.drain_round()
+        assert [r["pod"] for r in drained] == ["p"]
+        assert t.drain_round() == []
+
+    def test_inflight_waterfall_measures_against_now(self):
+        clock = FakeClock(start=0.0)
+        t = _tracker(clock)
+        t.intake("p")
+        clock.step(2.0)
+        t.mark("p", "batch_flushed")
+        clock.step(3.0)
+        wf = t.waterfall("p")
+        assert wf["state"] == "in-flight"
+        assert wf["e2e_s"] == pytest.approx(5.0)
+        assert wf["stages"]["batch_wait"] == pytest.approx(2.0)
+        # the open segment (batch_flushed -> now) folds into "other"
+        assert sum(wf["stages"].values()) == pytest.approx(5.0)
+
+    def test_snapshot_names_dominant_stage(self):
+        clock = FakeClock(start=0.0)
+        t = _tracker(clock)
+        _full_timeline(t, clock, pod="p0")
+        snap = t.snapshot()
+        assert snap["dominant_stage"] == "launch"  # the 4.0s segment
+        assert snap["inflight"] == 0
+        assert [r["pod"] for r in snap["completed"]] == ["p0"]
+        assert snap["stage_totals_s"]["solve"] == pytest.approx(3.0)
+
+    def test_completion_observes_histograms_on_flush(self):
+        clock = FakeClock(start=0.0)
+        t = _tracker(clock)
+        ready_before = metrics.POD_READY.count()
+        solve_before = metrics.POD_LIFECYCLE_STAGE.count({"stage": "solve"})
+        _full_timeline(t, clock)
+        # the bind path only buffers; the pre-scrape refresher folds in
+        t.flush_observations()
+        assert metrics.POD_READY.count() == ready_before + 1
+        assert metrics.POD_LIFECYCLE_STAGE.count({"stage": "solve"}) == solve_before + 1
+        # idempotent: a second flush with an empty buffer adds nothing
+        t.flush_observations()
+        assert metrics.POD_READY.count() == ready_before + 1
+
+    def test_global_tracker_flushes_via_exposition(self):
+        LIFECYCLE.configure()
+        try:
+            before = metrics.POD_READY.count()
+            LIFECYCLE.intake("expo-pod")
+            LIFECYCLE.complete("expo-pod")
+            metrics.REGISTRY.exposition()  # the scrape triggers the fold-in
+            assert metrics.POD_READY.count() == before + 1
+        finally:
+            LIFECYCLE.configure()
+
+
+class TestPreScrapePruner:
+    def test_hook_prunes_against_live_pending_set(self):
+        class Cluster:
+            def __init__(self, names):
+                self.names = names
+
+            def pending_pods(self):
+                return [type("P", (), {"name": n})() for n in self.names]
+
+        clock = FakeClock(start=0.0)
+        LIFECYCLE.configure(clock=clock.now)
+        try:
+            cluster = Cluster(["keep-me"])
+            track_cluster_for_pruning(cluster)
+            LIFECYCLE.intake("keep-me")
+            LIFECYCLE.intake("churned")
+            clock.step(120.0)  # both older than the grace window
+            lifecycle.prune_stale_entries()
+            assert LIFECYCLE.waterfall("keep-me") is not None
+            assert LIFECYCLE.waterfall("churned") is None
+        finally:
+            LIFECYCLE.configure()  # restore the real clock; clears state
+
+    def test_broken_cluster_does_not_wedge_the_scrape(self):
+        class Broken:
+            def pending_pods(self):
+                raise RuntimeError("mid-teardown")
+
+        clock = FakeClock(start=0.0)
+        LIFECYCLE.configure(clock=clock.now)
+        try:
+            broken = Broken()
+            track_cluster_for_pruning(broken)
+            LIFECYCLE.intake("p")
+            clock.step(120.0)
+            lifecycle.prune_stale_entries()  # must not raise
+        finally:
+            LIFECYCLE.configure()
+
+
+# ---------------------------------------------------------------------------
+# SLO burn-rate engine
+# ---------------------------------------------------------------------------
+
+
+def _engine(clock, threshold=1.0, target=0.9):
+    eng = SloEngine()
+    eng.configure({"pod_ready": (threshold, target)}, clock=clock.now)
+    return eng
+
+
+class TestSloMath:
+    def test_idle_is_zero_burn_full_budget(self):
+        clock = FakeClock(start=0.0)
+        eng = _engine(clock)
+        for _, length in WINDOWS:
+            assert eng.burn_rate("pod_ready", length) == 0.0
+        assert eng.budget_remaining("pod_ready") == 1.0
+
+    def test_all_good_is_zero_burn(self):
+        clock = FakeClock(start=0.0)
+        eng = _engine(clock)
+        for _ in range(20):
+            eng.observe_latency("pod_ready", 0.5)
+        for _, length in WINDOWS:
+            assert eng.burn_rate("pod_ready", length) == 0.0
+        assert eng.budget_remaining("pod_ready") == 1.0
+
+    def test_burn_normalization(self):
+        # target 0.9 -> 10% budget; 1 bad in 10 -> bad_frac 0.1 -> burn 1.0
+        clock = FakeClock(start=0.0)
+        eng = _engine(clock, target=0.9)
+        for _ in range(9):
+            eng.record("pod_ready", good=True)
+        eng.record("pod_ready", good=False)
+        assert eng.burn_rate("pod_ready", WINDOWS[0][1]) == pytest.approx(1.0)
+        # budget over the slow window: allowed = 0.1 * 10 = 1 bad, spent 1
+        assert eng.budget_remaining("pod_ready") == pytest.approx(0.0)
+
+    def test_latency_classified_against_threshold(self):
+        clock = FakeClock(start=0.0)
+        eng = _engine(clock, threshold=1.0, target=0.5)
+        eng.observe_latency("pod_ready", 0.9)   # good
+        eng.observe_latency("pod_ready", 1.0)   # good (<=)
+        eng.observe_latency("pod_ready", 1.1)   # bad
+        snap = eng.snapshot()["objectives"]["pod_ready"]
+        assert snap["windows"]["fast"] == {
+            "good": 2, "bad": 1,
+            "burn_rate": pytest.approx((1 / 3) / 0.5),
+        }
+
+    def test_fast_window_rolls_off_before_slow(self):
+        clock = FakeClock(start=0.0)
+        eng = _engine(clock, target=0.9)
+        eng.record("pod_ready", good=False)
+        fast_s, slow_s = WINDOWS[0][1], WINDOWS[1][1]
+        clock.step(fast_s + 20.0)  # past fast, inside slow
+        assert eng.burn_rate("pod_ready", fast_s) == 0.0
+        assert eng.burn_rate("pod_ready", slow_s) > 0.0
+        assert eng.budget_remaining("pod_ready") < 1.0
+
+    def test_budget_recovers_after_slow_window(self):
+        clock = FakeClock(start=0.0)
+        eng = _engine(clock, target=0.9)
+        for _ in range(5):
+            eng.record("pod_ready", good=False)
+        assert eng.budget_remaining("pod_ready") < 0.0  # overspent
+        clock.step(WINDOWS[1][1] + 20.0)
+        # fully rolled off: traffic gone, budget intact again
+        assert eng.burn_rate("pod_ready", WINDOWS[1][1]) == 0.0
+        assert eng.budget_remaining("pod_ready") == 1.0
+
+    def test_roll_off_frees_ring_memory(self):
+        clock = FakeClock(start=0.0)
+        eng = _engine(clock)
+        for _ in range(50):
+            eng.record("pod_ready", good=True)
+            clock.step(3600.0)  # every record a new epoch — old buckets drop
+        assert len(eng._buckets["pod_ready"]) <= 3
+
+    def test_unknown_objective_noops(self):
+        clock = FakeClock(start=0.0)
+        eng = _engine(clock)
+        eng.observe_latency("nope", 99.0)
+        eng.record("nope", good=False)
+        assert eng.burn_rate("nope", 300.0) == 0.0
+        assert eng.budget_remaining("nope") == 1.0
+
+    def test_refresh_metrics_exports_gauges(self):
+        clock = FakeClock(start=0.0)
+        eng = _engine(clock, target=0.9)
+        for _ in range(9):
+            eng.record("pod_ready", good=True)
+        eng.record("pod_ready", good=False)
+        eng.refresh_metrics()
+        assert metrics.SLO_BURN_RATE.value(
+            {"slo": "pod_ready", "window": "fast"}
+        ) == pytest.approx(1.0)
+        assert metrics.SLO_BUDGET_REMAINING.value(
+            {"slo": "pod_ready"}
+        ) == pytest.approx(0.0)
+
+
+# ---------------------------------------------------------------------------
+# e2e: provisioned pods -> /debug/lifecycle + /debug/slo over real HTTP
+# ---------------------------------------------------------------------------
+
+
+def _get(port, path):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}") as r:
+        return json.loads(r.read())
+
+
+class TestLifecycleEndpointE2E:
+    def _boot(self):
+        settings = Settings(batch_idle_duration=0, batch_max_duration=0)
+        op = Operator.new(
+            provider=FakeCloudProvider(catalog=generate_catalog(n_types=20)),
+            settings=settings,
+        )
+        op.cluster.add_provisioner(make_provisioner())
+        return op
+
+    def test_waterfall_sums_to_pod_ready_and_joins_decisions(self):
+        op = self._boot()
+        pods = make_pods(3, "wf", cpu="500m")
+        for p in pods:
+            op.cluster.add_pod(p)
+        op.step()
+        assert not op.cluster.pending_pods()
+
+        server = OperatorHTTPServer(port=0).start()
+        try:
+            wf = _get(server.port, f"/debug/lifecycle?pod={pods[0].name}")
+            assert wf["state"] == "completed"
+            assert wf["marks"][-1][0] == "bound"
+            # the tentpole invariant, over the wire: stages account for the
+            # FULL pod-ready latency (tolerance for float round-trip only)
+            assert sum(wf["stages"].values()) == pytest.approx(
+                wf["e2e_s"], rel=0.05, abs=1e-6
+            )
+            assert wf["wait_s"] + wf["work_s"] == pytest.approx(
+                wf["e2e_s"], rel=0.05, abs=1e-6
+            )
+            assert wf["backend"]  # the solve_result mark tagged who answered
+            # cross-link: the inlined DecisionRecords are this pod's, and the
+            # placement verdict shares the waterfall's trace id
+            assert wf["decisions"], "expected the pod's audit records inline"
+            placements = [d for d in wf["decisions"] if d["kind"] == "placement"]
+            assert placements and wf["trace_id"]
+            assert placements[0]["trace_id"] == wf["trace_id"]
+
+            snap = _get(server.port, "/debug/lifecycle")
+            assert snap["enabled"] is True
+            assert {r["pod"] for r in snap["completed"]} >= {p.name for p in pods}
+            assert snap["dominant_stage"] in snap["stage_totals_s"]
+
+            with pytest.raises(urllib.error.HTTPError) as err:
+                _get(server.port, "/debug/lifecycle?pod=no-such-pod")
+            assert err.value.code == 404
+        finally:
+            server.stop()
+
+    def test_slo_endpoint_reports_configured_objective(self):
+        op = self._boot()
+        for p in make_pods(2, "slo", cpu="500m"):
+            op.cluster.add_pod(p)
+        op.step()
+        server = OperatorHTTPServer(port=0).start()
+        try:
+            slo = _get(server.port, "/debug/slo")
+            obj = slo["objectives"]["pod_ready_p99"]
+            assert obj["threshold_s"] == op.settings.slo_pod_ready_p99_s
+            assert obj["target_frac"] == op.settings.slo_pod_ready_target_frac
+            # an in-process solve binds in well under 60s: all good, no burn
+            assert obj["windows"]["fast"]["good"] >= 2
+            assert obj["windows"]["fast"]["bad"] == 0
+            assert obj["windows"]["fast"]["burn_rate"] == 0.0
+            assert obj["budget_remaining"] == 1.0
+        finally:
+            server.stop()
+
+    def test_batch_wait_histogram_observed(self):
+        before = metrics.BATCH_WAIT.count({"batcher": "pod"})
+        op = self._boot()
+        for p in make_pods(2, "bw", cpu="500m"):
+            op.cluster.add_pod(p)
+        op.step()
+        assert metrics.BATCH_WAIT.count({"batcher": "pod"}) > before
