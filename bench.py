@@ -2257,6 +2257,185 @@ def bench_spot_churn(n_pods=240, waves=3, replace_budget=2, n_types=20):
     }
 
 
+def bench_federation_storm(
+    gang_size=4, lone_pods=9, rounds=12, n_types=12, round_s=10.0,
+    storm_fraction=0.5,
+):
+    """Federation survivability scenario (ISSUE 17): a 3-cluster federated
+    fleet under the canonical fault timeline (soak/churn.federation_storm_
+    script) — a regional spot storm, an arbiter partition that heals
+    (degraded-local rounds), and one FULL region blackout held past the
+    staleness sweep so the lost region's gangs fail over whole, then heal
+    and rejoin (epoch-bumped) with post-heal rounds captured.
+
+    Correctness under regional loss, not latency: zero unschedulable pods
+    across every surviving cluster at every round end, the lost region's
+    gangs re-enter elsewhere COMPLETE, mean fleet cost within 1.5x of a
+    single-global-cluster oracle (the same union workload placed by one
+    cluster that can never lose a region), and byte-identical replay of
+    every captured federation capsule — degraded and post-heal rounds
+    included, proving no duplicate launches across the epoch fence.
+    """
+    from karpenter_tpu.api import ObjectMeta, Pod, Provisioner, Resources
+    from karpenter_tpu.api import labels as wk
+    from karpenter_tpu.api.settings import Settings
+    from karpenter_tpu.cloudprovider import FakeCloudProvider, generate_catalog
+    from karpenter_tpu.controllers.provisioning import ProvisioningController
+    from karpenter_tpu.federation.fleet import FederatedFleet
+    from karpenter_tpu.soak.churn import federation_storm_script
+    from karpenter_tpu.solver.solver import GreedySolver
+    from karpenter_tpu.state import Cluster
+    from karpenter_tpu.utils.flightrecorder import FLIGHT
+
+    regions = ("us-east", "us-west", "eu-west")
+    storm_region, partition_region, blackout_region = (
+        "us-east", "us-west", "eu-west"
+    )
+
+    # -- single-global-cluster oracle: the union workload on ONE cluster
+    # that can never lose a region — the steady-state cost floor the
+    # federated fleet's churn + failover duplication is banded against
+    oracle_cluster = Cluster()
+    oracle_provider = FakeCloudProvider(catalog=generate_catalog(n_types=n_types))
+    for s in oracle_provider.subnets:
+        s.available_ips = 1 << 20
+    # a modest risk penalty (the default 10.0 x the cache's 0.05 spot prior
+    # overwhelms small types' spot discount entirely): spot pools price in,
+    # the regional storm has real victims, and post-storm risk drives the
+    # flee-to-on-demand dynamics the cost band absorbs
+    overrides = {"interruption_penalty_cost": 0.5}
+    oracle_ctl = ProvisioningController(
+        oracle_cluster, oracle_provider, solver=GreedySolver(),
+        settings=Settings(batch_idle_duration=0, batch_max_duration=0,
+                          spot_enabled=True, **overrides),
+    )
+    oracle_cluster.add_provisioner(Provisioner(meta=ObjectMeta(name="default")))
+    for region in regions:
+        for i in range(gang_size):
+            oracle_cluster.add_pod(Pod(
+                meta=ObjectMeta(
+                    name=f"gang-{region}-{i}",
+                    labels={wk.POD_GROUP: f"gang-{region}"},
+                    annotations={wk.POD_GROUP_MIN_MEMBERS: str(gang_size)},
+                    owner_kind="Job",
+                ),
+                requests=Resources(cpu="500m", memory="512Mi"),
+            ))
+        for i in range(lone_pods):
+            oracle_cluster.add_pod(Pod(
+                meta=ObjectMeta(name=f"web-{region}-{i}", owner_kind="ReplicaSet"),
+                requests=Resources(cpu="500m", memory="512Mi"),
+            ))
+    for i in range(gang_size):
+        # the mid-partition arrival is part of the union workload too
+        oracle_cluster.add_pod(Pod(
+            meta=ObjectMeta(
+                name=f"gang-degraded-{i}",
+                labels={wk.POD_GROUP: "gang-degraded"},
+                annotations={wk.POD_GROUP_MIN_MEMBERS: str(gang_size)},
+                owner_kind="Job",
+            ),
+            requests=Resources(cpu="500m", memory="512Mi"),
+        ))
+    oracle_ctl.reconcile()
+    oracle_cost = 0.0
+    for node in oracle_cluster.nodes.values():
+        oracle_cost += oracle_provider.pricing.price(*node.capacity_pool()) or 0.0
+
+    # -- the federated fleet + the canonical fault timeline ------------------
+    FLIGHT.configure(128)  # sub-capsule collection diffs the ring per round
+    fleet = FederatedFleet(
+        regions=regions, n_types=n_types, round_s=round_s,
+        settings_overrides=overrides,
+    )
+    for region in regions:
+        # one multi-region gang homed in each region (the blackout region's
+        # must re-enter elsewhere whole) + single-region filler pods the
+        # spot storm chews on
+        fleet.add_gang(region, f"gang-{region}", members=gang_size, regions="*")
+        fleet.add_pods(region, f"web-{region}", lone_pods)
+    script = federation_storm_script(
+        storm_region, blackout_region, partition_region,
+        round_s=round_s, rounds=rounds, storm_fraction=storm_fraction,
+    )
+
+    unsched_p100 = 0
+    storms = blackouts = 0
+    for r in range(rounds):
+        if r == 2:
+            # fresh multi-region work arriving INSIDE the partition window:
+            # the partitioned region cannot reach the arbiter, so the gate
+            # logs a degraded-local decision and schedules on its own
+            # authority — the capsule's degraded round
+            fleet.add_gang(
+                partition_region, "gang-degraded", members=gang_size,
+                regions="*",
+            )
+        for ev in script.due(now=r * round_s):
+            region = str(ev.get("region"))
+            if ev.kind == "region-blackout":
+                fleet.blackout(region)
+                blackouts += 1
+            elif ev.kind == "region-heal":
+                fleet.heal(region)
+            elif ev.kind == "arbiter-partition":
+                fleet.partition(region)
+            elif ev.kind == "arbiter-heal":
+                fleet.heal_partition(region)
+            elif ev.kind == "regional-spot-storm":
+                storms += fleet.storm_spot(region, float(ev.get("fraction", 0.5)))
+        fleet.run_round()
+        unsched_p100 = max(unsched_p100, fleet.pending_total())
+
+    leases_granted = sum(
+        1
+        for c in fleet.capsules
+        for a in c["outputs"]["verdict"]["assignments"]
+        if a.get("outcome") in ("granted", "renewed")
+    )
+    gangs_reentered = sorted(fleet.failover_gangs)
+    gangs_whole = all(
+        fleet.gang_whole_in_one_cluster(g) for g in gangs_reentered
+    )
+    mean_cost = sum(fleet.costs) / len(fleet.costs) if fleet.costs else 0.0
+    frac = round(mean_cost / oracle_cost, 4) if oracle_cost > 0 else None
+    reports = fleet.replay_all()
+    degraded_replays = sum(
+        1 for rep in reports
+        if rep.get("diffs", {}).get("degraded_assignments", 0)
+    )
+    final_epoch = fleet.capsules[-1]["outputs"]["verdict"]["epoch"]
+    post_heal_replays = sum(
+        1 for rep, c in zip(reports, fleet.capsules)
+        if c["epoch"] == final_epoch
+    )
+    return {
+        "regions": len(regions),
+        "rounds": rounds,
+        "storm_reclaims": storms,
+        "blackouts": blackouts,
+        "degraded_rounds": fleet.degraded_rounds,
+        "epoch_final": final_epoch,
+        "leases_granted": leases_granted,
+        "fed_unschedulable_p100": unsched_p100,
+        "fed_zero_unschedulable": bool(unsched_p100 == 0),
+        "gangs_failed_over": len(gangs_reentered),
+        "fed_gangs_reentered_whole": bool(gangs_reentered and gangs_whole),
+        "oracle_cost": round(oracle_cost, 4),
+        "mean_cost": round(mean_cost, 4),
+        "fed_cost_vs_oracle_frac": frac,
+        "within_cost_band": bool(frac is not None and frac <= 1.5),
+        "capsules": len(fleet.capsules),
+        "sub_capsules": sum(len(c["sub_capsules"]) for c in fleet.capsules),
+        "degraded_round_replays": degraded_replays,
+        "post_heal_replays": post_heal_replays,
+        "fed_replay_all_matched": bool(
+            reports and all(rep["match"] for rep in reports)
+        ),
+        "audit_violations": len(fleet.audit_violations),
+    }
+
+
 def bench_device_faults(n_pods=20_000, storm_rounds=6, overhead_repeats=8,
                         n_types=60):
     """Solver fault-domain scenario (ISSUE 15): a scripted device-fault
@@ -2985,6 +3164,14 @@ def _run_details(dry_run: bool = False) -> dict:
         except Exception as e:
             details["spot_churn"] = {"error": f"{type(e).__name__}: {e}"}
         try:
+            # the timeline needs >= 10 rounds to fit the blackout + heal;
+            # tiny workload keeps the dry run fast
+            details["federation_storm"] = bench_federation_storm(
+                gang_size=2, lone_pods=3, rounds=10, n_types=6
+            )
+        except Exception as e:
+            details["federation_storm"] = {"error": f"{type(e).__name__}: {e}"}
+        try:
             details["gang_topology"] = bench_gang_topology(
                 rounds=2, gang_size=2, n_types=8
             )
@@ -3036,6 +3223,10 @@ def _run_details(dry_run: bool = False) -> dict:
         ("gang_preemption", bench_gang_preemption),
         ("gang_topology", bench_gang_topology),
         ("spot_churn", bench_spot_churn),
+        # federation survivability (ISSUE 17): 3-cluster fleet under a
+        # regional spot storm + arbiter partition + full region blackout,
+        # banded against the single-global-cluster oracle
+        ("federation_storm", bench_federation_storm),
         # solver fault domain (ISSUE 15): scripted device-fault storm +
         # validator-overhead guard
         ("device_faults", bench_device_faults),
@@ -3131,6 +3322,7 @@ def main(argv=None):
     staging = details.get("device_staging", {})
     gangtopo = details.get("gang_topology", {})
     spot = details.get("spot_churn", {})
+    fed = details.get("federation_storm", {})
     cells = details.get("cell_decompose", {})
     race_topo = details.get("kernel_race_topology", {})
     aot = details.get("aot_cache") or {}
@@ -3203,6 +3395,17 @@ def main(argv=None):
         "spot_reclaims_survived": spot.get("reclaims_survived"),
         "spot_unschedulable_p100": spot.get("unschedulable_p100"),
         "spot_cost_vs_ondemand_frac": spot.get("cost_vs_ondemand_frac"),
+        # federation survivability (ISSUE 17): regional spot storm + full
+        # region blackout across a 3-cluster fleet — zero unschedulable,
+        # the lost region's gangs re-enter elsewhere whole, cost banded
+        # against the single-global-cluster oracle, and every federated
+        # round (degraded + post-heal included) replays byte-identically
+        "fed_unschedulable_p100": fed.get("fed_unschedulable_p100"),
+        "fed_gangs_reentered_whole": fed.get("fed_gangs_reentered_whole"),
+        "fed_cost_vs_oracle_frac": fed.get("fed_cost_vs_oracle_frac"),
+        "fed_replay_all_matched": fed.get("fed_replay_all_matched"),
+        "fed_degraded_rounds": fed.get("degraded_rounds"),
+        "fed_audit_violations": fed.get("audit_violations"),
         # sharded control plane (ISSUE 8): steady-state sharded round p50 at
         # the scenario's pod count, per-cell delta==full digest equivalence,
         # and the acceptance comparison against the 50k flat solve number

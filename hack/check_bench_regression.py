@@ -57,6 +57,15 @@ floor:
   must actually produce waterfalls, and the per-stage durations must sum
   to the end-to-end pod-ready latency (ratio ~1.0 — the attribution
   accounts for the FULL latency by construction).
+* ``federation_storm`` (ISSUE 17): the 3-cluster federated fleet under the
+  canonical fault timeline (regional spot storm, arbiter partition + heal,
+  one FULL region blackout + heal) must end every round with ZERO
+  unschedulable pods across the surviving clusters, re-enter the lost
+  region's gangs elsewhere WHOLE, keep mean fleet cost within
+  FED_COST_BAND x the single-global-cluster oracle, and replay every
+  captured federation capsule byte-identically — including at least one
+  degraded (arbiter-partitioned) round and one post-heal round — with
+  zero duplicate-launch audit violations across the epoch fence.
 * ``soak`` (ISSUE 11): the scaled chaos soak (sustained churn over the
   real-HTTP stack incl. one operator SIGKILL+restart and one apiserver
   restart) must finish with ZERO invariant violations — which covers the
@@ -128,6 +137,11 @@ SOAK_MEM_SLOPE_BPS = 524_288.0
 #: arm's unconstrained optimum (the ISSUE-13 acceptance band; coordinates
 #: within a domain are price-equal, so measured ~1.0x)
 GANGTOPO_COST_BAND = 1.05
+#: federation_storm: mean federated fleet cost vs the single-global-cluster
+#: oracle (the ISSUE-17 acceptance band; measured ~1.01x at the gated
+#: scale — regional fragmentation plus storm/failover churn is what the
+#: band absorbs)
+FED_COST_BAND = 1.5
 
 
 def run_checks(full: bool = False) -> list:
@@ -170,6 +184,10 @@ def run_checks(full: bool = False) -> list:
         n_pods=20_000 if full else 2_000, n_types=30
     )
     gangtopo = bench.bench_gang_topology()
+    # federation survivability (ISSUE 17): one scale either way — the fault
+    # timeline needs its full 12 rounds, and the workload must be large
+    # enough that regional fragmentation amortizes below the cost band
+    fed = bench.bench_federation_storm()
     lifecycle = bench.bench_lifecycle_overhead(
         repeats=6, n_pods=2_000 if full else 300
     )
@@ -193,6 +211,7 @@ def run_checks(full: bool = False) -> list:
         "cold_solve": cold, "kernel_race": race,
         "kernel_race_topology": race_topo,
         "kernel_race_topology_50k": race_topo_50k,
+        "federation_storm": fed,
         "soak": soak,
     }, default=str))
 
@@ -486,6 +505,64 @@ def run_checks(full: bool = False) -> list:
         failures.append(
             "lifecycle_overhead: no dominant stage named — stage "
             "attribution produced no segments"
+        )
+    # -- federation-storm gate (ISSUE 17) -------------------------------------
+    if fed.get("fed_unschedulable_p100", 1) != 0:
+        failures.append(
+            f"federation_storm left {fed.get('fed_unschedulable_p100')} pods "
+            "unschedulable at a round end across the surviving clusters "
+            "(must be zero under regional loss)"
+        )
+    if fed.get("fed_gangs_reentered_whole") is not True:
+        failures.append(
+            "federation_storm: the lost region's gangs did not all re-enter "
+            f"a surviving cluster WHOLE ({fed.get('gangs_failed_over')} "
+            "failed over) — the all-or-nothing regional failover broke"
+        )
+    ffrac = fed.get("fed_cost_vs_oracle_frac")
+    if ffrac is None or ffrac > FED_COST_BAND:
+        failures.append(
+            f"federation_storm mean cost {ffrac}x the single-global-cluster "
+            f"oracle (band {FED_COST_BAND}x)"
+        )
+    if fed.get("fed_replay_all_matched") is not True:
+        failures.append(
+            "federation_storm: not every captured federation capsule "
+            "replayed byte-identically (verdict digest or a per-cluster "
+            "sub-capsule diverged)"
+        )
+    if fed.get("audit_violations", 1) != 0:
+        failures.append(
+            f"federation_storm: {fed.get('audit_violations')} duplicate-"
+            "launch audit violations — a lease token was live in two "
+            "running clusters at once (the epoch fence broke)"
+        )
+    # vacuousness guards: the scenario must have actually blacked out a
+    # region, failed gangs over, granted leases, and captured BOTH failure
+    # shapes (>=1 degraded round, >=1 post-heal round) in the replayed set
+    if (
+        fed.get("blackouts", 0) < 1
+        or fed.get("gangs_failed_over", 0) < 1
+        or fed.get("leases_granted", 0) < 1
+    ):
+        failures.append(
+            "federation_storm exercised too little chaos "
+            f"(blackouts={fed.get('blackouts')}, "
+            f"gangs_failed_over={fed.get('gangs_failed_over')}, "
+            f"leases_granted={fed.get('leases_granted')}) — the scenario "
+            "regressed, the gate is vacuous"
+        )
+    if fed.get("degraded_rounds", 0) < 1 or fed.get(
+        "degraded_round_replays", 0
+    ) < 1:
+        failures.append(
+            "federation_storm captured no degraded (arbiter-partitioned) "
+            "round — the partition-tolerant degradation arm is vacuous"
+        )
+    if fed.get("post_heal_replays", 0) < 1:
+        failures.append(
+            "federation_storm captured no post-heal round — the rejoin "
+            "epoch-fence arm is vacuous"
         )
     # -- chaos soak gate (ISSUE 11) ------------------------------------------
     if soak.get("skipped_busy_box"):

@@ -53,6 +53,23 @@ SLICE_COORD = f"{GROUP}/slice-coord"
 # members and spot-diversification carriers.
 SLICE_ADJACENCY = f"{GROUP}/slice-adjacency"
 
+# Multi-region eligibility (federation/): a comma-separated region list (or
+# "*"/"any") on a pod — label or annotation — marking it eligible for
+# cross-cluster routing by the federation arbiter. Absent means
+# single-region: the federation gate never touches the pod. A gang's
+# affinity is its name-sorted first annotated member's (the same
+# deterministic first-member-wins convention gang_adjacency_mode uses).
+REGION_AFFINITY = f"{GROUP}/region-affinity"
+# Stamped (annotation) on every member of a gang re-entering the federation
+# after its home region blacked out: the region the gang failed over FROM.
+# Observability only — placement never reads it.
+FAILOVER_FROM = f"{GROUP}/failover-from"
+# Stamped (annotation) on every pod a federation transfer or failover moved
+# across clusters: the lease's client token. The fleet's launch audit joins
+# on it to prove no token is ever live in two clusters at once (the
+# double-launch the epoch fence prevents). Placement never reads it.
+FEDERATION_TOKEN = f"{GROUP}/federation-token"
+
 # Per-pod spot-diversification override (annotation): a fraction in (0, 1]
 # tightening/loosening settings.spot_diversification_max_frac for this pod's
 # group, or "none" to opt the group out of the gate. Pool identity affects

@@ -244,6 +244,24 @@ class Settings:
     # budget is (1 - target), burned as karpenter_tpu_slo_burn_rate{slo,
     # window} over fast (5m) and slow (1h) windows.
     slo_pod_ready_target_frac: float = 0.99
+    # multi-cluster federation (federation/): when enabled the operator runs
+    # a FederationClient against arbiter_endpoint — pushing capacity
+    # summaries every summary_interval_s and routing multi-region-eligible
+    # pods (karpenter.tpu/region-affinity) through placement leases. Every
+    # arbiter dependency is ADVISORY: an unreachable arbiter degrades this
+    # cluster to full local autonomy behind a circuit breaker.
+    federation_enabled: bool = False
+    # the global arbiter's base URL (e.g. "http://arbiter:8100"); required
+    # when federation is enabled.
+    arbiter_endpoint: str = ""
+    # placement-lease TTL: a lease older than this (or minted under an older
+    # federation epoch) is fenced — a healed partition cannot double-launch
+    # against it.
+    lease_ttl_s: float = 30.0
+    # cadence of capacity-summary pushes to the arbiter; also bounds how
+    # stale the arbiter's view of this cluster can be before its staleness
+    # sweep declares the region lost.
+    summary_interval_s: float = 10.0
 
     def validate(self) -> None:
         if not self.cluster_name:
@@ -341,6 +359,14 @@ class Settings:
             raise ValueError("sloPodReadyP99S must be > 0")
         if not 0 < self.slo_pod_ready_target_frac < 1:
             raise ValueError("sloPodReadyTargetFrac must be in (0, 1)")
+        if self.federation_enabled and not self.arbiter_endpoint:
+            raise ValueError(
+                "arbiterEndpoint is required when federation is enabled"
+            )
+        if self.lease_ttl_s <= 0:
+            raise ValueError("leaseTtlS must be > 0")
+        if self.summary_interval_s <= 0:
+            raise ValueError("summaryIntervalS must be > 0")
 
     # -- config system (reference: karpenter-global-settings ConfigMap,
     # settings.go:40-93; env/flag ingestion in the operator bootstrap) -------

@@ -224,6 +224,9 @@ class InterruptionController:
         # interruption->provisioning fast path: drained pods dirty the delta
         # encoder + arm the batch window synchronously (note_interrupted)
         self.provisioning = provisioning
+        # federation hook (operator wiring): realized risk events feed the
+        # arbiter through the next capacity summary; None = single-cluster
+        self.federation = None
         # cloud provider + settings enable the PROACTIVE rebalance path
         # (replacement launch needs a catalog and the risk penalty knob)
         self.provider = provider
@@ -542,6 +545,11 @@ class InterruptionController:
         else:
             self.risk_cache.record_rebalance(*pool)
         metrics.RISK_OBSERVATIONS.inc({"kind": kind})
+        if self.federation is not None:
+            # advisory feed: realized reclaims/rebalances reach the arbiter
+            # through the NEXT capacity summary (shared risk cache); the
+            # hook keeps the coupling explicit for the federation tests
+            self.federation.note_regional_risk(kind, pool)
 
     def _note_reclaim(self, instance_id: str) -> bool:
         """Exactly-once reclaim accounting: True only for the FIRST message

@@ -17,7 +17,7 @@ from __future__ import annotations
 
 import time
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from ..api import labels as wk
 from ..api.objects import Machine, Node, ObjectMeta, Pod, Provisioner
@@ -255,6 +255,13 @@ class ProvisioningController:
         # ticked down once per reconcile, expired entries dropped — bounded
         # by construction (every entry starts at gang_restart_boost_rounds).
         self._gang_restart_boost: Dict[str, int] = {}
+        # multi-cluster federation (federation/): the operator attaches a
+        # FederationClient when federation_enabled; the fleet harness also
+        # wires ``federation_transfer(pods, target) -> bool`` to physically
+        # move a routed unit. Both default off — with either absent the
+        # gate is a no-op and this controller IS the single-cluster system.
+        self.federation = None
+        self.federation_transfer: Optional[Callable[[List[Pod], str], bool]] = None
         cluster.watch(self._on_event)
         # lifecycle pruning: in-flight waterfalls for pods this cluster no
         # longer holds as pending are swept pre-scrape (deleted mid-flight)
@@ -336,6 +343,73 @@ class ProvisioningController:
                     self.batcher.note_arrival()
                 LIFECYCLE.intake(pod.name)
 
+    # -- federation gate ----------------------------------------------------
+    def _federation_gate(self, pods: List[Pod]) -> List[Pod]:
+        """Route multi-region-eligible units (``karpenter.tpu/
+        region-affinity``) through the federation arbiter. Gangs route as
+        ONE unit (atomicity crosses clusters); pods without the affinity
+        surface are never touched. Returns the pods that stay local."""
+        from ..federation.client import gang_region_affinity, region_affinity
+
+        fed = self.federation
+        by_gang: Dict[str, List[Pod]] = {}
+        lone: List[Tuple[Pod, List[str]]] = []
+        for p in pods:
+            regions = region_affinity(p)
+            if regions is None:
+                continue
+            g = p.pod_group()
+            if g:
+                by_gang.setdefault(g, []).append(p)
+            else:
+                lone.append((p, regions))
+        if not by_gang and not lone:
+            return pods
+        routed: set = set()
+        for gname in sorted(by_gang):
+            members = sorted(by_gang[gname], key=lambda p: p.meta.name)
+            regions = gang_region_affinity(members) or ["*"]
+            lease = fed.request_lease(
+                gname, regions, gang=gname, units=len(members)
+            )
+            self._route_unit(lease, members, routed)
+        for p, regions in sorted(lone, key=lambda t: t[0].meta.name):
+            lease = fed.request_lease(p.meta.name, regions, units=1)
+            self._route_unit(lease, [p], routed)
+        if not routed:
+            return pods
+        return [p for p in pods if p.meta.name not in routed]
+
+    def _route_unit(
+        self, lease: Optional[Dict], members: List[Pod], routed: set
+    ) -> None:
+        """Act on one unit's lease. Remote transfers are double-gated: the
+        lease must survive the epoch+TTL fence (``confirm``) AND the
+        transfer hook must succeed — anything less keeps the unit local,
+        which is always safe (local autonomy needs no fence)."""
+        fed = self.federation
+        if lease is None:
+            return  # degraded or no-capacity: schedule locally
+        target = lease.get("target")
+        if not target or target == fed.cluster_name:
+            return  # home IS the globally-cheapest cluster
+        transfer = self.federation_transfer
+        if transfer is None:
+            return  # advisory without a transfer path
+        if not fed.confirm(lease["token"]):
+            return  # fenced/expired lease: a healed partition lands here
+        if not transfer(list(members), target):
+            return
+        for p in members:
+            routed.add(p.meta.name)
+            DECISIONS.record(
+                "placement", "federation-routed", pod=p.meta.name,
+                reason=(
+                    f"leased to {target} "
+                    f"(epoch {lease.get('epoch')}, token {lease['token']})"
+                ),
+            )
+
     # -- the reconcile loop body -------------------------------------------
     def reconcile(self) -> ProvisioningResult:
         from ..utils.flightrecorder import FLIGHT
@@ -402,6 +476,19 @@ class ProvisioningController:
         if not pods:
             self.batcher.reset(upto_generation=batch_gen)
             return result
+
+        if self.federation is not None:
+            # the federation gate runs BEFORE the round-0 capsule capture: a
+            # pod routed to another cluster never enters this cluster's
+            # capsule, so the recorded round replays byte-identically with
+            # no federation client at all. Every gate outcome except a
+            # confirmed remote transfer keeps the pod local — degraded,
+            # no-capacity, unconfirmed fence, and home-is-cheapest all fall
+            # through to today's single-cluster path.
+            pods = self._federation_gate(pods)
+            if not pods:
+                self.batcher.reset(upto_generation=batch_gen)
+                return result
 
         provisioners = sorted(
             self.cluster.provisioners.values(), key=lambda p: -p.weight

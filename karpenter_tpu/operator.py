@@ -49,6 +49,11 @@ class Operator:
     drift: DriftController
     garbagecollect: GarbageCollectionController
     pricing: Optional[object] = None
+    # federation arbiter link (federation/client.py), present only when
+    # settings.federation_enabled: provisioning routes multi-region pods
+    # through it, interruption feeds it realized regional risk, and the
+    # summary tick rides the operator loop at summary_interval_s
+    federation: Optional[object] = None
     clock: Clock = field(default_factory=Clock)
     # state-observability scrapers (controllers/metricsscraper): periodic
     # cluster-state -> gauge controllers on the operator loop
@@ -221,6 +226,22 @@ class Operator:
             from .cloudprovider.pricing import PricingController
 
             pricing = PricingController(provider.pricing, clock=clock)
+        federation = None
+        if settings.federation_enabled:
+            from .federation.client import FederationClient
+
+            federation = FederationClient(
+                cluster_name=settings.cluster_name,
+                endpoint=settings.arbiter_endpoint,
+                settings=settings,
+                clock=clock,
+                provider=provider,
+                cluster=cluster,
+                risk_cache=risk_cache,
+            )
+            provisioning.federation = federation
+            if interruption is not None:
+                interruption.federation = federation
         drift = DriftController(cluster, provider, settings=settings, recorder=recorder)
         garbagecollect = GarbageCollectionController(
             cluster, provider, recorder=recorder, clock=clock
@@ -238,6 +259,7 @@ class Operator:
             drift=drift,
             garbagecollect=garbagecollect,
             pricing=pricing,
+            federation=federation,
             clock=clock,
             scrapers=build_scrapers(cluster),
         )
@@ -290,6 +312,13 @@ class Operator:
         if self.http_server is not None and getattr(self.http_server, "cells", None) is None:
             # late-bind the sharded-control-plane partition view the same way
             self.http_server.cells = self.provisioning.cell_status
+        if (
+            self.http_server is not None
+            and getattr(self.http_server, "federation", None) is None
+            and self.federation is not None
+        ):
+            # /debug/federation serves the client's live arbiter-link view
+            self.http_server.federation = self.federation.status
         try:
             self._run_loop(stop, tick)
         finally:
@@ -416,6 +445,16 @@ class Operator:
         if self.pricing is not None:
             controllers.append(
                 SingletonController("pricing", self.pricing.reconcile, interval=300.0)
+            )
+        if self.federation is not None:
+            # the capacity-summary heartbeat: failures degrade (the breaker
+            # opens, the gate schedules locally) — they never crash the loop,
+            # but the kit's backoff still paces a dead arbiter link
+            controllers.append(
+                SingletonController(
+                    "federation-summary", self.federation.tick,
+                    interval=self.settings.summary_interval_s,
+                )
             )
         controllers.append(SingletonController("drift", self.drift.reconcile, interval=300.0))
         controllers.append(

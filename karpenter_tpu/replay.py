@@ -111,12 +111,66 @@ def apply_overrides(capsule: Dict, overrides: Sequence[str]) -> Dict:
             _apply_risk_override(inputs, key[len("risk."):], value)
         elif key.startswith("provisioner."):
             _apply_provisioner_override(inputs, key[len("provisioner."):], value)
+        elif key.startswith("cluster."):
+            _apply_cluster_override(inputs, key[len("cluster."):], value)
         else:
             raise OverrideError(
                 f"unknown override {key!r} (use settings.*, offerings=..., "
-                "risk.<type>/<zone>/<ct>=<p>, provisioner.<name>.*)"
+                "risk.<type>/<zone>/<ct>=<p>, provisioner.<name>.*, "
+                "cluster.<name>.available=<bool>, cluster.<name>.risk.*=<p>)"
             )
     return capsule
+
+
+def _apply_cluster_override(inputs: Dict, sel: str, value: str) -> None:
+    """Federation counterfactuals: ``cluster.<name>.available=false`` drops
+    a member from the round ("where would this gang have landed if region A
+    were dead"), ``cluster.<name>.risk.<pool-or-*>=<p>`` repins a member
+    summary's pool risk (and recomputes its risk_peak) — the federation
+    analogue of the PR 7 risk-override machinery."""
+    if "available" not in inputs and "summaries" not in inputs:
+        raise OverrideError(
+            "cluster.* overrides apply to federation capsules only"
+        )
+    name, _, rest = sel.partition(".")
+    if not name or not rest:
+        raise OverrideError(
+            f"cluster override {sel!r} is not "
+            "cluster.<name>.available=<bool> or cluster.<name>.risk.<sel>=<p>"
+        )
+    known = set(inputs.get("available", {})) | set(inputs.get("summaries", {}))
+    if name not in known:
+        raise OverrideError(
+            f"unknown cluster {name!r} (capsule members: {sorted(known)})"
+        )
+    if rest == "available":
+        inputs.setdefault("available", {})[name] = _coerce_like(True, value)
+        return
+    if rest == "risk" or rest.startswith("risk."):
+        pool_sel = rest[len("risk."):] if rest.startswith("risk.") else "*"
+        try:
+            p = float(value)
+        except ValueError as e:
+            raise OverrideError(str(e)) from None
+        if not 0.0 <= p <= 1.0:
+            raise OverrideError(f"risk probability {p} not in [0, 1]")
+        summary = inputs.get("summaries", {}).get(name)
+        if summary is None:
+            raise OverrideError(
+                f"cluster {name!r} has no summary in this capsule"
+            )
+        risk = summary.setdefault("risk", {})
+        if pool_sel in ("*", ""):
+            for key in risk:
+                risk[key] = p
+            summary["risk_peak"] = p
+        else:
+            risk[pool_sel] = p  # pins pools the summary never saw, too
+            summary["risk_peak"] = max(risk.values()) if risk else 0.0
+        return
+    raise OverrideError(
+        f"unknown cluster override field {rest!r} (use available or risk.*)"
+    )
 
 
 def _apply_risk_override(inputs: Dict, sel: str, value: str) -> None:
@@ -533,6 +587,14 @@ def replay_capsule(
     if overrides:
         capsule = apply_overrides(capsule, overrides)
     controller_kind = capsule.get("controller", "provisioning")
+    if controller_kind == "federation":
+        # federation capsules carry no cluster/provider inputs of their own
+        # — the arbiter's verdict is a pure function of its recorded inputs,
+        # and the per-cluster rounds live in embedded sub-capsules
+        return _replay_federation(
+            capsule, counterfactual, forbid_network=forbid_network,
+            solver=solver,
+        )
     settings = settings_from_wire(capsule.get("inputs", {}).get("settings", {}))
     rid = f"replay.{next(_replay_seq)}"
     from contextlib import nullcontext
@@ -684,6 +746,70 @@ def replay_capsule(
         report["match"] = True if truncated else diffs["action_match"]
     report["diffs"] = diffs
     return report
+
+
+def _replay_federation(
+    capsule: Dict,
+    counterfactual: bool,
+    forbid_network: bool = True,
+    solver: Optional[str] = None,
+) -> Dict:
+    """Replay one federated round: re-run the arbiter's PURE verdict
+    function over the capsule's recorded inputs (requests in recorded
+    order, degraded requests included) and byte-compare verdict + digest;
+    then recursively replay every per-cluster sub-capsule. ``match`` is the
+    conjunction — a federated round only matches when the global routing
+    AND every local round reproduce."""
+    from .federation.arbiter import arbiter_verdict
+
+    inputs = capsule.get("inputs", {})
+    recorded_verdict = capsule.get("outputs", {}).get("verdict", {}) or {}
+    replayed_verdict = arbiter_verdict(inputs)
+    verdict_match = (
+        replayed_verdict.get("digest") == recorded_verdict.get("digest")
+        and replayed_verdict.get("assignments")
+        == recorded_verdict.get("assignments")
+        and replayed_verdict.get("rebalance")
+        == recorded_verdict.get("rebalance")
+    )
+    sub_reports: List[Dict] = []
+    for sub in capsule.get("sub_capsules", []):
+        # sub-capsules replay WITHOUT the federation overrides: a cluster
+        # counterfactual changes where units would route, not what a
+        # recorded local round actually solved
+        report = replay_capsule(
+            dict(sub.get("capsule") or {}),
+            forbid_network=forbid_network, solver=solver,
+        )
+        sub_reports.append({
+            "cluster": sub.get("cluster", ""),
+            "capsule_id": report.get("capsule_id", ""),
+            "match": report.get("match"),
+            "diffs": report.get("diffs", {}),
+        })
+    subs_match = all(r["match"] for r in sub_reports)
+    degraded = [
+        a for a in replayed_verdict.get("assignments", [])
+        if a.get("outcome") == "degraded-local"
+    ]
+    return {
+        "capsule_id": capsule.get("id", ""),
+        "controller": "federation",
+        "counterfactual": counterfactual,
+        "epoch": replayed_verdict.get("epoch"),
+        "replayed": {"verdict": replayed_verdict},
+        "recorded": {"verdict": recorded_verdict},
+        "truncated_by_error": False,
+        "sub_reports": sub_reports,
+        "diffs": {
+            "verdict_match": verdict_match,
+            "digest_recorded": recorded_verdict.get("digest"),
+            "digest_replayed": replayed_verdict.get("digest"),
+            "sub_capsules_match": subs_match,
+            "degraded_assignments": len(degraded),
+        },
+        "match": verdict_match and subs_match,
+    }
 
 
 def _actions_equal(a: Optional[Dict], b: Optional[Dict]) -> bool:
@@ -956,7 +1082,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "offerings=<type>/<zone>/<ct>=available|unavailable|"
                          "price:<x>, risk.<type>/<zone>/<ct>=<p>, "
                          "provisioner.<name>.limits.<res>=<qty>, "
-                         "provisioner.<name>.weight=<n>")
+                         "provisioner.<name>.weight=<n>; federation capsules: "
+                         "cluster.<name>.available=<bool>, "
+                         "cluster.<name>.risk.<pool-or-*>=<p>")
     ap.add_argument("--solver", default=None, choices=("tpu", "greedy"),
                     help="override the recorded solver")
     ap.add_argument("--json", action="store_true", help="emit the full report as JSON")
@@ -1024,6 +1152,19 @@ def _print_summary(report: Dict) -> None:
               f"({rejected} rejected) "
               f"equal={diffs.get('validation_match')}")
         print(f"  decisions: equal={diffs.get('decisions_match')}")
+    elif report["controller"] == "federation":
+        verdict = report.get("replayed", {}).get("verdict", {})
+        print(f"  epoch: {report.get('epoch')}  "
+              f"assignments: {len(verdict.get('assignments') or [])} "
+              f"({diffs.get('degraded_assignments', 0)} degraded-local)  "
+              f"rebalance: {len(verdict.get('rebalance') or [])}")
+        print(f"  verdict digest: recorded={diffs.get('digest_recorded')} "
+              f"replayed={diffs.get('digest_replayed')} "
+              f"equal={diffs.get('verdict_match')}")
+        for sub in report.get("sub_reports", []):
+            print(f"  sub-capsule {sub['capsule_id']} "
+                  f"({sub['cluster']}): match={sub['match']}")
+        print(f"  sub_capsules_match={diffs.get('sub_capsules_match')}")
     elif report["controller"] == "rebalance":
         rep = report.get("replayed", {})
         for a in rep.get("rebalance_actions") or []:

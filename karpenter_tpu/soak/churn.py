@@ -56,6 +56,15 @@ KINDS = (
     "device-fault-burst",  # script N device-path faults in the operator's solver
     "apiserver-restart",  # bounce the apiserver listener (store survives)
     "operator-restart",   # SIGKILL (crash) or SIGTERM (clean) + respawn
+    # federation fault domain (federation/fleet.py consumes these): regional
+    # compute loss vs. control-plane partition are DIFFERENT failures — a
+    # blackout loses the gangs (whole-gang failover fires), a partition only
+    # degrades the arbiter link (region schedules locally, keeps its gangs)
+    "region-blackout",      # a whole region's compute goes dark
+    "region-heal",          # the blacked-out region rejoins empty
+    "arbiter-partition",    # a region loses its arbiter link (compute fine)
+    "arbiter-heal",         # the partitioned link recovers
+    "regional-spot-storm",  # reclaim a fraction of ONE region's spot nodes
 )
 
 
@@ -184,6 +193,25 @@ class ChurnScript:
 
         def operator_restart(self, signal: str = "kill") -> "ChurnScript":
             return self._add("operator-restart", signal=signal)
+
+        def region_blackout(self, region: str, duration_s: float) -> "ChurnScript":
+            self._add("region-blackout", region=region)
+            return self._script.add(ChurnEvent(
+                t=self._t + duration_s, kind="region-heal",
+                params=_params(region=region),
+            ))
+
+        def arbiter_partition(self, region: str, duration_s: float) -> "ChurnScript":
+            self._add("arbiter-partition", region=region)
+            return self._script.add(ChurnEvent(
+                t=self._t + duration_s, kind="arbiter-heal",
+                params=_params(region=region),
+            ))
+
+        def regional_spot_storm(self, region: str,
+                                fraction: float = 0.5) -> "ChurnScript":
+            return self._add("regional-spot-storm", region=region,
+                             fraction=fraction)
 
     def at(self, t: float) -> "_At":
         return self._At(self, t)
@@ -406,3 +434,41 @@ class ChurnScript:
         for frac in apiserver_restarts:
             events.append(ChurnEvent(t=duration_s * frac, kind="apiserver-restart"))
         return cls(events=events, seed=seed, clock=clock)
+
+
+def federation_storm_script(
+    storm_region: str,
+    blackout_region: str,
+    partition_region: str,
+    round_s: float = 10.0,
+    rounds: int = 12,
+    storm_fraction: float = 0.5,
+    clock: Callable[[], float] = time.monotonic,
+) -> ChurnScript:
+    """The canonical federation survivability timeline — deterministic and
+    seedless (every offset is a pure function of the arguments), so the bench
+    and a triage re-run drive identical fault sequences. One pass exercises
+    every federation fault kind: an arbiter partition (degraded-local rounds)
+    that heals, a regional spot storm, and a full region blackout held long
+    enough for the staleness sweep to declare it lost and fail its gangs over
+    whole, then a heal so post-heal rounds (epoch-bumped rejoin) are captured
+    too."""
+    span = round_s * rounds
+    script = ChurnScript(clock=clock)
+    # partition early: degraded rounds must appear BEFORE the blackout so the
+    # capture window holds both failure shapes independently
+    script.at(round_s * 1).arbiter_partition(partition_region,
+                                             duration_s=round_s * 2)
+    script.at(round_s * 4).regional_spot_storm(storm_region,
+                                               fraction=storm_fraction)
+    # hold the blackout past the staleness sweep (fleet summary_stale_s is
+    # under 2 rounds) so the arbiter declares the region lost and the
+    # whole-gang failover fires, then heal with rounds to spare
+    script.at(round_s * 5).region_blackout(blackout_region,
+                                           duration_s=round_s * 4)
+    if script.last_t() >= span:
+        raise ValueError(
+            f"federation storm timeline ({script.last_t():g}s) does not fit "
+            f"in {rounds} rounds of {round_s:g}s — raise `rounds`"
+        )
+    return script

@@ -97,6 +97,72 @@ def bound_members(cluster, group: str) -> List[Pod]:
     return out
 
 
+#: node-selector keys that are coordinates of the REGION a pod ran in, not
+#: of the workload: a failover clone crossing regions must shed them or it
+#: arrives unschedulable (the new region has different zones/ICI domains)
+_REGIONAL_SELECTOR_KEYS = (
+    wk.ZONE,
+    wk.HOSTNAME,
+    wk.SLICE_POD,
+    wk.SLICE_COORD,
+)
+
+
+def failover_clone(pod: Pod, from_region: Optional[str] = None) -> Pod:
+    """A fresh PENDING copy of a (possibly bound) pod for cross-region
+    movement: new identity (uid, resource_version), no node binding, the
+    regional coordinate pins stripped, and — when ``from_region`` is given
+    (the blackout-failover path; plain federation transfers pass None) — a
+    ``failover-from`` annotation for observability. Gang labels/annotations
+    (and hence min-members and region-affinity) survive verbatim — gang
+    atomicity crosses the region boundary intact."""
+    from ..api.objects import new_uid
+
+    clone = dataclasses.replace(pod)
+    annotations = dict(pod.meta.annotations)
+    if from_region:
+        annotations[wk.FAILOVER_FROM] = from_region
+    clone.meta = dataclasses.replace(
+        pod.meta,
+        uid=new_uid(),
+        labels=dict(pod.meta.labels),
+        annotations=annotations,
+        finalizers=[],
+        deletion_timestamp=None,
+        resource_version=0,
+    )
+    clone.node_selector = {
+        k: v
+        for k, v in pod.node_selector.items()
+        if k not in _REGIONAL_SELECTOR_KEYS
+    }
+    clone.node_name = None
+    clone.phase = "Pending"
+    clone.__dict__.pop("_sched_sig", None)
+    return clone
+
+
+def regional_failover_gangs(
+    pods: Sequence[Pod], from_region: str
+) -> Dict[str, List[Pod]]:
+    """The whole-gang failover set for a lost region: every gang with at
+    least one member in ``pods`` re-enters as a COMPLETE list of fresh
+    pending clones (bound and pending members alike — a gang must never
+    cross regions partially). Keyed by gang name, members name-sorted;
+    lone (gangless) pods are not this function's business — the fleet
+    re-creates them individually."""
+    by_group: Dict[str, List[Pod]] = {}
+    for p in pods:
+        g = p.pod_group()
+        if g:
+            by_group.setdefault(g, []).append(p)
+    out: Dict[str, List[Pod]] = {}
+    for name in sorted(by_group):
+        members = sorted(by_group[name], key=lambda p: p.meta.name)
+        out[name] = [failover_clone(p, from_region) for p in members]
+    return out
+
+
 @dataclass
 class GangPlacement:
     """One gang's view of a solve result."""

@@ -528,6 +528,19 @@ class FlightRecorder:
             except OSError:
                 pass  # a full/unwritable disk must not fail the reconcile
 
+    def commit_external(self, capsule: Dict) -> None:
+        """Admit a capsule assembled OUTSIDE a CapsuleBuilder — the
+        federation fleet builds its round capsules by hand (arbiter inputs +
+        verdict + per-cluster sub-capsules) and commits them here so they
+        ride the same ring, /debug surface, and anomaly auto-dump as
+        reconcile capsules. The capsule must carry ``id``, ``controller``
+        and (optionally) ``anomalies``."""
+        if not self.enabled or getattr(_suppress, "on", False):
+            return
+        capsule.setdefault("timestamp", time.time())
+        capsule.setdefault("anomalies", [])
+        self._commit(capsule, list(capsule.get("anomalies", [])))
+
     # -- export -------------------------------------------------------------
     def list(self) -> List[Dict]:
         """Newest-first capsule summaries (the /debug/flightrecorder list)."""

@@ -38,6 +38,10 @@ Debug surface (the pprof-flag analogue, always on and cheap):
   waterfall (intake -> batch -> solve -> validate -> launch -> bind, wait
   vs in-stage decomposition) cross-linked to its trace_id, reconcile_id
   and DecisionRecords.
+* ``/debug/federation`` — the federation client's view of the global arbiter
+  (federation/client.py): mode (federated vs degraded), per-route breaker
+  states, last error, summary seq and the degraded-lease backlog size.
+  ``{"enabled": false}`` while ``federation_enabled`` is off.
 * ``/debug/slo`` — the SLO burn-rate engine (utils/slo.py): per objective,
   the configured threshold/target, per-window (fast/slow) good/bad traffic
   and burn rate, and error budget remaining.
@@ -72,6 +76,7 @@ class OperatorHTTPServer:
         decisions: Optional[DecisionLog] = None,
         flightrecorder: Optional[FlightRecorder] = None,
         cells: Optional[Callable[[Optional[str]], dict]] = None,
+        federation: Optional[Callable[[], dict]] = None,
         host: str = "127.0.0.1",
     ):
         self.registry = registry or REGISTRY
@@ -93,6 +98,10 @@ class OperatorHTTPServer:
         # None) -> payload; like the recorder, the operator late-binds this
         # when it adopts a server started before the controllers existed
         self.cells = cells
+        # federation client status: a zero-arg callable -> payload, late-bound
+        # by the operator when settings.federation_enabled (same adoption
+        # pattern as `cells`)
+        self.federation = federation
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -222,6 +231,12 @@ class OperatorHTTPServer:
                             LIFECYCLE.snapshot(limit=limit), default=str
                         ).encode()
                         self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                elif path == "/debug/federation":
+                    fn = outer.federation
+                    payload = fn() if fn is not None else {"enabled": False}
+                    body = json.dumps(payload, default=str).encode()
+                    self.send_response(200)
                     self.send_header("Content-Type", "application/json")
                 elif path == "/debug/slo":
                     body = json.dumps(SLO.snapshot(), default=str).encode()

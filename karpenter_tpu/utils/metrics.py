@@ -411,6 +411,35 @@ SPOT_DIVERSIFICATION = Counter(
          "enforcement yielded — placement outranks spread).",
     registry=REGISTRY,
 )
+# multi-cluster federation (federation/arbiter.py): lease routing outcomes,
+# the fencing epoch, and per-cluster summary freshness. Summary-age series
+# are replaced wholesale by the pre-scrape refresher (replace_series), so a
+# cluster that leaves the federation takes its series with it.
+FEDERATION_LEASES = Counter(
+    "karpenter_tpu_federation_leases_total",
+    help="Federation arbiter lease outcomes, labeled by outcome: granted "
+         "(fresh lease minted), renewed (idempotent re-request of a valid "
+         "lease), no-capacity, degraded-local (cluster scheduled on local "
+         "authority behind an open arbiter breaker), confirmed / fenced / "
+         "expired / unknown (lease confirmation verdicts — fenced means an "
+         "epoch bump invalidated the lease), stale-seq (summary intake "
+         "dropped a duplicate or reordered delivery).",
+    registry=REGISTRY,
+)
+FEDERATION_EPOCH = Gauge(
+    "karpenter_tpu_federation_epoch",
+    help="Current federation fencing epoch; bumps on every membership "
+         "transition (region lost or rejoined) and invalidates every "
+         "outstanding placement lease.",
+    registry=REGISTRY,
+)
+FEDERATION_SUMMARY_AGE = Gauge(
+    "karpenter_tpu_federation_summary_age_seconds",
+    help="Age of each member cluster's last accepted capacity summary, "
+         "labeled by cluster (pre-scrape refreshed; stale members past the "
+         "staleness window are declared lost by the arbiter sweep).",
+    registry=REGISTRY,
+)
 CLOUDPROVIDER_DURATION = Histogram(
     "karpenter_tpu_cloudprovider_duration_seconds",
     help="Cloud provider API call latency, labeled by method.",

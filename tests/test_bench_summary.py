@@ -63,6 +63,16 @@ def test_dry_run_last_stdout_line_is_json_summary():
     assert summary["devfault_breaker_reclosed"] is True
     assert summary["devfault_fallback_p50_ms"] is not None
     assert "devfault_validator_overhead_pct" in summary
+    # the ISSUE-17 federation-survivability fields ride the summary; the
+    # tiny 3-cluster storm RUNS in dry-run, so the survivability verdicts
+    # are concrete (the COST band is gated only at the regression gate's
+    # full scale — toy workloads can't amortize regional fragmentation)
+    assert summary["fed_unschedulable_p100"] == 0
+    assert summary["fed_gangs_reentered_whole"] is True
+    assert summary["fed_replay_all_matched"] is True
+    assert summary["fed_cost_vs_oracle_frac"] is not None
+    assert summary["fed_degraded_rounds"] >= 1
+    assert summary["fed_audit_violations"] == 0
     # the ISSUE-16 lifecycle-attribution fields ride the summary; the tiny
     # ABBA guard RUNS in dry-run, so the waterfall verdicts are concrete
     assert "lifecycle_overhead_pct" in summary
@@ -183,6 +193,28 @@ class TestArtifactWriter:
         assert rt["lifecycle_within_budget"] is True
         assert rt["pod_ready_dominant_stage"] == "solve"
         assert rt["lifecycle_stage_sum_over_e2e"] == 1.0
+
+    def test_federation_summary_fields_round_trip(self):
+        # ISSUE-17 satellite: the federation-survivability verdicts (zero
+        # unschedulable, gangs re-entered whole, cost vs the single-global-
+        # cluster oracle, all-capsules-replayed) survive the artifact
+        # writer byte-for-byte
+        summary = json.dumps({
+            "metric": "m", "summary": True,
+            "fed_unschedulable_p100": 0,
+            "fed_gangs_reentered_whole": True,
+            "fed_cost_vs_oracle_frac": 1.0123,
+            "fed_replay_all_matched": True,
+            "fed_degraded_rounds": 1,
+            "fed_audit_violations": 0,
+        })
+        artifact = bench_artifact.build_artifact(17, "cmd", 0, summary + "\n")
+        assert artifact["parsed"] == json.loads(summary)
+        rt = json.loads(json.dumps(artifact, allow_nan=False))["parsed"]
+        assert rt["fed_gangs_reentered_whole"] is True
+        assert rt["fed_replay_all_matched"] is True
+        assert rt["fed_cost_vs_oracle_frac"] == 1.0123
+        assert rt["fed_unschedulable_p100"] == 0
 
     def test_end_to_end_subprocess_write(self, tmp_path):
         fake = tmp_path / "fakebench.py"

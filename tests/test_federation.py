@@ -1,0 +1,902 @@
+"""Multi-cluster federation survivability (ISSUE 17).
+
+Four layers under test, bottom-up:
+
+* the arbiter's PURE round verdict (``arbiter_verdict``) — determinism,
+  digest sensitivity, token idempotence, degraded-local recording, the
+  risk-adjusted target choice and rebalance hysteresis;
+* the live ``FederationArbiter`` — seq-monotonic summary intake under
+  adversarial delivery (the satellite partition/reorder property test),
+  staleness sweeps, epoch fencing of leases across membership transitions;
+* the ``FederationClient`` — breaker-backed degradation to local autonomy,
+  bounded breaker cardinality, recovery after heal, the /debug payload;
+* the ``FederatedFleet`` harness — whole-gang regional failover with the
+  no-duplicate-launch audit, degraded rounds, byte-identical federated
+  replay including cluster.* counterfactual overrides.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from karpenter_tpu.api import labels as wk
+from karpenter_tpu.api.objects import ObjectMeta, Pod
+from karpenter_tpu.api.resources import Resources
+from karpenter_tpu.api.settings import Settings
+from karpenter_tpu.cloudprovider import FakeCloudProvider, generate_catalog
+from karpenter_tpu.federation.arbiter import (
+    FederationArbiter,
+    arbiter_verdict,
+    install_federation_exporter,
+    verdict_digest,
+)
+from karpenter_tpu.federation.client import (
+    ROUTE_SUMMARY,
+    ROUTES,
+    DirectArbiterTransport,
+    FederationClient,
+    build_summary,
+    gang_region_affinity,
+    region_affinity,
+)
+from karpenter_tpu.federation.fleet import FederatedFleet
+from karpenter_tpu.operator import Operator
+from karpenter_tpu.replay import OverrideError, replay_capsule
+from karpenter_tpu.soak.churn import ChurnEvent, ChurnScript, federation_storm_script
+from karpenter_tpu.solver.gang import failover_clone, regional_failover_gangs
+from karpenter_tpu.utils import metrics
+from karpenter_tpu.utils.cache import FakeClock
+from karpenter_tpu.utils.flightrecorder import FLIGHT
+from karpenter_tpu.utils.httpserver import OperatorHTTPServer
+
+
+def _summary(cluster, seq=1, price=0.1, headroom=10, risk_peak=0.0, region=None):
+    return {
+        "cluster": cluster, "region": region or cluster, "seq": seq,
+        "marginal_price": price, "risk_peak": risk_peak, "headroom": headroom,
+    }
+
+
+def _inputs(summaries, requests, epoch=1, leases_before=(), now=100.0, ttl=30.0):
+    return {
+        "epoch": epoch,
+        "summaries": {s["cluster"]: s for s in summaries},
+        "available": {s["cluster"]: True for s in summaries},
+        "leases_before": list(leases_before),
+        "requests": list(requests),
+        "now": now,
+        "lease_ttl_s": ttl,
+    }
+
+
+def _req(token, cluster="us-east", regions=("*",), units=1, **extra):
+    return {
+        "token": token, "unit": token, "cluster": cluster,
+        "regions": list(regions), "units": units, **extra,
+    }
+
+
+# ---------------------------------------------------------------------------
+# the pure verdict
+# ---------------------------------------------------------------------------
+
+
+class TestArbiterVerdict:
+    def test_deterministic_and_digest_stamped(self):
+        inputs = _inputs(
+            [_summary("us-east", price=0.2), _summary("eu-west", price=0.1)],
+            [_req("t/a"), _req("t/b")],
+        )
+        v1 = arbiter_verdict(dict(inputs))
+        v2 = arbiter_verdict(dict(inputs))
+        assert v1 == v2
+        assert v1["digest"] == verdict_digest(v1)
+        assert all(a["target"] == "eu-west" for a in v1["assignments"])
+
+    def test_digest_sensitive_to_epoch_and_request_order(self):
+        summaries = [_summary("us-east", price=0.2), _summary("eu-west", price=0.1)]
+        base = arbiter_verdict(_inputs(summaries, [_req("t/a"), _req("t/b")]))
+        bumped = arbiter_verdict(
+            _inputs(summaries, [_req("t/a"), _req("t/b")], epoch=2)
+        )
+        reordered = arbiter_verdict(_inputs(summaries, [_req("t/b"), _req("t/a")]))
+        assert bumped["digest"] != base["digest"]
+        assert reordered["digest"] != base["digest"]
+
+    def test_token_idempotence_grants_then_renews_same_target(self):
+        v = arbiter_verdict(_inputs(
+            [_summary("us-east", price=0.2), _summary("eu-west", price=0.1)],
+            [_req("t/a"), _req("t/a")],
+        ))
+        first, second = v["assignments"]
+        assert first["outcome"] == "granted"
+        assert second["outcome"] == "renewed"
+        assert first["target"] == second["target"] == "eu-west"
+
+    def test_renewal_honors_pre_round_lease_not_fresh_choice(self):
+        lease = {"token": "t/a", "target": "us-east", "epoch": 1,
+                 "expires_at": 500.0}
+        v = arbiter_verdict(_inputs(
+            [_summary("us-east", price=0.9), _summary("eu-west", price=0.1)],
+            [_req("t/a")], leases_before=[lease],
+        ))
+        # eu-west is far cheaper, but the live lease pins the unit — moving
+        # a unit mid-lease is exactly the flapping the TTL exists to stop
+        assert v["assignments"][0]["outcome"] == "renewed"
+        assert v["assignments"][0]["target"] == "us-east"
+
+    def test_expired_and_fenced_leases_reroute(self):
+        stale = {"token": "t/a", "target": "us-east", "epoch": 1,
+                 "expires_at": 50.0}  # now=100 -> expired
+        fenced = {"token": "t/b", "target": "us-east", "epoch": 1,
+                  "expires_at": 500.0}  # epoch moved on
+        v = arbiter_verdict(_inputs(
+            [_summary("us-east", price=0.9), _summary("eu-west", price=0.1)],
+            [_req("t/a"), _req("t/b")], epoch=2,
+            leases_before=[stale, fenced],
+        ))
+        assert [a["outcome"] for a in v["assignments"]] == ["granted", "granted"]
+        assert [a["target"] for a in v["assignments"]] == ["eu-west", "eu-west"]
+
+    def test_degraded_request_records_local_authority(self):
+        v = arbiter_verdict(_inputs(
+            [_summary("us-east"), _summary("eu-west")],
+            [_req("t/a", cluster="us-west", degraded=True)],
+        ))
+        a = v["assignments"][0]
+        assert a["outcome"] == "degraded-local"
+        assert a["target"] == "us-west"
+
+    def test_no_capacity_when_no_eligible_cluster(self):
+        v = arbiter_verdict(_inputs(
+            [_summary("us-east", headroom=0)],
+            [_req("t/a"), _req("t/b", regions=("ap-south",))],
+        ))
+        assert all(a["outcome"] == "no-capacity" for a in v["assignments"])
+        assert all(a["target"] is None for a in v["assignments"])
+
+    def test_region_affinity_filters_candidates(self):
+        v = arbiter_verdict(_inputs(
+            [_summary("us-east", price=0.9), _summary("eu-west", price=0.1)],
+            [_req("t/a", regions=("us-east",))],
+        ))
+        assert v["assignments"][0]["target"] == "us-east"
+
+    def test_headroom_gates_gang_sized_units(self):
+        v = arbiter_verdict(_inputs(
+            [_summary("us-east", price=0.9, headroom=8),
+             _summary("eu-west", price=0.1, headroom=2)],
+            [_req("t/gang", units=4)],
+        ))
+        # cheapest can't fit a 4-unit gang: the pricier one with room wins
+        assert v["assignments"][0]["target"] == "us-east"
+
+    def test_risk_inflates_price_and_ties_break_on_name(self):
+        risky = arbiter_verdict(_inputs(
+            [_summary("us-east", price=0.10, risk_peak=0.8),
+             _summary("eu-west", price=0.12)],
+            [_req("t/a")],
+        ))
+        assert risky["assignments"][0]["target"] == "eu-west"
+        tied = arbiter_verdict(_inputs(
+            [_summary("us-east", price=0.1), _summary("eu-west", price=0.1)],
+            [_req("t/a")],
+        ))
+        assert tied["assignments"][0]["target"] == "eu-west"
+
+    def test_rebalance_pairs_spike_with_calm_and_hysteresis(self):
+        v = arbiter_verdict(_inputs(
+            [_summary("us-east", price=0.1, risk_peak=0.7),
+             _summary("us-west", price=0.1, risk_peak=0.3),  # calm-ish, NOT a target
+             _summary("eu-west", price=0.2, risk_peak=0.05)],
+            [],
+        ))
+        assert v["rebalance"] == [{
+            "from": "us-east", "to": "eu-west", "reason": "risk-spike",
+            "risk": 0.7,
+        }]
+
+
+# ---------------------------------------------------------------------------
+# live arbiter: intake defense, sweeps, epoch fencing
+# ---------------------------------------------------------------------------
+
+
+class TestArbiterIntake:
+    def _arbiter(self, stale_s=15.0, ttl=30.0):
+        clock = FakeClock(0.0)
+        return FederationArbiter(
+            lease_ttl_s=ttl, summary_stale_s=stale_s, clock=clock
+        ), clock
+
+    def test_stale_and_duplicate_seq_dropped(self):
+        arb, _ = self._arbiter()
+        assert arb.submit_summary(_summary("us-east", seq=3))["outcome"] == "accepted"
+        assert arb.submit_summary(_summary("us-east", seq=3))["outcome"] == "stale-seq"
+        assert arb.submit_summary(_summary("us-east", seq=1))["outcome"] == "stale-seq"
+        assert arb.state()["members"]["us-east"]["seq"] == 3
+
+    def test_adversarial_delivery_converges_to_seq_maxima(self):
+        # the satellite property test: three clusters' summary streams are
+        # delayed, duplicated, reordered and epoch-regressed; the member
+        # view must still converge to each cluster's seq high-water mark
+        arb, clock = self._arbiter()
+        clusters = ("us-east", "us-west", "eu-west")
+        deliveries = []
+        for c in clusters:
+            for seq in range(1, 6):
+                s = _summary(c, seq=seq, price=0.1 + seq / 100.0)
+                s["epoch"] = max(1, seq - 2)  # stale epoch views ride along
+                deliveries.append(s)
+                if seq % 2 == 0:
+                    deliveries.append(dict(s))  # duplicate delivery
+        # deterministic adversarial shuffle: reversed pairs, then stripes
+        deliveries = deliveries[1::2] + deliveries[0::2][::-1]
+        outcomes = []
+        for s in deliveries:
+            outcomes.append(arb.submit_summary(s)["outcome"])
+            clock.step(0.01)
+        assert set(outcomes) == {"accepted", "stale-seq"}
+        members = arb.state()["members"]
+        assert {c: m["seq"] for c, m in members.items()} == {
+            c: 5 for c in clusters
+        }
+        # convergence of the VIEW, not just the seq: each member's summary
+        # is its seq-5 payload regardless of delivery order
+        for c in clusters:
+            assert members[c]["marginal_price"] == pytest.approx(0.15)
+        # and no phantom membership transitions: nothing was declared lost,
+        # so the epoch never moved
+        assert arb.epoch == 1
+
+    def test_declare_lost_bumps_once_and_rejoin_bumps_again(self):
+        arb, _ = self._arbiter()
+        arb.submit_summary(_summary("us-east", seq=1))
+        e0 = arb.epoch
+        assert arb.declare_lost("us-east") is True
+        assert arb.declare_lost("us-east") is False  # already lost: no re-bump
+        assert arb.epoch == e0 + 1
+        assert arb.submit_summary(_summary("us-east", seq=2))["outcome"] == "accepted"
+        assert arb.epoch == e0 + 2  # rejoin is a membership transition too
+
+    def test_staleness_sweep_declares_silent_members_lost(self):
+        arb, clock = self._arbiter(stale_s=15.0)
+        arb.submit_summary(_summary("us-east", seq=1))
+        arb.submit_summary(_summary("eu-west", seq=1))
+        clock.step(10.0)
+        arb.submit_summary(_summary("eu-west", seq=2))  # keeps talking
+        clock.step(10.0)  # us-east now 20s silent, eu-west 10s
+        e0 = arb.epoch
+        assert arb.sweep_lost() == ["us-east"]
+        assert arb.epoch == e0 + 1
+        assert arb.sweep_lost() == []  # idempotent until another goes quiet
+
+    def test_no_lease_survives_an_epoch_bump(self):
+        arb, _ = self._arbiter()
+        arb.submit_summary(_summary("us-east", seq=1, price=0.1))
+        arb.submit_summary(_summary("eu-west", seq=1, price=0.2))
+        lease = arb.request_lease(_req("us-west/web-0", cluster="us-west"))
+        assert lease["outcome"] == "granted"
+        assert arb.confirm_lease("us-west/web-0")["outcome"] == "confirmed"
+        arb.declare_lost("eu-west")  # ANY membership transition fences ALL
+        confirm = arb.confirm_lease("us-west/web-0")
+        assert confirm == {
+            "outcome": "fenced", "valid": False, "epoch": arb.epoch,
+        }
+
+    def test_confirm_outcomes_unknown_expired_and_epoch_mismatch(self):
+        arb, clock = self._arbiter(ttl=30.0)
+        arb.submit_summary(_summary("us-east", seq=1))
+        assert arb.confirm_lease("nope")["outcome"] == "unknown"
+        arb.request_lease(_req("us-east/a", cluster="us-east"))
+        # a client claiming a different epoch than the arbiter's is fenced
+        # even while the lease row itself is current
+        assert arb.confirm_lease("us-east/a", epoch=99)["outcome"] == "fenced"
+        clock.step(31.0)
+        assert arb.confirm_lease("us-east/a")["outcome"] == "expired"
+
+    def test_lease_outcomes_land_on_the_counter(self):
+        arb, _ = self._arbiter()
+        arb.submit_summary(_summary("us-east", seq=1))
+        before = metrics.FEDERATION_LEASES.value({"outcome": "granted"})
+        arb.request_lease(_req("us-east/m", cluster="us-east"))
+        assert metrics.FEDERATION_LEASES.value({"outcome": "granted"}) == before + 1
+
+    def test_round_capsule_inputs_snapshot_before_requests(self):
+        arb, _ = self._arbiter()
+        arb.submit_summary(_summary("us-east", seq=1))
+        arb.begin_round()
+        arb.request_lease(_req("us-east/a", cluster="us-east"))
+        inputs, verdict = arb.round_capsule_parts(
+            [_req("us-west/b", cluster="us-west", degraded=True)]
+        )
+        assert inputs["leases_before"] == []  # pre-round: no lease yet
+        assert [r["token"] for r in inputs["requests"]] == [
+            "us-east/a", "us-west/b",
+        ]
+        outcomes = [a["outcome"] for a in verdict["assignments"]]
+        assert outcomes == ["granted", "degraded-local"]
+        # the capsule replays itself byte-identically right out of the gate
+        assert arbiter_verdict(inputs)["digest"] == verdict["digest"]
+
+
+# ---------------------------------------------------------------------------
+# the client: degradation, breaker bounds, recovery
+# ---------------------------------------------------------------------------
+
+
+class TestFederationClient:
+    def _client(self, **kw):
+        from karpenter_tpu.api.objects import Provisioner
+        from karpenter_tpu.state import Cluster
+
+        clock = FakeClock(0.0)
+        arb = FederationArbiter(clock=clock)
+        transport = DirectArbiterTransport(arb)
+        # a real catalog behind the summary: without one the summary carries
+        # the no-capacity sentinel (headroom 0) and no lease can ever land
+        cluster = Cluster()
+        cluster.add_provisioner(Provisioner(meta=ObjectMeta(name="default")))
+        client = FederationClient(
+            "us-east", transport=transport, clock=clock,
+            provider=FakeCloudProvider(catalog=generate_catalog(n_types=4)),
+            cluster=cluster,
+            recovery_timeout_s=kw.pop("recovery_timeout_s", 5.0),
+            breaker_clock=clock.now, **kw,
+        )
+        return client, transport, arb, clock
+
+    def test_mint_token_stable_per_unit(self):
+        client, _, _, _ = self._client()
+        assert client.mint_token("train-42") == "us-east/train-42"
+        assert client.mint_token("train-42") == client.mint_token("train-42")
+
+    def test_push_and_lease_happy_path(self):
+        client, _, arb, _ = self._client()
+        assert client.push_summary(launch_headroom=10) is True
+        assert client.mode == "federated"
+        lease = client.request_lease("web-0", ["*"])
+        assert lease is not None and lease["target"] == "us-east"
+        assert client.confirm(lease["token"]) is True
+        assert client.epoch_seen == arb.epoch
+
+    def test_partition_degrades_to_local_autonomy(self):
+        client, transport, _, _ = self._client()
+        client.push_summary(launch_headroom=10)
+        transport.partitioned = True
+        assert client.push_summary() is False
+        assert client.mode == "degraded"
+        assert client.request_lease("web-0", ["*"], gang=None) is None
+        log = client.drain_degraded_log()
+        assert len(log) == 1 and log[0]["degraded"] is True
+        assert log[0]["token"] == "us-east/web-0"
+        assert client.drain_degraded_log() == []  # drained exactly once
+        # an unreachable fence is NOT a confirmation — remote launches stop
+        assert client.confirm("us-east/web-0") is False
+
+    def test_breaker_cardinality_bounded_by_route_templates(self):
+        client, transport, _, _ = self._client()
+        transport.partitioned = True
+        for i in range(8):
+            client.push_summary()
+            client.request_lease(f"pod-{i}", ["*"])
+        # one breaker per route TEMPLATE, never per token/pod
+        assert set(client.status()["breakers"]) == set(ROUTES)
+        assert client.breakers.get(ROUTE_SUMMARY).state == "open"
+
+    def test_seq_advances_across_the_partition_no_stale_rejoin(self):
+        client, transport, arb, clock = self._client()
+        assert client.push_summary() is True
+        transport.partitioned = True
+        client.push_summary()  # fails, but burns a seq
+        client.push_summary()
+        transport.partitioned = False
+        clock.step(6.0)  # past recovery_timeout_s: half-open probe admitted
+        assert client.push_summary() is True
+        # the arbiter must never mistake the rejoin push for a retransmit
+        assert arb.state()["members"]["us-east"]["seq"] == client._seq
+
+    def test_mode_recovers_after_heal(self):
+        client, transport, _, clock = self._client()
+        transport.partitioned = True
+        for _ in range(3):
+            client.push_summary()
+        assert client.mode == "degraded"
+        transport.partitioned = False
+        clock.step(6.0)
+        assert client.push_summary() is True
+        assert client.mode == "federated"
+        assert client.last_error is None
+
+    def test_status_payload_shape(self):
+        client, transport, _, _ = self._client()
+        client.push_summary(launch_headroom=3)
+        client.request_lease("web-0", ["*"])
+        status = client.status()
+        assert status["enabled"] is True
+        assert status["cluster"] == status["region"] == "us-east"
+        assert status["mode"] == "federated"
+        assert status["summaries_pushed"] == 1
+        assert status["summaries_failed"] == 0
+        assert [l["token"] for l in status["leases"]] == ["us-east/web-0"]
+        assert set(status["breakers"]) == set(ROUTES)
+
+    def test_build_summary_no_capacity_sentinel(self):
+        s = build_summary("us-east", "us-east", seq=1, epoch=1)
+        # no offerings at all: priced out of every choice, zero headroom —
+        # the arbiter's chooser can never route work here
+        assert s["marginal_price"] == 1e18
+        assert s["headroom"] == 0
+
+    def test_build_summary_reads_catalog_and_risk(self):
+        provider = FakeCloudProvider(catalog=generate_catalog(n_types=6))
+        from karpenter_tpu.api.objects import Provisioner
+        from karpenter_tpu.state import Cluster
+
+        cluster = Cluster()
+        cluster.add_provisioner(Provisioner(meta=ObjectMeta(name="default")))
+        s = build_summary(
+            "us-east", "us-east", seq=2, epoch=1,
+            provider=provider, cluster=cluster, launch_headroom=7,
+        )
+        assert 0 < s["marginal_price"] < 1e17
+        assert s["per_zone_price"]
+        assert s["headroom"] == 7 and s["seq"] == 2
+
+
+class TestRegionAffinity:
+    def _pod(self, name="p", annotations=None, labels=None):
+        return Pod(
+            meta=ObjectMeta(
+                name=name, annotations=dict(annotations or {}),
+                labels=dict(labels or {}),
+            ),
+            requests=Resources(cpu="100m", memory="128Mi"),
+        )
+
+    def test_annotation_label_and_absent(self):
+        assert region_affinity(self._pod()) is None
+        assert region_affinity(
+            self._pod(annotations={wk.REGION_AFFINITY: " us-east , eu-west "})
+        ) == ["us-east", "eu-west"]
+        assert region_affinity(
+            self._pod(labels={wk.REGION_AFFINITY: "us-west"})
+        ) == ["us-west"]
+        assert region_affinity(
+            self._pod(annotations={wk.REGION_AFFINITY: " , "})
+        ) is None
+
+    def test_gang_affinity_is_first_annotated_member_name_sorted(self):
+        pods = [
+            self._pod("c-late", annotations={wk.REGION_AFFINITY: "eu-west"}),
+            self._pod("a-first"),
+            self._pod("b-mid", annotations={wk.REGION_AFFINITY: "us-east"}),
+        ]
+        assert gang_region_affinity(pods) == ["us-east"]
+        assert gang_region_affinity([self._pod("a"), self._pod("b")]) is None
+
+
+# ---------------------------------------------------------------------------
+# whole-gang failover clones
+# ---------------------------------------------------------------------------
+
+
+class TestFailoverClone:
+    def _bound_member(self, name, gang="train"):
+        pod = Pod(
+            meta=ObjectMeta(
+                name=name,
+                labels={wk.POD_GROUP: gang},
+                annotations={
+                    wk.POD_GROUP_MIN_MEMBERS: "2",
+                    wk.REGION_AFFINITY: "*",
+                },
+                owner_kind="Job",
+            ),
+            requests=Resources(cpu="500m", memory="512Mi"),
+        )
+        pod.node_selector = {wk.ZONE: "us-east-1a", "team": "ml"}
+        pod.node_name = "node-1"
+        pod.phase = "Running"
+        return pod
+
+    def test_clone_is_fresh_pending_identity_with_pins_stripped(self):
+        pod = self._bound_member("train-0")
+        clone = failover_clone(pod, "us-east")
+        assert clone.meta.uid != pod.meta.uid
+        assert clone.phase == "Pending" and clone.node_name is None
+        assert wk.ZONE not in clone.node_selector
+        assert clone.node_selector["team"] == "ml"  # non-regional pins survive
+        assert clone.meta.annotations[wk.FAILOVER_FROM] == "us-east"
+        # gang atomicity crosses the region boundary intact
+        assert clone.meta.labels[wk.POD_GROUP] == "train"
+        assert clone.meta.annotations[wk.POD_GROUP_MIN_MEMBERS] == "2"
+        # the source pod is untouched (the dead region's store is frozen)
+        assert pod.node_name == "node-1" and pod.phase == "Running"
+
+    def test_regional_failover_gangs_complete_and_sorted(self):
+        pods = [
+            self._bound_member("b-1", gang="b"),
+            self._bound_member("a-1", gang="a"),
+            self._bound_member("a-0", gang="a"),
+            Pod(meta=ObjectMeta(name="lone"), requests=Resources(cpu="100m")),
+        ]
+        gangs = regional_failover_gangs(pods, "us-east")
+        assert list(gangs) == ["a", "b"]
+        assert [p.meta.name for p in gangs["a"]] == ["a-0", "a-1"]
+        assert all(p.phase == "Pending" for p in gangs["a"])
+        assert "lone" not in {
+            p.meta.name for members in gangs.values() for p in members
+        }
+
+
+# ---------------------------------------------------------------------------
+# the federated fleet: survivability end-to-end
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def flight_ring():
+    FLIGHT.configure(64)
+    yield
+    FLIGHT.configure(0)
+
+
+def _fleet(**kw):
+    kw.setdefault("n_types", 6)
+    kw.setdefault("settings_overrides", {"interruption_penalty_cost": 0.5})
+    return FederatedFleet(**kw)
+
+
+class TestFederatedFleet:
+    def test_steady_state_binds_everything_and_replays(self, flight_ring):
+        fleet = _fleet()
+        fleet.add_gang("us-east", "train", members=3)
+        fleet.add_pods("us-west", "web", 4)
+        for _ in range(2):
+            fleet.run_round()
+        assert fleet.pending_total() == 0
+        assert fleet.gang_whole_in_one_cluster("train")
+        assert fleet.audit_violations == []
+        reports = fleet.replay_all()
+        assert reports and all(r["match"] for r in reports)
+
+    def test_partition_degrades_locally_and_heals(self, flight_ring):
+        fleet = _fleet()
+        fleet.add_pods("us-east", "seed", 2)
+        fleet.run_round()
+        fleet.partition("us-west")
+        # fresh multi-region work lands INSIDE the partition: the region
+        # must schedule it locally on its own authority, not stall
+        fleet.add_gang("us-west", "cut-off", members=2, regions="*")
+        fleet.run_round()
+        assert fleet.degraded_rounds >= 1
+        assert fleet.regions["us-west"].client.mode == "degraded"
+        assert fleet.gang_whole_in_one_cluster("cut-off")
+        assert fleet.pending_total() == 0
+        fleet.heal_partition("us-west")
+        fleet.run_round()
+        assert fleet.regions["us-west"].client.mode == "federated"
+        # the degraded round is IN the capsule stream and replays
+        degraded_reports = [
+            r for r in fleet.replay_all()
+            if r["diffs"].get("degraded_assignments", 0) > 0
+        ]
+        assert degraded_reports and all(r["match"] for r in degraded_reports)
+
+    def test_blackout_fails_gangs_over_whole_with_no_duplicates(self, flight_ring):
+        fleet = _fleet()
+        fleet.add_gang("eu-west", "train", members=3, regions="*")
+        fleet.add_pods("eu-west", "solo", 2, regions="*")
+        fleet.run_round()
+        assert fleet.gang_whole_in_one_cluster("train")
+        epoch_before = fleet.arbiter.epoch
+        fleet.blackout("eu-west")
+        for _ in range(3):  # staleness sweep needs ~2 silent rounds
+            fleet.run_round()
+        assert fleet.arbiter.epoch > epoch_before
+        assert "train" in fleet.failover_gangs
+        # the gang re-entered WHOLE — every member bound in exactly one
+        # surviving cluster — and no token runs in two clusters at once
+        assert fleet.gang_whole_in_one_cluster("train")
+        assert fleet.pending_total() == 0
+        assert fleet.audit_violations == []
+        surviving = [
+            name for name, rc in fleet.regions.items()
+            if not rc.blacked_out and any(
+                p.pod_group() == "train" for p in rc.cluster.pods.values()
+            )
+        ]
+        assert surviving and surviving != ["eu-west"]
+        # lone pods re-entered too, stamped with their failover provenance
+        refugees = [
+            p for name, rc in fleet.regions.items() if not rc.blacked_out
+            for p in rc.cluster.pods.values()
+            if p.meta.annotations.get(wk.FAILOVER_FROM) == "eu-west"
+        ]
+        assert len(refugees) == 5  # 3 gang members + 2 solo pods
+
+    def test_heal_rejoins_empty_and_fences_the_old_epoch(self, flight_ring):
+        fleet = _fleet()
+        fleet.add_gang("eu-west", "train", members=2, regions="*")
+        fleet.run_round()
+        fleet.blackout("eu-west")
+        for _ in range(3):
+            fleet.run_round()
+        lost_epoch = fleet.arbiter.epoch
+        fleet.heal("eu-west")
+        fleet.run_round()  # rejoin summary lands: another fence
+        assert fleet.arbiter.epoch > lost_epoch
+        assert fleet.regions["eu-west"].cluster.pods == {}
+        # the healed region must NOT still be running its old gang — the
+        # failed-over copy elsewhere is the only live one
+        assert fleet.gang_whole_in_one_cluster("train")
+        assert fleet.audit_violations == []
+        # the whole epic — pre-fault, lost, post-heal — replays byte-identically
+        reports = fleet.replay_all()
+        assert all(r["match"] for r in reports)
+        final_epoch = fleet.arbiter.epoch
+        assert any(r["epoch"] == final_epoch for r in reports)  # post-heal round
+
+
+# ---------------------------------------------------------------------------
+# federated replay: counterfactuals and guard rails
+# ---------------------------------------------------------------------------
+
+
+class TestFederatedReplayOverrides:
+    def _captured_capsule(self, flight_ring):
+        fleet = _fleet()
+        fleet.add_gang("us-east", "train", members=2, regions="*")
+        capsule = fleet.run_round()
+        granted = [
+            a for a in capsule["outputs"]["verdict"]["assignments"]
+            if a["outcome"] in ("granted", "renewed")
+        ]
+        assert granted
+        return capsule, granted[0]["target"]
+
+    def test_cluster_available_false_reroutes_the_round(self, flight_ring):
+        capsule, target = self._captured_capsule(flight_ring)
+        report = replay_capsule(
+            dict(capsule), overrides=[f"cluster.{target}.available=false"]
+        )
+        assert report["counterfactual"] is True
+        replayed = report["replayed"]["verdict"]["assignments"]
+        assert all(a["target"] != target for a in replayed)
+
+    def test_cluster_risk_override_repins_summary_and_peak(self, flight_ring):
+        capsule, target = self._captured_capsule(flight_ring)
+        report = replay_capsule(
+            dict(capsule), overrides=[f"cluster.{target}.risk.*=0.9"]
+        )
+        assert report["counterfactual"] is True
+        # a 0.9-risk member is a rebalance source (and a worse target)
+        rebalance = report["replayed"]["verdict"]["rebalance"]
+        assert any(d["from"] == target for d in rebalance)
+
+    def test_unknown_member_and_bad_selector_rejected(self, flight_ring):
+        capsule, _ = self._captured_capsule(flight_ring)
+        with pytest.raises(OverrideError, match="unknown cluster"):
+            replay_capsule(
+                dict(capsule), overrides=["cluster.mars.available=false"]
+            )
+        with pytest.raises(OverrideError, match="available or risk"):
+            replay_capsule(
+                dict(capsule), overrides=["cluster.us-east.color=blue"]
+            )
+
+    def test_cluster_override_refused_on_local_capsules(self, flight_ring):
+        capsule, _ = self._captured_capsule(flight_ring)
+        sub = capsule["sub_capsules"][0]["capsule"]
+        with pytest.raises(OverrideError, match="federation capsules only"):
+            replay_capsule(
+                dict(sub), overrides=["cluster.us-east.available=false"]
+            )
+
+
+# ---------------------------------------------------------------------------
+# metrics exporter, churn DSL, settings, /debug, operator wiring
+# ---------------------------------------------------------------------------
+
+
+class TestFederationMetrics:
+    def test_summary_age_series_track_and_prune_members(self):
+        clock = FakeClock(0.0)
+        arb = FederationArbiter(clock=clock)  # installs itself as exporter
+        arb.submit_summary(_summary("us-east", seq=1))
+        clock.step(4.0)
+        arb.submit_summary(_summary("eu-west", seq=1))
+        clock.step(2.0)
+        metrics.REGISTRY.exposition()  # pre-scrape refresher fires here
+        assert metrics.FEDERATION_SUMMARY_AGE.value(
+            {"cluster": "us-east"}
+        ) == pytest.approx(6.0)
+        assert metrics.FEDERATION_SUMMARY_AGE.value(
+            {"cluster": "eu-west"}
+        ) == pytest.approx(2.0)
+        assert metrics.FEDERATION_EPOCH.value() == float(arb.epoch)
+        # a replacement arbiter with fewer members prunes departed series
+        # atomically — no ghost cluster ages on the scrape page
+        arb2 = FederationArbiter(clock=clock)
+        arb2.submit_summary(_summary("ap-south", seq=1))
+        metrics.REGISTRY.exposition()
+        exposed = metrics.FEDERATION_SUMMARY_AGE.collect()
+        assert any("ap-south" in line for line in exposed)
+        assert not any("us-east" in line for line in exposed)
+        install_federation_exporter(None)
+        metrics.REGISTRY.exposition()
+        assert not any(
+            "cluster" in line for line in metrics.FEDERATION_SUMMARY_AGE.collect()
+            if not line.startswith("#")
+        )
+
+
+class TestFederationChurn:
+    def test_new_kinds_validate_and_unknown_rejected(self):
+        for kind in ("region-blackout", "region-heal", "arbiter-partition",
+                     "arbiter-heal", "regional-spot-storm"):
+            ChurnEvent(t=0.0, kind=kind, params={"region": "us-east"})
+        with pytest.raises(ValueError):
+            ChurnEvent(t=0.0, kind="region-meltdown")
+
+    def test_fault_builders_schedule_their_own_heals(self):
+        script = ChurnScript(clock=lambda: 0.0)
+        script.at(10.0).region_blackout("eu-west", duration_s=20.0)
+        script.at(5.0).arbiter_partition("us-west", duration_s=10.0)
+        script.at(40.0).regional_spot_storm("us-east", fraction=0.25)
+        events = [(e.t, e.kind) for e in script.due(now=100.0)]
+        assert events == [
+            (5.0, "arbiter-partition"),
+            (10.0, "region-blackout"),
+            (15.0, "arbiter-heal"),
+            (30.0, "region-heal"),
+            (40.0, "regional-spot-storm"),
+        ]
+        assert list(script.due(now=100.0)) == []  # each event fires once
+
+    def test_storm_script_deterministic_and_fits_guard(self):
+        def gen():
+            return federation_storm_script(
+                "us-east", "eu-west", "us-west",
+                round_s=10.0, rounds=12, clock=lambda: 0.0,
+            )
+
+        a = [(e.t, e.kind, dict(e.params)) for e in gen().due(now=1e9)]
+        b = [(e.t, e.kind, dict(e.params)) for e in gen().due(now=1e9)]
+        assert a == b  # seedless and replayable
+        kinds = [k for _, k, _ in a]
+        assert kinds.count("region-blackout") == 1
+        assert kinds.count("region-heal") == 1
+        assert "arbiter-partition" in kinds and "arbiter-heal" in kinds
+        with pytest.raises(ValueError, match="does not fit"):
+            federation_storm_script(
+                "us-east", "eu-west", "us-west",
+                round_s=10.0, rounds=5, clock=lambda: 0.0,
+            )
+
+
+class TestFederationSettings:
+    def test_enabled_requires_endpoint(self):
+        Settings(federation_enabled=True, arbiter_endpoint="http://a:1").validate()
+        with pytest.raises(ValueError, match="arbiterEndpoint"):
+            Settings(federation_enabled=True).validate()
+
+    def test_knob_ranges(self):
+        with pytest.raises(ValueError, match="leaseTtlS"):
+            Settings(lease_ttl_s=0).validate()
+        with pytest.raises(ValueError, match="summaryIntervalS"):
+            Settings(summary_interval_s=-1).validate()
+
+
+def _get(port, path):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}") as r:
+        return json.loads(r.read())
+
+
+class TestDebugFederationEndpoint:
+    def test_serves_client_status_when_wired(self):
+        clock = FakeClock(0.0)
+        arb = FederationArbiter(clock=clock)
+        client = FederationClient(
+            "us-east", transport=DirectArbiterTransport(arb), clock=clock,
+        )
+        client.push_summary(launch_headroom=2)
+        server = OperatorHTTPServer(port=0, federation=client.status).start()
+        try:
+            payload = _get(server.port, "/debug/federation")
+            assert payload["enabled"] is True
+            assert payload["cluster"] == "us-east"
+            assert payload["mode"] == "federated"
+            assert set(payload["breakers"]) == set(ROUTES)
+        finally:
+            server.stop()
+
+    def test_reports_disabled_when_federation_off(self):
+        server = OperatorHTTPServer(port=0).start()
+        try:
+            assert _get(server.port, "/debug/federation") == {"enabled": False}
+        finally:
+            server.stop()
+
+
+class TestArbiterHTTPServerE2E:
+    def test_client_drives_the_real_wire(self):
+        from karpenter_tpu.federation.server import ArbiterHTTPServer
+
+        clock = FakeClock(0.0)
+        arb = FederationArbiter(clock=clock)
+        server = ArbiterHTTPServer(arb, port=0).start()
+        try:
+            # a second cluster's summary gives the arbiter a routing choice
+            arb.submit_summary(_summary("eu-west", seq=1, price=0.02))
+            client = FederationClient(
+                "us-east", endpoint=server.endpoint, clock=clock,
+            )
+            assert client.push_summary() is True  # no-capacity sentinel rides too
+            lease = client.request_lease("train", ["*"], gang="train", units=2)
+            assert lease is not None and lease["target"] == "eu-west"
+            assert client.confirm(lease["token"]) is True
+            state = _get(server.port, "/v1/state")
+            assert set(state["members"]) == {"us-east", "eu-west"}
+            assert [l["token"] for l in state["leases"]] == ["us-east/train"]
+            # the fence over the wire: an epoch bump kills the confirm
+            arb.declare_lost("eu-west")
+            assert client.confirm(lease["token"]) is False
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/healthz"
+            ) as r:
+                assert r.read() == b"ok\n"
+        finally:
+            server.stop()
+
+    def test_missing_token_and_unknown_routes_rejected(self):
+        from karpenter_tpu.federation.server import ArbiterHTTPServer
+
+        arb = FederationArbiter(clock=FakeClock(0.0))
+        server = ArbiterHTTPServer(arb, port=0).start()
+        try:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{server.port}/v1/lease",
+                data=b"{}", method="POST",
+                headers={"Content-Type": "application/json"},
+            )
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(req)
+            assert err.value.code == 400
+            with pytest.raises(urllib.error.HTTPError) as err:
+                _get(server.port, "/v1/nope")
+            assert err.value.code == 404
+        finally:
+            server.stop()
+
+
+class TestOperatorWiring:
+    def test_new_wires_client_into_the_control_loops(self):
+        settings = Settings(
+            cluster_name="us-east",
+            federation_enabled=True,
+            arbiter_endpoint="http://127.0.0.1:1",
+            interruption_queue_name="q",
+            batch_idle_duration=0, batch_max_duration=0,
+        )
+        op = Operator.new(
+            provider=FakeCloudProvider(catalog=generate_catalog(n_types=4)),
+            settings=settings,
+        )
+        assert op.federation is not None
+        assert op.federation.cluster_name == "us-east"
+        assert op.provisioning.federation is op.federation
+        assert op.interruption.federation is op.federation
+
+    def test_disabled_by_default(self):
+        op = Operator.new(
+            provider=FakeCloudProvider(catalog=generate_catalog(n_types=4)),
+            settings=Settings(batch_idle_duration=0, batch_max_duration=0),
+        )
+        assert op.federation is None
+        assert getattr(op.provisioning, "federation", None) is None
