@@ -148,6 +148,22 @@ class Settings:
     # width is the largest power of two <= this (the compiled batch axis is
     # pow2-bucketed like every other kernel axis).
     fleet_max_batch: int = 16
+    # 2D meshed solver tier (parallel.mesh make_mesh2d): shard the kernel's
+    # option columns across an ``options`` device axis and the superproblem
+    # batch across a ``fleet`` axis, so one sharded round solves as ONE
+    # multi-chip device program. Off (the default): today's behavior — a 1D
+    # portfolio mesh when multiple devices are present, else single device,
+    # byte-identical round digests.
+    mesh_enabled: bool = False
+    # mesh shape as "OPTIONSxFLEET" device counts (e.g. "4x2"), or "auto"
+    # to derive one from the local device count (fleet axis 2 when >= 4
+    # devices, else 1). Ignored unless mesh_enabled; a shape the host
+    # cannot satisfy (fewer devices) degrades to the meshless path.
+    mesh_shape: str = "auto"
+    # cap on same-bucket cells entering ONE superproblem dispatch (the
+    # sharded batch axis of the meshed kernel). Only consulted on a 2D
+    # mesh; the effective width is the largest power of two <= this.
+    superproblem_max_cells: int = 64
     # AOT kernel executable cache (solver/jax_solver.py AOTCache): kernel
     # solves dispatch pre-built per-bucket executables; this enables the
     # persistent on-disk XLA compilation cache so a restarted operator
@@ -329,6 +345,20 @@ class Settings:
                 "fleetMaxBatch must be >= 2 (a 1-wide fleet is a per-cell "
                 "dispatch; use fleet_dispatch_enabled=false to disable)"
             )
+        if self.superproblem_max_cells < 2:
+            raise ValueError(
+                "superproblemMaxCells must be >= 2 (a 1-cell superproblem "
+                "is a fleet dispatch; use mesh_enabled=false to disable)"
+            )
+        if self.mesh_shape != "auto":
+            parts = self.mesh_shape.lower().split("x")
+            if len(parts) != 2 or not all(
+                p.isdigit() and int(p) >= 1 for p in parts
+            ):
+                raise ValueError(
+                    'meshShape must be "auto" or "OxF" device counts '
+                    '(e.g. "4x2")'
+                )
         if self.aot_cache_capacity < 1:
             raise ValueError("aotCacheCapacity must be >= 1")
         if self.device_staging_capacity_mb < 1:

@@ -1257,6 +1257,11 @@ class ProvisioningController:
             fleet_stats = stage_fleet(
                 [(solvers[i], staged[i]) for i in sorted(staged)],
                 max_batch=self.settings.fleet_max_batch,
+                superproblem_max_cells=(
+                    self.settings.superproblem_max_cells
+                    if self.settings.mesh_enabled
+                    else 0
+                ),
             )
             metrics.FLEET_ROUND_DISPATCHES.set(
                 float(fleet_stats["dispatches"])
@@ -1427,6 +1432,9 @@ class ProvisioningController:
             merged.stats["fleet_cells_batched"] = float(
                 fleet_stats["cells_batched"]
             )
+            merged.stats["superproblems"] = float(
+                fleet_stats.get("superproblems", 0)
+            )
         router.note_round_modes(modes)
         router.last_round = summaries
         metrics.CELLS_TOTAL.set(float(len(works)))
@@ -1533,6 +1541,20 @@ class ProvisioningController:
             clone, "dispatch_timeout_s"
         ):
             clone.dispatch_timeout_s = self.solver.dispatch_timeout_s
+        # meshed-tier config rides along: every clone must stamp the SAME
+        # mesh dims into its bucket keys as the main solver (superproblem
+        # grouping batches across clones — a mesh-config drift would split
+        # the groups) and share the resolved mesh object itself, so a round
+        # builds ONE device mesh, not one per cell
+        if hasattr(self.solver, "mesh_shape") and hasattr(clone, "mesh_shape"):
+            clone.mesh_shape = self.solver.mesh_shape
+            clone.superproblem_max_cells = getattr(
+                self.solver, "superproblem_max_cells",
+                clone.superproblem_max_cells,
+            )
+            if getattr(self.solver, "mesh", None) is not None:
+                clone.mesh = self.solver.mesh
+                clone.auto_mesh = False
         return clone
 
     # -- /debug/cells -------------------------------------------------------

@@ -152,12 +152,23 @@ class Operator:
             cache_dir=settings.aot_cache_dir,
             persist=settings.aot_cache_enabled,
         )
+        # 2D meshed solver tier: resolve the configured mesh shape against
+        # the devices this host actually has (None below 2 devices — the
+        # meshed tier is strictly multi-chip and a 1-device operator keeps
+        # byte-identical behavior)
+        mesh_shape = None
+        if settings.mesh_enabled:
+            from .parallel import parse_mesh_shape
+
+            mesh_shape = parse_mesh_shape(settings.mesh_shape)
         solver = solver or TPUSolver(
             aot_precompile=settings.aot_precompile_enabled,
             aot_donate=settings.aot_donate_inputs,
             device_staging=settings.device_staging_enabled,
             staging_capacity_mb=settings.device_staging_capacity_mb,
             dispatch_timeout_s=settings.kernel_dispatch_timeout_s,
+            mesh_shape=mesh_shape,
+            superproblem_max_cells=settings.superproblem_max_cells,
         )
         # kernel-backend circuit breaker thresholds (process-global board —
         # sweep worker clones share both the AOT cache and its quarantines)

@@ -6,10 +6,26 @@ strategies) is embarrassingly parallel, so members shard across chips via
 ``jax.sharding`` and the winner reduces with a single argmin — collectives ride ICI,
 no host round-trips. This is the data-parallel axis of the BASELINE north star
 ("vmapped FFD ... across TPU cores").
+
+Two mesh generations coexist here:
+
+* the legacy **1D portfolio mesh** (``make_mesh``) — portfolio members shard
+  over a single ``portfolio`` axis, problem tensors replicate; and
+* the **2D meshed solver tier** (``make_mesh2d``) — an ``options`` × ``fleet``
+  mesh where the candidate/option axis of the problem tensors themselves
+  partitions across the ``options`` axis (a 500k-pod partition's option
+  columns split across chips) and the superproblem batch axis (same-bucket
+  cells stacked by the sharded round) splits across ``fleet``, so a whole
+  sharded round is ONE multi-chip device program. Which tensor leaf lands on
+  which axis is decided by a ``match_partition_rules``-style rule table over
+  leaf NAMES (PARTITION_RULES): every leaf must match exactly one rule, and
+  an unmatched leaf is a hard error — a silently-replicated new tensor is
+  how sharding regressions are born.
 """
 
 from __future__ import annotations
 
+import re
 from typing import Optional, Sequence, Tuple
 
 import jax
@@ -17,6 +33,171 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 PORTFOLIO_AXIS = "portfolio"
+
+#: 2D meshed-tier axes: option/candidate columns × superproblem batch rows
+OPTIONS_AXIS = "options"
+FLEET_AXIS = "fleet"
+
+#: The sharding-rule table of the meshed solver tier, in match-first order:
+#: ``(leaf-name regex, PartitionSpec over the leaf's OWN dims)``. Option-axis
+#: tensors shard their O dim on ``options``; everything group-, existing-,
+#: zone- or scalar-shaped replicates (those axes are small and every option
+#: shard needs them whole); the portfolio member arrays replicate too — on
+#: the 2D tier the parallel axis IS the option axis, not K. The superproblem
+#: batch dim is NOT in the table: ``match_partition_rules`` prefixes
+#: ``fleet`` for batched leaves, so one table serves both B=1 and B>1.
+PARTITION_RULES: Tuple[Tuple[str, P], ...] = (
+    # option-axis problem tensors: O leads
+    (r"^(alloc|price|opt_zone|opt_valid)$", P(OPTIONS_AXIS)),
+    # compat is [G, O]: O is dim 1
+    (r"^compat$", P(None, OPTIONS_AXIS)),
+    # group-axis tensors, per-group zone quotas, relation bitmasks: replicate
+    (r"^(demand|demand_units|count|node_cap|quota|colocate)$", P()),
+    # existing-capacity slots and their relation bits: replicate
+    (r"^(ex_rem|ex_zone|ex_compat|ex_valid)$", P()),
+    (r"^rel_", P()),
+    # portfolio member arrays (orders/alphas/looks/rsvs/swaps): replicate
+    (r"^(orders|alphas|looks|rsvs|swaps)$", P()),
+)
+
+
+def match_partition_rules(
+    name: str,
+    shape: Sequence[int],
+    batch: bool = False,
+    rules: Sequence[Tuple[str, P]] = PARTITION_RULES,
+) -> P:
+    """The PartitionSpec for one problem-tensor leaf, by name.
+
+    Scalars (and 1-element leaves) are never partitioned. ``batch=True``
+    treats dim 0 as the superproblem batch axis (sharded on ``fleet``) and
+    matches the rule against the remaining member-rank dims. A leaf whose
+    name no rule covers raises — the table must stay exhaustive over
+    PackInputs + the member arrays (property-tested)."""
+    shape = tuple(shape)
+    inner = shape[1:] if batch else shape
+    lead = (FLEET_AXIS,) if batch else ()
+    if len(inner) == 0 or int(np.prod(inner, dtype=np.int64)) <= 1:
+        return P(*lead) if lead else P()
+    for rule, spec in rules:
+        if re.search(rule, name):
+            return P(*(lead + tuple(spec)))
+    raise ValueError(f"Partition rule not found for param: {name}")
+
+
+def _fit_spec_to_mesh(mesh: Mesh, spec: P, shape: Sequence[int]) -> P:
+    """Drop sharded axes a leaf cannot honor: a dim that does not divide its
+    mesh axis evenly (or an axis of size 1) replicates instead — a wrong
+    PartitionSpec would force XLA resharding collectives mid-dispatch."""
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    out = []
+    for i, ax in enumerate(tuple(spec)):
+        n = sizes.get(ax, 1)
+        if ax is None or n <= 1 or i >= len(shape) or shape[i] % n != 0:
+            out.append(None)
+        else:
+            out.append(ax)
+    return P(*out)
+
+
+def mesh_sharding(
+    mesh: Mesh, name: str, shape: Sequence[int], batch: bool = False
+) -> NamedSharding:
+    """Rule-table NamedSharding for one leaf on a 2D mesh."""
+    spec = match_partition_rules(name, shape, batch=batch)
+    return NamedSharding(mesh, _fit_spec_to_mesh(mesh, spec, shape))
+
+
+def is_mesh2d(mesh) -> bool:
+    """True when ``mesh`` is the 2D meshed-tier (options × fleet) mesh."""
+    return mesh is not None and OPTIONS_AXIS in getattr(mesh, "axis_names", ())
+
+
+def parse_mesh_shape(
+    value: Optional[str], n_devices: Optional[int] = None
+) -> Optional[Tuple[int, int]]:
+    """Resolve the ``mesh_shape`` setting to an ``(options, fleet)`` tuple.
+
+    ``"auto"`` splits the local devices: all of them on the option axis below
+    4 devices, a fleet axis of 2 from 4 up (the superproblem batch then
+    genuinely shards). An explicit ``"OxF"`` is taken verbatim. Returns None
+    when fewer than 2 devices are available — the meshed tier is strictly
+    multi-chip and single-device behavior must stay byte-identical."""
+    if n_devices is None:
+        n_devices = len(jax.devices())
+    if n_devices < 2:
+        return None
+    if value is None or value == "auto":
+        f = 2 if n_devices >= 4 else 1
+        return (n_devices // f, f)
+    o, _, f = value.partition("x")
+    shape = (int(o), int(f))
+    if shape[0] < 1 or shape[1] < 1 or shape[0] * shape[1] < 2:
+        return None
+    return shape
+
+
+def make_mesh2d(shape: Tuple[int, int]) -> Mesh:
+    """The 2D meshed-tier mesh: ``shape = (options, fleet)`` devices."""
+    devices = jax.devices()
+    o, f = shape
+    if o * f > len(devices):
+        raise ValueError(
+            f"mesh shape {o}x{f} needs {o * f} devices, have {len(devices)}"
+        )
+    arr = np.array(devices[: o * f]).reshape(o, f)
+    return Mesh(arr, (OPTIONS_AXIS, FLEET_AXIS))
+
+
+def mesh_axes_label(mesh: Mesh) -> str:
+    """``"4x2"``-style axes label for metrics/artifacts."""
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    return f"{sizes.get(OPTIONS_AXIS, 1)}x{sizes.get(FLEET_AXIS, 1)}"
+
+
+def shard_problem2d(mesh: Mesh, inputs, *member_arrays):
+    """Place a single (B=1) problem onto the 2D mesh per the rule table."""
+    import jax.numpy as jnp
+
+    fields = type(inputs)._fields
+    inputs = type(inputs)(*[
+        jax.device_put(
+            jnp.asarray(getattr(inputs, f)),
+            mesh_sharding(mesh, f, np.shape(getattr(inputs, f))),
+        )
+        for f in fields
+    ])
+    names = ("orders", "alphas", "looks", "rsvs", "swaps")
+    placed = tuple(
+        jax.device_put(jnp.asarray(a), mesh_sharding(mesh, n, np.shape(a)))
+        for n, a in zip(names, member_arrays)
+    )
+    return (inputs,) + placed
+
+
+def shard_superproblem(mesh: Mesh, b: int, inputs, *member_arrays):
+    """Place a stacked superproblem (leading batch axis ``b``) onto the 2D
+    mesh: batch rows split over ``fleet``, option columns over ``options``,
+    per the rule table. The sharded round's fleet staging calls this once
+    per dispatch — the whole round is then one multi-chip device program."""
+    import jax.numpy as jnp
+
+    fields = type(inputs)._fields
+    inputs = type(inputs)(*[
+        jax.device_put(
+            jnp.asarray(getattr(inputs, f)),
+            mesh_sharding(mesh, f, np.shape(getattr(inputs, f)), batch=True),
+        )
+        for f in fields
+    ])
+    names = ("orders", "alphas", "looks", "rsvs", "swaps")
+    placed = tuple(
+        jax.device_put(
+            jnp.asarray(a), mesh_sharding(mesh, n, np.shape(a), batch=True)
+        )
+        for n, a in zip(names, member_arrays)
+    )
+    return (inputs,) + placed
 
 
 def make_mesh(n_devices: Optional[int] = None) -> Mesh:
@@ -54,10 +235,25 @@ def shard_portfolio(
 
 
 def round_up_portfolio(k: int, mesh: Optional[Mesh]) -> int:
-    if mesh is None:
+    # the 2D meshed tier replicates the member arrays (its parallel axis is
+    # the option axis, not K), so no rounding applies there
+    if mesh is None or is_mesh2d(mesh):
         return k
     d = mesh.devices.size
     return ((k + d - 1) // d) * d
+
+
+def shard_aligned_options(o_bucket: int, mesh: Optional[Mesh]) -> int:
+    """Shard-aligned option padding: the padded O bucket must divide the
+    ``options`` axis evenly or the rule table degrades that leaf to
+    replication. Both are powers of two in practice, but lcm keeps this
+    correct for any explicit mesh shape."""
+    if not is_mesh2d(mesh):
+        return o_bucket
+    import math
+
+    n = dict(zip(mesh.axis_names, mesh.devices.shape)).get(OPTIONS_AXIS, 1)
+    return math.lcm(o_bucket, max(n, 1))
 
 
 def fleet_shardings(mesh: Mesh, b: int) -> Tuple[NamedSharding, NamedSharding]:

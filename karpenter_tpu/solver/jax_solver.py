@@ -35,6 +35,7 @@ over the small static zone axis.
 
 from __future__ import annotations
 
+import contextlib
 import functools
 import threading
 import time
@@ -45,6 +46,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
+
+from ..parallel.mesh import FLEET_AXIS, OPTIONS_AXIS
 
 # Plain numpy scalars, NEVER jnp: a module-level jnp scalar is a live device
 # array; captured as a jit closure constant it is re-fed to the executable on
@@ -129,6 +132,64 @@ def _greedy_fill(fit: jax.Array, want: jax.Array) -> jax.Array:
     return jnp.clip(want - before, 0, fit)
 
 
+# ---------------------------------------------------------------------------
+# Meshed-tier sharding constraints
+# ---------------------------------------------------------------------------
+#
+# On the 2D (options × fleet) mesh, the option axis of the problem tensors is
+# partitioned across chips. Left to itself XLA's SPMD partitioner tends to
+# all-gather the option-axis intermediates at the first argmin and run the
+# water-fill scan replicated — ``_pin`` pins the hot option-axis values to
+# their shard layout inside the loops so the partitioned layout survives the
+# whole program. The pins are PROVABLY INERT off the mesh: ``_PIN_MESH`` is
+# only ever non-None inside a ``mesh_constraints`` scope (the AOT compile of
+# a 2D-mesh bucket, serialized under the process-wide compile gate), so every
+# single-device or 1D-mesh trace takes the early return and the jaxpr is
+# byte-identical to the pre-mesh kernel.
+
+_PIN_MESH: list = [None]
+
+
+@contextlib.contextmanager
+def mesh_constraints(mesh):
+    """Activate ``_pin`` sharding constraints for traces under a 2D mesh.
+
+    Pair this with the mesh-keyed jit wrappers (``_get_jit(..., mesh=...)``):
+    those have per-mesh-shape trace caches, so a constrained trace can never
+    be served to an unconstrained caller."""
+    from ..parallel.mesh import is_mesh2d
+
+    prev = _PIN_MESH[0]
+    _PIN_MESH[0] = mesh if is_mesh2d(mesh) else None
+    try:
+        yield
+    finally:
+        _PIN_MESH[0] = prev
+
+
+def _pin(x: jax.Array, *spec) -> jax.Array:
+    """``with_sharding_constraint`` against the active 2D mesh, or identity.
+
+    ``spec`` names one mesh axis (or None) per dim of ``x`` at member rank;
+    under the superproblem vmap the ``spmd_axis_name=FLEET_AXIS`` batching
+    rule prefixes the batch axis automatically. Dims that do not divide
+    their mesh axis degrade to replicated rather than forcing XLA pad/slice
+    collectives."""
+    mesh = _PIN_MESH[0]
+    if mesh is None:
+        return x
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    clean = tuple(
+        ax
+        if ax is not None and sizes.get(ax, 1) > 1 and x.shape[i] % sizes[ax] == 0
+        else None
+        for i, ax in enumerate(spec)
+    )
+    return lax.with_sharding_constraint(x, NamedSharding(mesh, P(*clean)))
+
+
 def _shared_precompute(inputs: PackInputs, s_new: int, n_zones: int) -> _Shared:
     G, R = inputs.demand.shape
     O = inputs.price.shape[0]
@@ -167,8 +228,8 @@ def _shared_precompute(inputs: PackInputs, s_new: int, n_zones: int) -> _Shared:
     # to raw sizing (provider pods still place; requirers take what's left).
     row_fits = jnp.any((units_rsv > 0) & ok, axis=1, keepdims=True)  # [G, 1]
     units_rsv = jnp.where(~row_fits & (units_raw > 0), units_raw, units_rsv)
-    units = _finish(units_raw)
-    units_rsv = _finish(units_rsv)
+    units = _pin(_finish(units_raw), None, OPTIONS_AXIS)
+    units_rsv = _pin(_finish(units_rsv), None, OPTIONS_AXIS)
 
     units_f = units.astype(jnp.float32)
     rate = jnp.where(units > 0, inputs.price[None, :] / jnp.maximum(units_f, 1.0), INF)
@@ -197,7 +258,10 @@ def _shared_precompute(inputs: PackInputs, s_new: int, n_zones: int) -> _Shared:
     u2 = jnp.clip(u2, 0, IBIG)  # [G, O, G']
     u2 = jnp.minimum(u2, inputs.node_cap[None, None, :].astype(jnp.float32))
     ok2 = ok.T[None, :, :]  # [1, O, G'] — g' must be compatible with option o
-    val_pair = jnp.where(ok2 & (u2 > 0), u2 * lam[None, None, :], 0.0)
+    val_pair = _pin(
+        jnp.where(ok2 & (u2 > 0), u2 * lam[None, None, :], 0.0),
+        None, OPTIONS_AXIS, None,
+    )
 
     exok_pad = jnp.concatenate(
         [ex_ok, jnp.zeros((G, s_new), bool)], axis=1
@@ -259,7 +323,9 @@ def _pack_member(
         inputs.price[None, :] - LOOKAHEAD_DISCOUNT * val_t,
         LOOKAHEAD_FLOOR * inputs.price[None, :],
     )
-    price_t = jnp.where(look, price_eff, inputs.price[None, :])  # [T, O]
+    price_t = _pin(
+        jnp.where(look, price_eff, inputs.price[None, :]), None, OPTIONS_AXIS
+    )  # [T, O]
 
     # Static bucket structure: bucket z < Z restricts to zone z; bucket Z is
     # unrestricted (used by non-zone-limited groups).
@@ -367,24 +433,36 @@ def _pack_member(
         okb = opt_bucket_ok & (u > 0)[None, :]  # [Zb, O]
         wb = want[:, None]
         k_all = -(-wb // safe_u[None, :])  # ceil
-        lump_score = jnp.where(okb & (wb > 0), k_all.astype(jnp.float32) * pe[None, :], INF)
+        # the water-fill's option choice stays SHARDED on the options axis:
+        # without the pins XLA all-gathers the [Zb, O] score planes before
+        # every argmin and the whole scan runs replicated
+        lump_score = _pin(
+            jnp.where(okb & (wb > 0), k_all.astype(jnp.float32) * pe[None, :], INF),
+            None, OPTIONS_AXIS,
+        )
         o_lump, cost_lump = _argmin_tiebreak(lump_score, units_f, alpha)
         # mixed full-segment candidates must fit within the want (u <= want):
         # a rate-best node LARGER than the want gives n_full = 0, degenerating
         # mixed to the lump — the genuine two-piece mix (full nodes of a
         # mid-size type + one small tail node) needs u <= want
-        rate = jnp.where(
-            okb & (u[None, :] <= wb),
-            pe[None, :] / jnp.maximum(units_f, 1.0)[None, :],
-            INF,
+        rate = _pin(
+            jnp.where(
+                okb & (u[None, :] <= wb),
+                pe[None, :] / jnp.maximum(units_f, 1.0)[None, :],
+                INF,
+            ),
+            None, OPTIONS_AXIS,
         )
         o_rate, best_rate = _argmin_tiebreak(rate, units_f, alpha)
         c_rate = u[o_rate]  # [Zb]
         n_full = want // jnp.maximum(c_rate, 1)
         rem = want - n_full * c_rate
         rem_k = -(-rem[:, None] // safe_u[None, :])
-        rem_score = jnp.where(
-            okb & (rem[:, None] > 0), rem_k.astype(jnp.float32) * pe[None, :], INF
+        rem_score = _pin(
+            jnp.where(
+                okb & (rem[:, None] > 0), rem_k.astype(jnp.float32) * pe[None, :], INF
+            ),
+            None, OPTIONS_AXIS,
         )
         o_tail, tail_best = _argmin_tiebreak(rem_score, units_f, alpha)
         tail_cost = jnp.where(rem > 0, tail_best, 0.0)
@@ -654,10 +732,20 @@ class BucketKey(NamedTuple):
     # control plane's fleet dispatch); B == 1 is the classic single-problem
     # program and keeps the pre-fleet key/label shape.
     B: int = 1
+    # meshed-tier dims: the (options, fleet) device-mesh shape a 2D-mesh
+    # executable was partitioned for. (1, 1) is the un-meshed program and
+    # keeps the pre-mesh key/label shape — a sharded executable must never
+    # serve (or evict alongside) its single-device sibling.
+    MO: int = 1
+    MF: int = 1
 
     def label(self) -> str:
         base = f"g{self.G}o{self.O}e{self.E}s{self.S}z{self.Z}r{self.R}k{self.K}"
-        return base if self.B == 1 else f"{base}b{self.B}"
+        if self.B > 1:
+            base = f"{base}b{self.B}"
+        if self.MO > 1 or self.MF > 1:
+            base = f"{base}m{self.MO}x{self.MF}"
+        return base
 
 
 def bucket_key(g: int, o: int, e: int, s_new: int, z: int, r: int, k: int) -> BucketKey:
@@ -670,16 +758,23 @@ def bucket_key(g: int, o: int, e: int, s_new: int, z: int, r: int, k: int) -> Bu
 def _bucket_specs(key: BucketKey, mesh=None):
     """abstract input specs (ShapeDtypeStructs) for one bucket — what
     ``jit(...).lower(...)`` compiles against, no real arrays needed. With a
-    mesh, portfolio-axis arrays carry a PartitionSpec sharding over the
+    1D mesh, portfolio-axis arrays carry a PartitionSpec sharding over the
     device axis and problem tensors replicate (the pjit layout
     ``parallel.shard_portfolio`` produces at dispatch time). Fleet buckets
-    (B > 1) prefix EVERY spec with the batch axis; under a mesh the batch
+    (B > 1) prefix EVERY spec with the batch axis; under a 1D mesh the batch
     axis is the one sharded across devices (``parallel.fleet_shardings``) —
-    each device solves a slab of cells."""
+    each device solves a slab of cells. On the 2D meshed tier every leaf's
+    sharding comes from the rule table instead (``parallel.mesh_sharding``):
+    option columns split over ``options``, the superproblem batch over
+    ``fleet`` — matching ``shard_problem2d``/``shard_superproblem`` at
+    dispatch time."""
     G, O, E, S, Z, R, K = key.G, key.O, key.E, key.S, key.Z, key.R, key.K
     B = key.B
+    from ..parallel.mesh import is_mesh2d
+
+    mesh2d = is_mesh2d(mesh)
     member = replicated = None
-    if mesh is not None:
+    if mesh is not None and not mesh2d:
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         from ..parallel.mesh import PORTFOLIO_AXIS, fleet_shardings
@@ -690,50 +785,61 @@ def _bucket_specs(key: BucketKey, mesh=None):
             member = NamedSharding(mesh, P(PORTFOLIO_AXIS))
             replicated = NamedSharding(mesh, P())
 
-    def spec(shape, dtype, shard):
+    def spec(shape, dtype, shard, name=None):
         if B > 1:
             shape = (B,) + tuple(shape)
+        if mesh2d and name is not None:
+            from ..parallel.mesh import mesh_sharding
+
+            shard = mesh_sharding(mesh, name, shape, batch=B > 1)
         if shard is None:
             return jax.ShapeDtypeStruct(shape, dtype)
         return jax.ShapeDtypeStruct(shape, dtype, sharding=shard)
 
     f32, i32, b = jnp.float32, jnp.int32, jnp.bool_
     inputs = PackInputs(
-        demand=spec((G, R), f32, replicated),
-        demand_units=spec((G, R), f32, replicated),
-        count=spec((G,), i32, replicated),
-        node_cap=spec((G,), i32, replicated),
-        quota=spec((G, Z), i32, replicated),
-        colocate=spec((G,), b, replicated),
-        compat=spec((G, O), b, replicated),
-        alloc=spec((O, R), f32, replicated),
-        price=spec((O,), f32, replicated),
-        opt_zone=spec((O,), i32, replicated),
-        opt_valid=spec((O,), b, replicated),
-        ex_rem=spec((E, R), f32, replicated),
-        ex_zone=spec((E,), i32, replicated),
-        ex_compat=spec((G, E), b, replicated),
-        ex_valid=spec((E,), b, replicated),
-        rel_set=spec((G,), i32, replicated),
-        rel_host_forbid=spec((G,), i32, replicated),
-        rel_host_need=spec((G,), i32, replicated),
-        rel_zone_forbid=spec((G,), i32, replicated),
-        rel_zone_need=spec((G,), i32, replicated),
-        rel_slot_bits=spec((E,), i32, replicated),
-        rel_zone_bits=spec((Z,), i32, replicated),
+        demand=spec((G, R), f32, replicated, "demand"),
+        demand_units=spec((G, R), f32, replicated, "demand_units"),
+        count=spec((G,), i32, replicated, "count"),
+        node_cap=spec((G,), i32, replicated, "node_cap"),
+        quota=spec((G, Z), i32, replicated, "quota"),
+        colocate=spec((G,), b, replicated, "colocate"),
+        compat=spec((G, O), b, replicated, "compat"),
+        alloc=spec((O, R), f32, replicated, "alloc"),
+        price=spec((O,), f32, replicated, "price"),
+        opt_zone=spec((O,), i32, replicated, "opt_zone"),
+        opt_valid=spec((O,), b, replicated, "opt_valid"),
+        ex_rem=spec((E, R), f32, replicated, "ex_rem"),
+        ex_zone=spec((E,), i32, replicated, "ex_zone"),
+        ex_compat=spec((G, E), b, replicated, "ex_compat"),
+        ex_valid=spec((E,), b, replicated, "ex_valid"),
+        rel_set=spec((G,), i32, replicated, "rel_set"),
+        rel_host_forbid=spec((G,), i32, replicated, "rel_host_forbid"),
+        rel_host_need=spec((G,), i32, replicated, "rel_host_need"),
+        rel_zone_forbid=spec((G,), i32, replicated, "rel_zone_forbid"),
+        rel_zone_need=spec((G,), i32, replicated, "rel_zone_need"),
+        rel_slot_bits=spec((E,), i32, replicated, "rel_slot_bits"),
+        rel_zone_bits=spec((Z,), i32, replicated, "rel_zone_bits"),
     )
-    orders = spec((K, G), i32, member)
-    alphas = spec((K,), f32, member)
-    looks = spec((K,), b, member)
-    rsvs = spec((K,), b, member)
-    swaps = spec((K, G), i32, member)
+    orders = spec((K, G), i32, member, "orders")
+    alphas = spec((K,), f32, member, "alphas")
+    looks = spec((K,), b, member, "looks")
+    rsvs = spec((K,), b, member, "rsvs")
+    swaps = spec((K, G), i32, member, "swaps")
     return inputs, orders, alphas, looks, rsvs, swaps
 
 
 _DONATING_JIT = None
 
+#: per-(donate, fleet, mesh-shape) jit wrappers for the 2D meshed tier. Each
+#: wrapper closes over a FRESH function object, so its trace cache can never
+#: serve a mesh-constrained trace to an unconstrained caller (or across mesh
+#: shapes) — the single-device byte-identity contract rests on this.
+_MESH_JITS: Dict[tuple, object] = {}
+_MESH_JITS_LOCK = threading.Lock()
 
-def _get_jit(donate: bool, fleet: bool = False):
+
+def _get_jit(donate: bool, fleet: bool = False, mesh=None):
     """The jit wrapper an AOT lowering goes through. The donating variant
     hands the problem tensors' device buffers to XLA for reuse — a cold
     one-shot dispatch then skips the output-allocation copy; callers must
@@ -743,8 +849,44 @@ def _get_jit(donate: bool, fleet: bool = False):
     they MUST stay donate-free: a fleet dispatch is fed the stager's live
     resident tensors (host-stacked or d2d-stacked masters), which a
     donating executable would consume out from under the next round's
-    stage()."""
+    stage().
+
+    On a 2D (options × fleet) mesh every variant is mesh-shape-keyed and the
+    superproblem (fleet) program vmaps with ``spmd_axis_name=FLEET_AXIS`` so
+    the member's ``_pin`` constraints compose with the sharded batch axis.
+    Lowerings of these variants must run inside ``mesh_constraints(mesh)``
+    (AOTCache.compile does)."""
     global _DONATING_JIT
+    from ..parallel.mesh import is_mesh2d
+
+    if is_mesh2d(mesh):
+        jkey = (bool(donate), bool(fleet), tuple(mesh.devices.shape))
+        with _MESH_JITS_LOCK:
+            jitw = _MESH_JITS.get(jkey)
+            if jitw is None:
+                if fleet:
+                    def _impl(inputs, orders, alphas, looks, rsvs, swaps,
+                              s_new, n_zones):
+                        member = functools.partial(
+                            _pack_solve_fused_impl, s_new=s_new,
+                            n_zones=n_zones,
+                        )
+                        return jax.vmap(member, spmd_axis_name=FLEET_AXIS)(
+                            inputs, orders, alphas, looks, rsvs, swaps
+                        )
+                else:
+                    def _impl(inputs, orders, alphas, looks, rsvs, swaps,
+                              s_new, n_zones):
+                        return _pack_solve_fused_impl(
+                            inputs, orders, alphas, looks, rsvs, swaps,
+                            s_new, n_zones,
+                        )
+                kwargs = dict(static_argnames=("s_new", "n_zones"))
+                if donate and not fleet:
+                    kwargs["donate_argnames"] = ("inputs",)
+                jitw = jax.jit(_impl, **kwargs)
+                _MESH_JITS[jkey] = jitw
+        return jitw
     if fleet:
         return pack_solve_fleet
     if not donate:
@@ -834,7 +976,15 @@ class AOTCache:
     # -- lookup -------------------------------------------------------------
     @staticmethod
     def _ckey(key: BucketKey, donate: bool, mesh) -> tuple:
-        return (key, bool(donate), 0 if mesh is None else mesh.devices.size)
+        # mesh-SHAPE keyed, not just device count: a (4, 2) and an (8, 1)
+        # mesh partition the same bucket differently, and the 2D tier's
+        # rule-table shardings are baked into the executable
+        if mesh is None:
+            return (key, bool(donate), 0)
+        return (
+            key, bool(donate),
+            (tuple(mesh.axis_names), tuple(mesh.devices.shape)),
+        )
 
     def get(self, key: BucketKey, donate: bool = False, mesh=None):
         """The compiled executable for ``key``, or None (counted as a miss)."""
@@ -889,11 +1039,15 @@ class AOTCache:
                     entry = self._entries.get(ck)
                 if entry is not None:
                     return entry.exe
-                exe = (
-                    _get_jit(donate, fleet=key.B > 1)
-                    .lower(*specs, s_new=key.S, n_zones=key.Z)
-                    .compile()
-                )
+                # 2D-mesh lowerings trace with the water-fill sharding pins
+                # active; off the mesh the scope is a no-op and the traced
+                # program is byte-identical to the pre-mesh kernel
+                with mesh_constraints(mesh):
+                    exe = (
+                        _get_jit(donate, fleet=key.B > 1, mesh=mesh)
+                        .lower(*specs, s_new=key.S, n_zones=key.Z)
+                        .compile()
+                    )
             compile_s = time.perf_counter() - t0
             with self._lock:
                 self._entries[ck] = _AOTEntry(exe, compile_s)

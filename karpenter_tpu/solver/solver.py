@@ -989,7 +989,18 @@ class _FleetBuffer:
     def materialize(self) -> np.ndarray:
         with self._lock:
             if self._host is None:
+                t0 = time.perf_counter()
                 self._host = np.asarray(self.buf)
+                if self.mesh is not None:
+                    # the cross-device gather a meshed fleet pays ONCE per
+                    # round (the first poller assembles the [B, L] result
+                    # from its shards) — karpenter_tpu_solve_phase_seconds
+                    # {phase=gather} is the meshed tier's visibility into
+                    # that collective cost
+                    metrics.SOLVE_PHASE.observe(
+                        time.perf_counter() - t0,
+                        {"phase": "gather", "mode": "sharded"},
+                    )
             return self._host
 
 
@@ -1012,6 +1023,7 @@ class _FleetDispatch:
 def stage_fleet(
     entries: Sequence[Tuple["TPUSolver", EncodedProblem]],
     max_batch: int = 16,
+    superproblem_max_cells: int = 0,
 ) -> dict:
     """Batch same-bucket kernel dispatches into single vmapped device calls.
 
@@ -1028,6 +1040,15 @@ def stage_fleet(
     and the vmapped member program is bit-identical to the B=1 program, so
     batching can never change an answer.
 
+    **Superproblem mode** (the 2D meshed tier): when a group's owner holds a
+    2D (options × fleet) mesh and ``superproblem_max_cells >= 2``, the chunk
+    width cap is raised to ``superproblem_max_cells`` — same-bucket cells of
+    a whole sharded round then enter the kernel as ONE sharded batch axis
+    (batch rows split across the mesh's ``fleet`` axis, option columns
+    across ``options``), so the round is a single multi-chip device program.
+    The vmapped member is still bit-identical per row; only the placement
+    and the device-call count change.
+
     Problems the per-cell race would not dispatch (tiny, oracle-only
     constraint shapes, race memory says the kernel loses here, open race
     breaker) are skipped, as are chunks whose fleet executable is not
@@ -1036,19 +1057,26 @@ def stage_fleet(
 
     Returns staging stats for the round's capsule/bench accounting:
     ``dispatches`` (device calls fired), ``cells_batched``, ``eligible``,
-    ``cold_buckets``, and per-dispatch ``buckets`` labels.
+    ``cold_buckets``, per-dispatch ``buckets`` labels, plus the meshed
+    tier's ``superproblems`` (2D-mesh dispatches) and ``mesh_axes``.
     """
     from ..utils import metrics
 
     stats = {
         "dispatches": 0, "cells_batched": 0, "eligible": 0,
-        "cold_buckets": 0, "buckets": [],
+        "cold_buckets": 0, "buckets": [], "superproblems": 0,
+        "mesh_axes": "",
     }
     if max_batch < 2 or len(entries) < 2:
         return stats
     # largest pow2 chunk width within the cap: chunk size == fleet width, so
     # the cap bounds the compiled batch axis, not just the real cells
     width_cap = 1 << (int(max_batch).bit_length() - 1)
+    super_cap = (
+        1 << (int(superproblem_max_cells).bit_length() - 1)
+        if superproblem_max_cells >= 2
+        else 0
+    )
     groups: "OrderedDict[BucketKey, list]" = OrderedDict()
     for solver, problem in entries:
         if problem is None or problem.G == 0:
@@ -1080,12 +1108,26 @@ def stage_fleet(
             (solver, problem)
         )
     cleared: set = set()
+    from ..parallel import FLEET_AXIS, is_mesh2d, mesh_axes_label
+
     for key, members in groups.items():
-        for base in range(0, len(members), width_cap):
-            chunk = members[base : base + width_cap]
+        # superproblem width: on a 2D mesh the batch axis is a REAL device
+        # axis (rows shard across ``fleet``), so the cap that bounds it is
+        # the operator's superproblem budget, not the host-stack fleet cap
+        group_mesh = members[0][0]._ensure_mesh()
+        group_2d = group_mesh is not None and is_mesh2d(group_mesh)
+        cap = max(width_cap, super_cap) if group_2d and super_cap else width_cap
+        for base in range(0, len(members), cap):
+            chunk = members[base : base + cap]
             if len(chunk) < 2:
                 continue  # a lone cell dispatches per-cell as before
             B = bucket_fleet(len(chunk))
+            if group_2d:
+                # pad the batch axis up to the mesh's fleet-axis multiple so
+                # the superproblem rows actually shard (padding slots are
+                # provably inert, so over-padding can never change answers)
+                sizes = dict(zip(group_mesh.axis_names, group_mesh.devices.shape))
+                B = max(B, sizes.get(FLEET_AXIS, 1))
             fleet_key = key._replace(B=B)
             owner = chunk[0][0]
             mesh = owner._ensure_mesh()
@@ -1127,6 +1169,11 @@ def stage_fleet(
                 stats["cells_batched"] += len(chunk)
                 stats["buckets"].append(fleet_key.label())
                 metrics.FLEET_DISPATCH.inc({"bucket": fleet_key.label()})
+                if group_2d:
+                    axes = mesh_axes_label(mesh)
+                    stats["superproblems"] += 1
+                    stats["mesh_axes"] = axes
+                    metrics.MESH_DISPATCH.inc({"axes": axes})
     return stats
 
 
@@ -1169,13 +1216,51 @@ def _stage_fleet_chunk(chunk, key, fleet_key, B, mesh, exe, cleared) -> bool:
     orders_b, alphas_b, looks_b, rsvs_b, swaps_b = (
         stack(1), stack(2), stack(3), stack(4), stack(5),
     )
-    if mesh is not None:
+    from ..parallel import is_mesh2d
+
+    if mesh is not None and not is_mesh2d(mesh):
         from ..parallel import shard_fleet
 
         (inputs_d, orders_d, alphas_d, looks_d, rsvs_d, swaps_d) = shard_fleet(
             mesh, B, jax.tree.map(jnp.asarray, inputs_b),
             jnp.asarray(orders_b), jnp.asarray(alphas_b),
             jnp.asarray(looks_b), jnp.asarray(rsvs_b), jnp.asarray(swaps_b),
+        )
+    elif mesh is not None:
+        # superproblem staging (2D meshed tier): the stacked [B, ...]
+        # tensors route through the owner's stager under a mesh-labeled tag
+        # — full uploads device_put per the rule table WITH the batch axis
+        # on ``fleet`` (batch=True), so the whole superproblem lands
+        # partitioned across the mesh; a repeat sharded round whose chunk
+        # lines up the same cells re-uploads only churned rows, and those
+        # scatter-patches inherit the resident master's sharded placement
+        from ..parallel import mesh_axes_label, mesh_sharding
+
+        t_stage = time.perf_counter()
+        owner = chunk[0][0]
+
+        def put(name, arr, _mesh=mesh):
+            return jax.device_put(
+                arr, mesh_sharding(_mesh, name, np.shape(arr), batch=True)
+            )
+
+        leaves = {f: getattr(inputs_b, f) for f in PackInputs._fields}
+        leaves.update(
+            orders=orders_b, alphas=alphas_b, looks=looks_b,
+            rsvs=rsvs_b, swaps=swaps_b,
+        )
+        staged = owner._stager.stage(
+            ("super", mesh_axes_label(mesh)) + tuple(fleet_key), leaves,
+            put=put,
+        )
+        inputs_d = PackInputs(*[staged[f] for f in PackInputs._fields])
+        orders_d, alphas_d, looks_d, rsvs_d, swaps_d = (
+            staged["orders"], staged["alphas"], staged["looks"],
+            staged["rsvs"], staged["swaps"],
+        )
+        metrics.SOLVE_PHASE.observe(
+            time.perf_counter() - t_stage,
+            {"phase": "stage", "mode": "sharded"},
         )
     else:
         t_stage = time.perf_counter()
@@ -1304,6 +1389,8 @@ class TPUSolver(Solver):
         device_staging: bool = True,
         staging_capacity_mb: int = 256,
         dispatch_timeout_s: float = 2.0,
+        mesh_shape=None,
+        superproblem_max_cells: int = 64,
     ):
         self.portfolio = portfolio
         self.seed = seed
@@ -1337,6 +1424,16 @@ class TPUSolver(Solver):
         # the solver build one over all local devices on first kernel solve.
         self.mesh = mesh
         self.auto_mesh = auto_mesh
+        # 2D meshed solver tier: an (options, fleet) mesh shape builds a 2D
+        # mesh on first kernel use — option columns of the problem tensors
+        # shard across ``options`` and the superproblem batch axis across
+        # ``fleet`` (parallel.mesh rule table). None keeps today's behavior
+        # (1D portfolio mesh when multiple devices, else single device).
+        self.mesh_shape = mesh_shape
+        # superproblem mode: same-bucket cells of a sharded round enter the
+        # meshed kernel as ONE sharded batch — this caps how many cells one
+        # device program carries. Only consulted on a 2D mesh.
+        self.superproblem_max_cells = superproblem_max_cells
         # AOT executable cache policy: pre-compile likely buckets in the
         # background (shape hints from the encode session + pattern shape
         # ring), and optionally donate problem-tensor device buffers on
@@ -1383,7 +1480,16 @@ class TPUSolver(Solver):
             import jax
 
             self.auto_mesh = False  # probe once
-            if len(jax.devices()) > 1:
+            if self.mesh_shape is not None:
+                # 2D meshed tier, only when the shape is genuinely
+                # multi-chip AND the devices exist — a 1-device host stays
+                # meshless so single-device behavior is byte-identical
+                from ..parallel import make_mesh2d
+
+                o, f = self.mesh_shape
+                if o * f > 1 and o * f <= len(jax.devices()):
+                    self.mesh = make_mesh2d((o, f))
+            elif len(jax.devices()) > 1:
                 from ..parallel import make_mesh
 
                 self.mesh = make_mesh()
@@ -1654,10 +1760,13 @@ class TPUSolver(Solver):
         solve — which consumes its fleet slice in place of a per-problem
         dispatch. Answers are identical to the serial ``solve_pods`` loop
         (the vmapped member program is bit-identical to the B=1 program);
-        only the device-call count and the wall clock change."""
+        only the device-call count and the wall clock change. On a 2D mesh
+        the solver's superproblem cap widens the batch so the whole fleet
+        can dispatch as one sharded device program."""
         staged = [self.encode_for_staging(**req) for req in requests]
         stage_fleet(
-            [(self, p) for p in staged], max_batch=max_batch
+            [(self, p) for p in staged], max_batch=max_batch,
+            superproblem_max_cells=self.superproblem_max_cells,
         )
         return [
             self.solve_pods(**req, pre_encoded=p)
@@ -1749,17 +1858,43 @@ class TPUSolver(Solver):
         ever calls."""
         from ..parallel import round_up_portfolio
 
-        return bucket_key(
+        return self._mesh_stamp(bucket_key(
             problem.G, problem.O, problem.E,
             self._cached_s_new(problem) if s_new is None else s_new,
             len(problem.zones), len(problem.resource_axes),
             round_up_portfolio(self.portfolio, self._ensure_mesh()),
+        ))
+
+    def _mesh_stamp(self, key: BucketKey) -> BucketKey:
+        """On the 2D meshed tier, grow the bucket key's mesh dims (MO, MF)
+        and shard-align the option padding: a sharded executable lives in
+        its own key space, and O must divide the options axis or the rule
+        table degrades the option tensors to replication."""
+        mesh = self._ensure_mesh()
+        from ..parallel import (
+            FLEET_AXIS, OPTIONS_AXIS, is_mesh2d, shard_aligned_options,
+        )
+
+        if not is_mesh2d(mesh):
+            return key
+        sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+        return key._replace(
+            O=shard_aligned_options(key.O, mesh),
+            MO=sizes.get(OPTIONS_AXIS, 1),
+            MF=sizes.get(FLEET_AXIS, 1),
         )
 
     def _donate(self) -> bool:
-        """Donation is a single-device optimization: mesh runs replicate
-        problem tensors under explicit shardings and skip it."""
-        return self.aot_donate and self.mesh is None
+        """Donation is off on the legacy 1D mesh: its inputs replicate under
+        explicit shardings outside the stager, so there is no master to
+        clone. The 2D meshed tier stages per-shard THROUGH the DeviceStager,
+        and ``_stage_inputs`` clones the sharded resident master for a
+        donating dispatch — donation rides the mesh where staging permits."""
+        if self.mesh is None:
+            return self.aot_donate
+        from ..parallel import is_mesh2d
+
+        return self.aot_donate and is_mesh2d(self.mesh) and self._stager.enabled
 
     def _race_dispatch_affordable(self, problem: EncodedProblem) -> bool:
         """Race admission: can this BUCKET's dispatch answer inside the
@@ -1839,7 +1974,11 @@ class TPUSolver(Solver):
                 )
             for (g, o, e, z, r), s, b in hints:
                 if s:
-                    hk = bucket_key(g, o, e, s, z, r, k)
+                    # mesh-stamp the hint exactly like _bucket_key stamps
+                    # live keys (option padding to the shard multiple, MO/MF
+                    # dims): an unstamped warm would build executables the
+                    # meshed dispatches never look up
+                    hk = self._mesh_stamp(bucket_key(g, o, e, s, z, r, k))
                     keys.append(hk)
                     if b and b > 1:
                         # a hint that last solved as a fleet row pre-builds
@@ -1863,16 +2002,21 @@ class TPUSolver(Solver):
         copies are enqueued. By the time the round reaches fleet staging or
         the per-cell race, the tensors are resident (or in flight) and the
         dispatch pays only the leftover wait. A no-op for problems the race
-        would never dispatch (tiny, oracle-only, quality mode) and on mesh
-        runs (explicit shardings own their placement)."""
+        would never dispatch (tiny, oracle-only, quality mode) and on legacy
+        1D-mesh runs (explicit portfolio shardings own their placement); the
+        2D meshed tier DOES prestage — its tensors route through the stager
+        per-shard, so the overlap win carries over unchanged."""
         try:
+            from ..parallel import is_mesh2d
+
+            mesh = self._ensure_mesh()
             if (
                 problem.G == 0
                 or (problem.O == 0 and problem.E == 0)
                 or _tensor_path_unsupported(problem) is not None
                 or self.latency_budget_s > 1.0
                 or int(problem.count.sum()) < self.race_min_pods
-                or self._ensure_mesh() is not None
+                or (mesh is not None and not is_mesh2d(mesh))
             ):
                 return
             # skip what the race will skip: an unaffordable bucket, a
@@ -2334,7 +2478,9 @@ class TPUSolver(Solver):
                 problem, inputs, orders, alphas, looks, s_new, n_zones, [None],
             )
         mesh = self._ensure_mesh()
-        if mesh is not None:
+        from ..parallel import is_mesh2d
+
+        if mesh is not None and not is_mesh2d(mesh):
             from ..parallel import shard_portfolio
 
             inputs_d, orders_d, alphas_d, looks_d, rsvs_d, swaps_d = shard_portfolio(
@@ -2365,7 +2511,22 @@ class TPUSolver(Solver):
             Zp = inputs.rel_zone_bits.shape[0]
             tag = ("cell", Gp, Op, Ep, Zp, inputs.demand.shape[1],
                    orders.shape[0])
-            staged = self._stager.stage(tag, leaves)
+            put = None
+            if mesh is not None:
+                # 2D meshed tier: per-shard staging — full uploads
+                # device_put under the rule-table shardings, so the stager's
+                # resident masters live partitioned across the mesh and a
+                # hit/restage round moves no (or only churned-row) bytes
+                from ..parallel import mesh_axes_label, mesh_sharding
+
+                tag = ("cell2d", mesh_axes_label(mesh)) + tag[1:]
+
+                def put(name, arr, _mesh=mesh):
+                    return jax.device_put(
+                        arr, mesh_sharding(_mesh, name, np.shape(arr))
+                    )
+
+            staged = self._stager.stage(tag, leaves, put=put)
             inputs_d = PackInputs(*[staged[f] for f in PackInputs._fields])
             orders_d, alphas_d, looks_d, rsvs_d, swaps_d = (
                 staged["orders"], staged["alphas"], staged["looks"],
@@ -2384,6 +2545,14 @@ class TPUSolver(Solver):
             self._device_cache.clear()  # hold at most one problem resident
             self._device_cache[key] = entry
         return entry[1:]
+
+    def _options_pad(self, o: int) -> int:
+        """Natural option-axis padding: the pow2 bucket, shard-aligned to
+        the 2D mesh's options axis when one is active (``_mesh_stamp`` grows
+        the bucket KEY the same way, so key and padded tensors agree)."""
+        from ..parallel import shard_aligned_options
+
+        return shard_aligned_options(bucket_options(o), self._ensure_mesh())
 
     # -- encoding to device-ready padded arrays -----------------------------
     def _prepare(self, problem: EncodedProblem, bucket: Optional[BucketKey] = None):
@@ -2404,7 +2573,7 @@ class TPUSolver(Solver):
 
         memo_key = (
             bucket.G if bucket else bucket_groups(problem.G),
-            bucket.O if bucket else bucket_options(problem.O),
+            bucket.O if bucket else self._options_pad(problem.O),
             bucket.E if bucket else bucket_existing(problem.E),
             bucket.S if bucket else self._estimate_slots(problem),
             bucket.Z if bucket else bucket_zones(max(len(problem.zones), 1)),
@@ -2417,7 +2586,7 @@ class TPUSolver(Solver):
         t_presolve = time.perf_counter()
         G, O, E, R = problem.G, problem.O, problem.E, len(problem.resource_axes)
         Gp = bucket.G if bucket else bucket_groups(G)
-        Op = bucket.O if bucket else bucket_options(O)
+        Op = bucket.O if bucket else self._options_pad(O)
         # Ep padded to a power of two like the other axes: consolidation
         # sweep simulations vary E by one node per prefix, and an exact Ep
         # would give every prefix its own XLA shape (compile per simulation);

@@ -28,9 +28,12 @@ a resident MASTER copy and hands the dispatch device-side clones
 (``Array.copy()`` — a device-to-device copy, no host round trip), so
 donation recycles the stager's buffers instead of defeating residency.
 
-Mesh runs are bypassed: their inputs go through explicit shardings
-(``parallel.shard_portfolio``/``shard_fleet``) and replication, a different
-residency story.
+Legacy 1D-mesh runs are bypassed: their inputs go through explicit
+shardings (``parallel.shard_portfolio``/``shard_fleet``) and replication, a
+different residency story. The 2D meshed tier stages THROUGH the stager
+per-shard: the caller passes a ``put`` placement hook (device_put under the
+rule-table NamedSharding), so the resident masters live sharded across the
+mesh and hits/restages never leave it.
 
 Events are counted in ``karpenter_tpu_device_staging_total{event}`` and the
 per-round numbers (``last_round``) feed the bench staging arm.
@@ -81,10 +84,17 @@ class DeviceStager:
         self.last_round: Dict[str, object] = {}
 
     # -- core ---------------------------------------------------------------
-    def stage(self, tag: tuple, leaves: Dict[str, np.ndarray]) -> Dict[str, object]:
+    def stage(
+        self, tag: tuple, leaves: Dict[str, np.ndarray], put=None
+    ) -> Dict[str, object]:
         """Return device arrays for ``leaves``, reusing/patching the resident
         entry for ``tag`` where bytes allow. ``tag`` must pin every static of
-        the padded shape (bucket dims, portfolio K, fleet width)."""
+        the padded shape (bucket dims, portfolio K, fleet width — and, for
+        meshed tags, the mesh axes: a resident single-device master must
+        never serve a sharded dispatch). ``put(name, array)`` overrides the
+        device placement of full uploads (the meshed tier's per-shard
+        ``device_put``); hits and scatter restages inherit the resident
+        master's placement, so a sharded master stays sharded."""
         import jax.numpy as jnp
 
         from ..utils import faults as _faults
@@ -112,6 +122,8 @@ class DeviceStager:
                 corrupted *= 4.0
                 leaves[victim] = corrupted
         if not self.enabled:
+            if put is not None:
+                return {k: put(k, np.asarray(v)) for k, v in leaves.items()}
             return {k: jnp.asarray(v) for k, v in leaves.items()}
         round_info: Dict[str, object] = {
             "hit": 0, "restage": 0, "full": 0, "rows": {},
@@ -159,7 +171,7 @@ class DeviceStager:
                         bytes_moved += (new.nbytes // max(new.shape[0], 1)) * rows
                         continue
                 # full upload of this leaf
-                dev = jnp.asarray(new)
+                dev = put(name, new) if put is not None else jnp.asarray(new)
                 out[name] = dev
                 entry.dev[name] = dev
                 entry.host[name] = new.copy()

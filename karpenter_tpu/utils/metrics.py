@@ -343,11 +343,13 @@ SOLVE_DURATION = Histogram(
 SOLVE_PHASE = Histogram(
     "karpenter_tpu_solve_phase_seconds",
     help="Solver phase latency (encode/presolve/stage/solve/decode/"
-         "validate), labeled by phase and by the round's encode mode "
-         "(delta/full) — the continuous view of the incremental-encode "
-         "win; {phase=stage} separates host-to-device staging from encode "
-         "and solve, and {phase=validate} is the placement-validation "
-         "firewall's per-evaluation cost (budgeted < 5% of round p50).",
+         "validate/gather), labeled by phase and by the round's encode "
+         "mode (delta/full) — the continuous view of the incremental-"
+         "encode win; {phase=stage} separates host-to-device staging from "
+         "encode and solve, {phase=validate} is the placement-validation "
+         "firewall's per-evaluation cost (budgeted < 5% of round p50), "
+         "and {phase=gather} is the meshed tier's once-per-fleet cross-"
+         "device result assembly.",
     registry=REGISTRY,
 )
 RECONCILE_DURATION = Histogram(
@@ -539,6 +541,15 @@ FLEET_DISPATCH = Counter(
     help="Batched kernel device calls fired by fleet dispatch, labeled by "
          "the fleet executable bucket (the B-suffixed shape label); each "
          "call solved up to B same-bucket cell problems at once.",
+    registry=REGISTRY,
+)
+MESH_DISPATCH = Counter(
+    "karpenter_tpu_mesh_dispatch_total",
+    help="Superproblem dispatches onto the 2D (options x fleet) device "
+         "mesh, labeled by the mesh axes (e.g. 4x2) — each one solved a "
+         "whole same-bucket batch of cells as ONE multi-chip device "
+         "program; zero while mesh_enabled is off or on single-device "
+         "hosts.",
     registry=REGISTRY,
 )
 FLEET_ROUND_DISPATCHES = Gauge(

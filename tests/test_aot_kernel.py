@@ -197,7 +197,7 @@ def _key(**kw):
 @pytest.fixture()
 def stub_cache(monkeypatch):
     stub = _StubJit()
-    monkeypatch.setattr(J, "_get_jit", lambda donate, fleet=False: stub)
+    monkeypatch.setattr(J, "_get_jit", lambda donate, fleet=False, mesh=None: stub)
     cache = J.AOTCache(capacity=2)
     cache.configure(persist=False)
     return cache

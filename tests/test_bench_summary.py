@@ -20,9 +20,11 @@ sys.path.insert(0, os.path.join(ROOT, "hack"))
 import bench_artifact  # noqa: E402  (hack/bench_artifact.py)
 
 
-def test_dry_run_last_stdout_line_is_json_summary():
+def test_dry_run_last_stdout_line_is_json_summary(tmp_path):
+    summary_file = tmp_path / "summary.json"
     proc = subprocess.run(
-        [sys.executable, os.path.join(ROOT, "bench.py"), "--dry-run"],
+        [sys.executable, os.path.join(ROOT, "bench.py"), "--dry-run",
+         "--summary-out", str(summary_file)],
         capture_output=True, text=True, timeout=300, cwd=ROOT,
         env={**os.environ, "JAX_PLATFORMS": "cpu"},
     )
@@ -81,6 +83,13 @@ def test_dry_run_last_stdout_line_is_json_summary():
     assert summary["pod_ready_dominant_stage"]  # a tracked round names one
     # the tentpole invariant over a real round: stages sum to e2e
     assert abs(summary["lifecycle_stage_sum_over_e2e"] - 1.0) < 0.05
+    # the ISSUE-18 meshed-tier fields ride the summary (null in dry-run:
+    # the mesh arm runs only in the full bench / regression gate)
+    for key in ("mesh_skipped", "mesh_axes", "mesh_super_speedup",
+                "mesh_super_equal", "mesh_violations",
+                "mesh_super_dispatches"):
+        assert key in summary
+        assert summary[key] is None
     # every stdout line is valid JSON on its own (no partial fragments)
     for ln in lines:
         json.loads(ln)
@@ -90,6 +99,16 @@ def test_dry_run_last_stdout_line_is_json_summary():
     )
     assert artifact["parsed"] == summary
     assert json.loads(json.dumps(artifact))["parsed"] == summary
+    # the ISSUE-18 file channel: --summary-out wrote the SAME summary the
+    # final stdout line carries, and the artifact writer PREFERS the file
+    # over stdout scraping (the "parsed": null fix, end to end)
+    assert bench_artifact.read_summary_file(str(summary_file)) == summary
+    preferred = bench_artifact.build_artifact(
+        9, "bench --dry-run", proc.returncode, proc.stdout + proc.stderr,
+        summary_file=str(summary_file),
+    )
+    assert preferred["parsed"] == summary
+    assert preferred["parsed_source"] == "file"
 
 
 class TestArtifactWriter:
@@ -215,6 +234,84 @@ class TestArtifactWriter:
         assert rt["fed_replay_all_matched"] is True
         assert rt["fed_cost_vs_oracle_frac"] == 1.0123
         assert rt["fed_unschedulable_p100"] == 0
+
+    def test_mesh_summary_fields_round_trip(self):
+        # ISSUE-18 satellite: the meshed-tier verdicts (axes label, meshed
+        # round speedup, bit-identical kernel rows, zero violations) survive
+        # the artifact writer byte-for-byte
+        summary = json.dumps({
+            "metric": "m", "summary": True,
+            "mesh_skipped": False,
+            "mesh_axes": "4x2",
+            "mesh_super_speedup": 1.37,
+            "mesh_super_equal": True,
+            "mesh_violations": 0,
+            "mesh_super_dispatches": 1,
+        })
+        artifact = bench_artifact.build_artifact(18, "cmd", 0, summary + "\n")
+        assert artifact["parsed"] == json.loads(summary)
+        rt = json.loads(json.dumps(artifact, allow_nan=False))["parsed"]
+        assert rt["mesh_super_equal"] is True
+        assert rt["mesh_axes"] == "4x2"
+        assert rt["mesh_violations"] == 0
+
+    def test_summary_file_preferred_over_stdout(self, tmp_path):
+        # ISSUE-18 satellite: when the file channel exists, it WINS — stdout
+        # may carry a stale or noise-corrupted summary and never regresses
+        # the parse back to scraping
+        f = tmp_path / "s.json"
+        f.write_text(json.dumps({"value": 7.0, "summary": True, "src": "file"}))
+        stdout_summary = json.dumps({"value": 1.0, "summary": True})
+        artifact = bench_artifact.build_artifact(
+            18, "cmd", 0, stdout_summary + "\n", summary_file=str(f)
+        )
+        assert artifact["parsed"]["src"] == "file"
+        assert artifact["parsed_source"] == "file"
+
+    def test_torn_or_missing_summary_file_falls_back_to_stdout(self, tmp_path):
+        stdout_summary = json.dumps({"value": 2.0, "summary": True})
+        torn = tmp_path / "torn.json"
+        torn.write_text('{"value": 2.0, "summ')  # crashed mid-write
+        for path in (str(torn), str(tmp_path / "never-written.json")):
+            artifact = bench_artifact.build_artifact(
+                18, "cmd", 0, stdout_summary + "\n", summary_file=path
+            )
+            assert artifact["parsed"] == json.loads(stdout_summary)
+            assert artifact["parsed_source"] == "stdout"
+        # and a dead bench with neither channel degrades to null, not garbage
+        artifact = bench_artifact.build_artifact(
+            18, "cmd", 1, "XlaRuntimeError: device exploded\n",
+            summary_file=str(torn),
+        )
+        assert artifact["parsed"] is None
+        assert artifact["parsed_source"] is None
+
+    def test_auto_injection_uses_file_channel(self, tmp_path):
+        # `python bench.py` commands gain --summary-out automatically; the
+        # fake bench writes ONLY the file (its stdout is pure noise), so a
+        # successful parse proves the injected channel carried the summary
+        (tmp_path / "bench.py").write_text(
+            "import argparse, json\n"
+            "ap = argparse.ArgumentParser()\n"
+            "ap.add_argument('--summary-out')\n"
+            "args = ap.parse_args()\n"
+            "with open(args.summary_out, 'w') as f:\n"
+            "    json.dump({'value': 5.0, 'summary': True}, f)\n"
+            "print('E0000 teardown noise, no summary on stdout')\n"
+        )
+        out = tmp_path / "BENCH_rt.json"
+        proc = subprocess.run(
+            [sys.executable, os.path.join(ROOT, "hack", "bench_artifact.py"),
+             "--out", str(out), "--n", "18", "--cmd", "python bench.py"],
+            capture_output=True, text=True, timeout=60, cwd=tmp_path,
+        )
+        assert proc.returncode == 0, proc.stderr
+        artifact = json.loads(out.read_text())
+        assert artifact["parsed"] == {"value": 5.0, "summary": True}
+        assert artifact["parsed_source"] == "file"
+        assert "parsed=file" in proc.stderr
+        # the recorded cmd is the ORIGINAL (reproducible), not the injected
+        assert artifact["cmd"] == "python bench.py"
 
     def test_end_to_end_subprocess_write(self, tmp_path):
         fake = tmp_path / "fakebench.py"

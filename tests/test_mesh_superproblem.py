@@ -1,0 +1,194 @@
+"""Meshed solver tier (ISSUE 18): the sharding-rule table's exhaustiveness
+contract, single-device inertness (the tier must be provably absent below 2
+devices — byte-identical jaxprs, unchanged bucket labels), 2D mesh-shape
+resolution, and meshed==unmeshed kernel equality on the conftest's forced
+8-device host mesh. The full dryrun (2D solve + superproblem staging at 2/4
+devices) runs as slow-marked subprocesses — tier-1 keeps the host-level
+contracts and one direct kernel-equality dispatch only."""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if ROOT not in sys.path:
+    sys.path.insert(0, ROOT)
+
+from karpenter_tpu.api import ObjectMeta, Pod, Provisioner, Resources  # noqa: E402
+from karpenter_tpu.cloudprovider import generate_catalog  # noqa: E402
+from karpenter_tpu.parallel import (  # noqa: E402
+    FLEET_AXIS,
+    OPTIONS_AXIS,
+    is_mesh2d,
+    make_mesh,
+    make_mesh2d,
+    match_partition_rules,
+    mesh_axes_label,
+    mesh_sharding,
+    parse_mesh_shape,
+    round_up_portfolio,
+    shard_aligned_options,
+)
+from karpenter_tpu.solver import encode  # noqa: E402
+from karpenter_tpu.solver.jax_solver import (  # noqa: E402
+    _PIN_MESH,
+    _get_jit,
+    _pin,
+    PackInputs,
+    pack_solve_fused,
+)
+from karpenter_tpu.solver.solver import TPUSolver  # noqa: E402
+
+MEMBER_ARRAYS = ("orders", "alphas", "looks", "rsvs", "swaps")
+
+
+class TestPartitionRules:
+    """The match_partition_rules table must stay exhaustive over every
+    tensor leaf the meshed tier stages, and an unknown leaf must hard-error
+    — a silently-replicated new tensor is how sharding regressions are
+    born."""
+
+    def test_exhaustive_over_every_kernel_leaf(self):
+        # property: every PackInputs field + member array resolves, both as
+        # a single problem and with the superproblem batch axis prefixed
+        for leaf in PackInputs._fields + MEMBER_ARRAYS:
+            spec = match_partition_rules(leaf, (4, 8))
+            assert isinstance(spec, P)
+            spec_b = match_partition_rules(leaf, (2, 4, 8), batch=True)
+            assert tuple(spec_b)[0] == FLEET_AXIS, (leaf, spec_b)
+
+    def test_unmatched_leaf_is_a_hard_error(self):
+        with pytest.raises(ValueError, match="Partition rule not found"):
+            match_partition_rules("brand_new_leaf", (4, 8))
+
+    def test_scalars_and_one_element_leaves_never_partition(self):
+        # the scalar short-circuit fires before name matching: even an
+        # unknown name is fine at trivial shapes (nothing to shard)
+        assert match_partition_rules("brand_new_leaf", ()) == P()
+        assert match_partition_rules("brand_new_leaf", (1,)) == P()
+        # a batched leaf whose member rank is scalar still rides fleet
+        assert match_partition_rules("count", (2,), batch=True) == P(FLEET_AXIS)
+
+    def test_option_axis_tensors_land_on_options(self):
+        assert match_partition_rules("alloc", (64, 4)) == P(OPTIONS_AXIS)
+        assert match_partition_rules("price", (64,)) == P(OPTIONS_AXIS)
+        # compat is [G, O]: the option dim is dim 1
+        assert match_partition_rules("compat", (8, 64)) == P(None, OPTIONS_AXIS)
+        # group-axis tensors and member arrays replicate
+        assert match_partition_rules("demand", (8, 4)) == P()
+        assert match_partition_rules("orders", (8, 16)) == P()
+        # batch prefixes fleet on top of the member spec
+        assert match_partition_rules("alloc", (2, 64, 4), batch=True) == P(
+            FLEET_AXIS, OPTIONS_AXIS
+        )
+
+    def test_indivisible_dim_degrades_to_replication(self):
+        # a leaf whose O dim does not divide the options axis must replicate
+        # (a wrong PartitionSpec would force resharding collectives), never
+        # error — staging correctness cannot depend on lattice alignment
+        mesh = make_mesh2d((2, 1))
+        assert mesh_sharding(mesh, "alloc", (3, 4)).spec == P(None)
+        assert mesh_sharding(mesh, "alloc", (4, 4)).spec == P(OPTIONS_AXIS)
+
+
+class TestSingleDeviceInertness:
+    """Below 2 devices (and for any solver without a 2D mesh) the meshed
+    tier must be provably absent: same jit function object, identity pins,
+    unchanged bucket labels — byte-identical round digests vs pre-mesh
+    builds."""
+
+    def test_pin_is_identity_without_active_mesh(self):
+        assert _PIN_MESH[0] is None
+        x = np.arange(8.0)
+        assert _pin(x, None, OPTIONS_AXIS) is x
+
+    def test_unmeshed_jit_is_the_module_level_function(self):
+        # not just equal — the SAME object, so unconstrained callers can
+        # never pick up a mesh-constrained trace from the jit cache
+        assert _get_jit(False, False, None) is pack_solve_fused
+
+    def test_bucket_key_label_unchanged_at_default_mesh_dims(self):
+        solver = TPUSolver(portfolio=8, auto_mesh=False)
+        problem = _tiny_problem()
+        key = solver._bucket_key(problem)
+        assert key.MO == 1 and key.MF == 1
+        meshed = key._replace(MO=4, MF=2)
+        assert meshed.label().endswith("m4x2")
+        assert meshed.label().replace("m4x2", "") == key.label()
+
+    def test_parse_mesh_shape_below_two_devices_is_none(self):
+        assert parse_mesh_shape("auto", 1) is None
+        assert parse_mesh_shape("4x2", 1) is None
+        assert parse_mesh_shape("1x1", 8) is None
+
+    def test_parse_mesh_shape_auto_splits(self):
+        assert parse_mesh_shape("auto", 2) == (2, 1)
+        assert parse_mesh_shape("auto", 4) == (2, 2)
+        assert parse_mesh_shape("auto", 8) == (4, 2)
+        assert parse_mesh_shape("4x2", 8) == (4, 2)
+
+    def test_2d_mesh_never_rounds_the_portfolio(self):
+        # the 2D tier's parallel axis is the option axis, not K
+        mesh = make_mesh2d((2, 2))
+        assert is_mesh2d(mesh) and not is_mesh2d(make_mesh(2))
+        assert mesh_axes_label(mesh) == "2x2"
+        assert round_up_portfolio(5, mesh) == 5
+        assert shard_aligned_options(8, mesh) == 8
+        assert shard_aligned_options(2, make_mesh2d((4, 2))) == 4
+        assert shard_aligned_options(8, None) == 8
+
+
+def _tiny_problem(n_pods: int = 24, seed_prefix: str = "p"):
+    pods = [
+        Pod(
+            meta=ObjectMeta(name=f"{seed_prefix}-{i}", labels={"app": f"a{i % 3}"}),
+            requests=Resources(
+                cpu=[0.2, 0.4, 0.6][i % 3], memory=f"{[0.25, 0.5, 1][i % 3]}Gi"
+            ),
+        )
+        for i in range(n_pods)
+    ]
+    prov = Provisioner(meta=ObjectMeta(name="default"))
+    return encode(pods, [(prov, generate_catalog(n_types=8))])
+
+
+def test_superproblem_kernel_rows_bit_identical_to_single_device():
+    """The ISSUE 18 equivalence contract, directly at the kernel layer: two
+    same-bucket problems stacked as ONE sharded superproblem on a real 2D
+    (options x fleet) mesh must produce rows byte-identical to the plain
+    single-device dispatches — hence digest-equal placements."""
+    import bench
+
+    mesh_solver = TPUSolver(portfolio=8, mesh_shape=(2, 1), superproblem_max_cells=2)
+    plain = TPUSolver(portfolio=8, auto_mesh=False)
+    probs = [_tiny_problem(seed_prefix=f"c{i}") for i in range(2)]
+    eq = bench._super_kernel_equal(mesh_solver, plain, probs, 2)
+    assert eq is True
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("n", [2, 4])
+def test_dryrun_multichip_meshed_tier(n):
+    """The full driver dryrun at forced 2/4 host devices: 2D solve cost ==
+    single-device cost, superproblem staging engages, sharded rows
+    bit-identical, zero violations."""
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": f"--xla_force_host_platform_device_count={n}",
+    }
+    proc = subprocess.run(
+        [
+            sys.executable, "-c",
+            f"from __graft_entry__ import dryrun_multichip; dryrun_multichip({n})",
+        ],
+        capture_output=True, text=True, timeout=900, cwd=ROOT, env=env,
+    )
+    assert proc.returncode == 0, (proc.stdout[-2000:], proc.stderr[-2000:])
+    assert "dryrun_multichip OK (meshed 2D): mesh" in proc.stdout
