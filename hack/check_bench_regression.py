@@ -16,6 +16,13 @@ floor:
   >= 2 spot pools) must end every settle window with ZERO pending pods,
   every victim replaced within the 2-reconcile budget, and mean fleet cost
   <= COST_BAND x the on-demand-only lower bound.
+* ``cost_accounting`` (ISSUE 19): the cost ledger's metered total must
+  equal the independent offline integration of the node timeline exactly
+  (piecewise-constant rates make the trapezoid rule exact), every
+  attribution partition must conserve, the ledger-derived
+  spend-vs-on-demand fraction must agree with the timeline's and stay
+  <= 1.0x on a spot-placing run, and the watch-path overhead (deterministic
+  per-event arm) must stay < 5% of the reconcile timeline.
 * ``cell_decompose`` (ISSUE 8): every cell's delta encode must stay
   digest-identical to a from-scratch full encode of that cell's canonical
   inputs, the union of per-cell solves must price identically to the flat
@@ -192,6 +199,10 @@ def run_checks(full: bool = False) -> list:
     cells_fleet = bench.bench_cell_decompose(
         n_pods=20_000, n_cells=8, rounds=8, n_types=30, flat_compare=False
     )
+    # cost-ledger accounting arm (ISSUE 19): scenario defaults either way —
+    # the verdicts are equalities (metered == integrated, partitions
+    # conserve), not wall-clock, so one scale is enough
+    costacc = bench.bench_cost_accounting()
     staging = bench.bench_device_staging()
     devfault = bench.bench_device_faults(
         n_pods=20_000 if full else 2_000, n_types=30
@@ -224,7 +235,8 @@ def run_checks(full: bool = False) -> list:
     )
     print(json.dumps({
         "delta_reconcile": delta, "consolidation_sweep": sweep,
-        "spot_churn": churn, "cell_decompose": cells,
+        "spot_churn": churn, "cost_accounting": costacc,
+        "cell_decompose": cells,
         "cell_fleet": cells_fleet, "gang_topology": gangtopo,
         "device_staging": staging, "device_faults": devfault,
         "lifecycle_overhead": lifecycle,
@@ -284,6 +296,50 @@ def run_checks(full: bool = False) -> list:
         failures.append(
             f"spot_churn mean cost {frac}x the on-demand-only lower bound "
             f"(band {COST_BAND}x)"
+        )
+    # -- cost_accounting gate (ISSUE 19) ------------------------------------
+    if (
+        costacc.get("reclaims", 0) < 3
+        or costacc.get("spot_savings_dollars", 0.0) <= 0.0
+        or costacc.get("watch_events", 0) < 100
+    ):
+        failures.append(
+            "cost_accounting exercised too little churn "
+            f"(reclaims={costacc.get('reclaims')}, "
+            f"spot_savings={costacc.get('spot_savings_dollars')}, "
+            f"events={costacc.get('watch_events')}) — the scenario itself "
+            "regressed, the gate is vacuous"
+        )
+    if not costacc.get("conservation_ok", False):
+        failures.append(
+            "cost_accounting: ledger partitions do not conserve "
+            f"(max_abs_error={costacc.get('conservation_max_abs_error')})"
+        )
+    if not costacc.get("integration_equal", False):
+        failures.append(
+            "cost_accounting: metered total diverged from the independent "
+            f"offline integration ({costacc.get('ledger_dollars')} vs "
+            f"{costacc.get('offline_dollars')}, "
+            f"err={costacc.get('integration_abs_err')})"
+        )
+    if not costacc.get("frac_consistent", False):
+        failures.append(
+            "cost_accounting: ledger-derived spend-vs-on-demand fraction "
+            f"({costacc.get('ledger_vs_ondemand_frac')}) disagrees with the "
+            f"offline timeline's ({costacc.get('offline_vs_ondemand_frac')})"
+        )
+    led_frac = costacc.get("ledger_vs_ondemand_frac")
+    if led_frac is None or led_frac > 1.0 + 1e-6:
+        failures.append(
+            f"cost_accounting: realized spend {led_frac}x the on-demand "
+            "counterfactual — a spot-placing timeline must never exceed 1.0x"
+        )
+    if not costacc.get("within_overhead_budget", False):
+        failures.append(
+            "cost_accounting: ledger watch-path overhead "
+            f"{costacc.get('ledger_overhead_pct')}% of the reconcile "
+            f"timeline (per event {costacc.get('per_event_us')}us) "
+            "exceeds the 5% budget"
         )
     # -- cell_decompose gate (ISSUE 8) --------------------------------------
     if not cells.get("digests_equal", False):

@@ -33,11 +33,33 @@ DOC = os.path.join(ROOT, "docs", "observability.md")
 #: prefixes both match; the trailing slash of a prefix route is stripped)
 _ROUTE = re.compile(r'"(/debug/[a-z_]+)/?"')
 
+#: a route the handler actually DISPATCHES on (an equality compare or a
+#: prefix startswith) — distinct from _ROUTE, which also matches the
+#: DEBUG_ROUTES table literals and would make table-vs-handler vacuous
+_HANDLER = re.compile(
+    r'path\s*==\s*"(/debug/[a-z_]+)"|path\.startswith\("(/debug/[a-z_]+)/"\)'
+)
+
 
 def registered_routes(path: str = SERVER) -> Set[str]:
     with open(path) as f:
         source = f.read()
     return set(_ROUTE.findall(source))
+
+
+def handler_routes(path: str = SERVER) -> Set[str]:
+    """Routes with a real dispatch branch in the handler."""
+    with open(path) as f:
+        source = f.read()
+    return {a or b for a, b in _HANDLER.findall(source)}
+
+
+def table_routes() -> Set[str]:
+    """The DEBUG_ROUTES index table — the source of truth ``GET /debug``
+    serves; imported live so the gate and the index can never disagree."""
+    from karpenter_tpu.utils.httpserver import DEBUG_ROUTES
+
+    return set(DEBUG_ROUTES)
 
 
 def documented_routes(path: str = DOC) -> Set[str]:
@@ -64,6 +86,19 @@ def check() -> List[str]:
         problems.append(
             f"docs/observability.md documents {route} which is not "
             "registered on the HTTP surface"
+        )
+    # the GET /debug index table must track the handler branches exactly
+    table = table_routes()
+    handler = handler_routes()
+    for route in sorted(handler - table):
+        problems.append(
+            f"route {route} has a handler branch but no DEBUG_ROUTES index "
+            "entry (GET /debug would not list it)"
+        )
+    for route in sorted(table - handler):
+        problems.append(
+            f"DEBUG_ROUTES lists {route} but no handler branch serves it "
+            "(GET /debug advertises a 404)"
         )
     return problems
 

@@ -1,0 +1,190 @@
+"""Metric label-cardinality lint — every label key must be enumerated here.
+
+Prometheus series are born from label VALUES, but runaway cardinality always
+arrives through a label KEY that names an unbounded identity space: a pod
+name, a node name, a machine id, a trace id. One such key turns a fleet of
+100k pods into 100k series per metric and takes the scrape path down. This
+gate makes the label-key space a closed, reviewed set:
+
+* every dict literal passed to a metric mutator (``.inc``/``.set``/
+  ``.observe``/``.time``) or to ``series_key`` anywhere in the package must
+  use keys from ``ALLOWED_LABEL_KEYS``;
+* identity-shaped keys (``FORBIDDEN_LABEL_KEYS``) are rejected everywhere —
+  with ONE documented exemption: the fleet-state gauges in
+  ``controllers/metricsscraper/`` carry ``node_name`` because they publish
+  via ``replace_series`` full swaps and registry-refresher pruning, so
+  their series set is bounded by the LIVE fleet, never by history;
+* a non-constant (computed) key in a metric label literal is rejected
+  outright — a computed key is an unreviewable cardinality hole. ``**``
+  spreads are skipped: the spread dict's own literal is checked where it is
+  built.
+
+Static by design (AST over source, no imports): the lint sees call sites
+that only fire on rare paths a test run never visits. Wired as a tier-1
+test (``tests/test_metric_cardinality.py``) like the other drift gates, and
+runnable standalone::
+
+    python hack/check_metric_cardinality.py   # exits 1 and prints offenders
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+from typing import List, Tuple
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PACKAGE = os.path.join(ROOT, "karpenter_tpu")
+
+#: metric mutators whose first positional arg / ``labels=`` kwarg is a label
+#: dict; ``series_key`` builds the same label identity for set_series /
+#: replace_series views
+_METRIC_METHODS = {"inc", "set", "observe", "time"}
+
+#: The closed label-key vocabulary. Adding a key here is a REVIEWED act:
+#: every key must name a bounded enum-like dimension (capacity types, stage
+#: names, reasons, outcome verdicts), never an object identity.
+ALLOWED_LABEL_KEYS = {
+    "action",         # backpressure/queue actions (shed, coalesce, ...)
+    "axes",           # mesh axis layouts (2D shapes, tiny enum)
+    "batcher",        # 'pod' | 'rpc'
+    "bucket",         # AOT size buckets (log-scaled, bounded)
+    "capacity_type",  # spot | on-demand
+    "cell",           # control-plane cells (bounded by cell_max_count)
+    "cluster",        # federation member clusters (config-bounded)
+    "code",           # HTTP/RPC status classes
+    "controller",     # controller names (static set)
+    "endpoint",       # RPC route TEMPLATES (not URLs with ids)
+    "event",          # staging/cache event kinds
+    "instance_type",  # catalog-bounded
+    "kind",           # decision/risk kinds (static set)
+    "method",         # HTTP verbs
+    "mode",           # encode/solve modes (static set)
+    "outcome",        # ok | terminal | exhausted | deadline | ...
+    "owner",          # pod owner KIND (ReplicaSet/Job/...), not owner name
+    "phase",          # node/pod lifecycle phases
+    "preemptor",      # preemption trigger classes
+    "provisioner",    # provisioner names (operator-config-bounded)
+    "reason",         # event/decision reasons (static set)
+    "resource_type",  # cpu/memory/pods + accelerator extended resources
+    "scraper",        # scraper names (static set)
+    "service",        # RPC service names (static set)
+    "site",           # tracemalloc top-site rank (bounded N)
+    "slo",            # SLO objective names (settings-bounded)
+    "source",         # cost-savings streams (spot/consolidation/...)
+    "stage",          # lifecycle stage names (static set)
+    "to",             # breaker target states (closed/open/half-open)
+    "trigger",        # flight-recorder anomaly triggers (static set)
+    "type",           # event types (Normal/Warning)
+    "verdict",        # validation verdicts (static set)
+    "window",         # SLO windows (fast/slow)
+    "zone",           # catalog-bounded
+}
+
+#: identity-shaped keys that must never label a metric: each names a space
+#: that grows with workload history, not with configuration
+FORBIDDEN_LABEL_KEYS = {
+    "pod", "pod_name", "name", "node", "node_name", "machine",
+    "machine_name", "instance_id", "gang", "gang_name", "uid",
+    "trace_id", "reconcile_id", "token",
+}
+
+#: the one exemption: fleet-state gauges keyed by live node, published via
+#: replace_series full swaps + refresher pruning (series die with the node)
+_NODE_NAME_EXEMPT_PREFIX = os.path.join("controllers", "metricsscraper") + os.sep
+_EXEMPT_KEYS = {"node_name"}
+
+
+def _label_dicts(call: ast.Call) -> List[ast.Dict]:
+    """The candidate label-dict literals of one metric-mutator call."""
+    out = []
+    for arg in list(call.args) + [
+        kw.value for kw in call.keywords if kw.arg == "labels"
+    ]:
+        if isinstance(arg, ast.Dict):
+            out.append(arg)
+    return out
+
+
+def _is_metric_call(call: ast.Call) -> bool:
+    fn = call.func
+    if isinstance(fn, ast.Attribute):
+        return fn.attr in _METRIC_METHODS or fn.attr == "series_key"
+    return isinstance(fn, ast.Name) and fn.id == "series_key"
+
+
+def scan_file(path: str, rel: str) -> List[Tuple[str, int, str]]:
+    """(rel_path, line, problem) for every offending label key in one file."""
+    with open(path) as f:
+        try:
+            tree = ast.parse(f.read())
+        except SyntaxError as e:
+            return [(rel, e.lineno or 0, f"unparseable: {e.msg}")]
+    problems = []
+    exempt_file = rel.startswith(_NODE_NAME_EXEMPT_PREFIX)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call) or not _is_metric_call(node):
+            continue
+        for d in _label_dicts(node):
+            for key_node in d.keys:
+                if key_node is None:
+                    continue  # a ** spread: checked at its own literal
+                if not (
+                    isinstance(key_node, ast.Constant)
+                    and isinstance(key_node.value, str)
+                ):
+                    problems.append((
+                        rel, key_node.lineno,
+                        "computed label key (unreviewable cardinality)",
+                    ))
+                    continue
+                key = key_node.value
+                if key in _EXEMPT_KEYS and exempt_file:
+                    continue
+                if key in FORBIDDEN_LABEL_KEYS:
+                    problems.append((
+                        rel, key_node.lineno,
+                        f"forbidden label key {key!r} (unbounded identity "
+                        "space — roll it up or serve it on /debug/*)",
+                    ))
+                elif key not in ALLOWED_LABEL_KEYS:
+                    problems.append((
+                        rel, key_node.lineno,
+                        f"label key {key!r} not in ALLOWED_LABEL_KEYS "
+                        "(extend hack/check_metric_cardinality.py if the "
+                        "key space is genuinely bounded)",
+                    ))
+    return problems
+
+
+def check(package: str = PACKAGE) -> List[str]:
+    """Every offense as a human-readable line; empty means clean."""
+    problems: List[str] = []
+    for root, dirs, files in os.walk(package):
+        dirs[:] = [d for d in dirs if d != "__pycache__"]
+        for name in sorted(files):
+            if not name.endswith(".py"):
+                continue
+            path = os.path.join(root, name)
+            rel = os.path.relpath(path, package)
+            for rel_path, line, problem in scan_file(path, rel):
+                problems.append(f"karpenter_tpu/{rel_path}:{line}: {problem}")
+    return sorted(problems)
+
+
+def main() -> int:
+    problems = check()
+    for p in problems:
+        print(f"CARDINALITY: {p}", file=sys.stderr)
+    if problems:
+        return 1
+    print(
+        f"metric label keys bounded: {len(ALLOWED_LABEL_KEYS)} allowed keys, "
+        f"{len(FORBIDDEN_LABEL_KEYS)} forbidden"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

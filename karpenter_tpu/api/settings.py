@@ -260,6 +260,17 @@ class Settings:
     # budget is (1 - target), burned as karpenter_tpu_slo_burn_rate{slo,
     # window} over fast (5m) and slow (1h) windows.
     slo_pod_ready_target_frac: float = 0.99
+    # cost ledger (utils/costledger.py): when enabled the operator meters
+    # realized spend (node-seconds x launch-time offering price) from
+    # cluster watch events, attributes it per provisioner/cell/gang/pod
+    # with a conservation invariant, and serves /debug/costs plus the
+    # karpenter_tpu_cost_* metrics.
+    cost_ledger_enabled: bool = True
+    # the ledger's rolling-window width: the /debug/costs burn-rate window
+    # default, and the accrual horizon for consolidation-savings and
+    # re-launch-delta streams (a savings claim older than one window is
+    # stale — the fleet has churned under it).
+    cost_ledger_window_s: float = 3600.0
     # multi-cluster federation (federation/): when enabled the operator runs
     # a FederationClient against arbiter_endpoint — pushing capacity
     # summaries every summary_interval_s and routing multi-region-eligible
@@ -389,6 +400,8 @@ class Settings:
             raise ValueError("sloPodReadyP99S must be > 0")
         if not 0 < self.slo_pod_ready_target_frac < 1:
             raise ValueError("sloPodReadyTargetFrac must be in (0, 1)")
+        if self.cost_ledger_window_s <= 0:
+            raise ValueError("costLedgerWindowS must be > 0")
         if self.federation_enabled and not self.arbiter_endpoint:
             raise ValueError(
                 "arbiterEndpoint is required when federation is enabled"

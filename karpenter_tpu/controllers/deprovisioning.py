@@ -87,6 +87,9 @@ class DeprovisioningController:
         self.settings = settings or Settings()
         self.recorder = recorder or Recorder()
         self.clock = clock or Clock()
+        # cost-ledger hook (operator wiring): every EXECUTED action reports
+        # its $/hr savings so consolidation ROI is a realized stream
+        self.costs = None
         # risk-priced objective: consolidation what-ifs must price spot risk
         # the same way provisioning does, or the sweep would "save" money by
         # repacking onto pools the next solve refuses
@@ -943,6 +946,8 @@ class DeprovisioningController:
             self.termination.delete_node(name)
         self.termination.reconcile()
         metrics.DEPROVISIONING_ACTIONS.inc({"reason": action.reason})
+        if self.costs is not None:
+            self.costs.note_consolidation(action, now=self.clock.now())
         self.recorder.publish(
             "Deprovisioned", f"{action.reason}: {action.nodes}", object_kind="Deprovisioner"
         )

@@ -227,6 +227,9 @@ class InterruptionController:
         # federation hook (operator wiring): realized risk events feed the
         # arbiter through the next capacity summary; None = single-cluster
         self.federation = None
+        # cost-ledger hook (operator wiring): exactly-once reclaims charge
+        # the restart tax; rebalance replacements report price regressions
+        self.costs = None
         # cloud provider + settings enable the PROACTIVE rebalance path
         # (replacement launch needs a catalog and the risk penalty knob)
         self.provider = provider
@@ -523,6 +526,12 @@ class InterruptionController:
                     self._note_risk(
                         "interruption", (pool[0], pool[1], wk.CAPACITY_TYPE_SPOT)
                     )
+                    if self.costs is not None:
+                        # same exactly-once edge as the risk note: the ledger
+                        # charges one restart tax per reclaimed instance
+                        self.costs.note_reclaim(
+                            (pool[0], pool[1], wk.CAPACITY_TYPE_SPOT)
+                        )
                 elif node.meta.deletion_timestamp is not None:
                     continue  # duplicate message: node already draining
                 self.unavailable_offerings.mark_unavailable(
@@ -647,6 +656,14 @@ class InterruptionController:
             # else: a reclaim raced the launch and popped the reservation —
             # the node is draining; the fresh replacement stays and absorbs
             # the drained pods next provisioning round (capacity, not a leak)
+        if self.costs is not None:
+            # a replacement priced above the reclaimed pool is a realized
+            # interruption loss (the re-launch delta stream); a cheaper or
+            # unknown-price pool reports nothing
+            pricing = getattr(self.provider, "pricing", None)
+            old_price = pricing.price(*pool) if pricing is not None else None
+            if old_price is not None:
+                self.costs.note_relaunch(old_price, spec.option.price)
         self._record_action("replacement-launched", name, pool, spec, new_node.name)
         DECISIONS.record(
             "rebalance", "replacement-launched", node=name,

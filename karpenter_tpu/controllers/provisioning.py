@@ -432,7 +432,10 @@ class ProvisioningController:
                 try:
                     result = self._reconcile(cap)
                     if cap.captured:
-                        cap.set_outputs_provisioning(result, self.cluster)
+                        cap.set_outputs_provisioning(
+                            result, self.cluster,
+                            getattr(self.provider, "pricing", None),
+                        )
                         # the round's completed lifecycle waterfalls ride
                         # the capsule as forensic output (excluded from the
                         # replay byte-match like aot_solves)

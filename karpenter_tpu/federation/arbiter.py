@@ -419,6 +419,10 @@ class FederationArbiter:
                         ),
                         "risk_peak": m.summary.get("risk_peak"),
                         "marginal_price": m.summary.get("marginal_price"),
+                        # realized burn from the member's cost ledger (None
+                        # for clusters not running one): the operator's view
+                        # of where the fleet's money actually goes
+                        "cost": m.summary.get("cost"),
                     }
                     for n, m in sorted(self._members.items())
                 },

@@ -81,11 +81,15 @@ def build_summary(
     risk_cache=None,
     launch_headroom: Optional[int] = None,
     clock: Optional[Clock] = None,
+    cost_ledger=None,
 ) -> Dict:
     """One capacity summary: the cluster's residue marginal price (cheapest
     available offering — the same crude dual PR 8's arbitration orders cells
-    by), per-zone price breakdown, risk-cache pool estimates, and launch
-    headroom. Pure read — nothing here mutates provider or cluster state."""
+    by), per-zone price breakdown, risk-cache pool estimates, launch
+    headroom, and — when the cluster runs a cost ledger — its realized
+    spend/burn so the arbiter routes on actual burn rather than marginal
+    price alone. Pure read — nothing here mutates provider or cluster state
+    (the ledger settle only closes its own open segments at "now")."""
     marginal = float("inf")
     per_zone: Dict[str, float] = {}
     if provider is not None and cluster is not None:
@@ -118,6 +122,8 @@ def build_summary(
         "risk_peak": round(risk_peak, 6),
         "headroom": launch_headroom,
     }
+    if cost_ledger is not None:
+        summary["cost"] = cost_ledger.federation_fields()
     if clock is not None:
         summary["time"] = round(clock.now(), 6)
     if summary["marginal_price"] is None:
@@ -195,6 +201,7 @@ class FederationClient:
         failure_threshold: int = 3,
         recovery_timeout_s: float = 10.0,
         breaker_clock=None,
+        cost_ledger=None,
     ):
         self.cluster_name = cluster_name
         self.region = region or cluster_name
@@ -202,6 +209,7 @@ class FederationClient:
         self.provider = provider
         self.cluster = cluster
         self.risk_cache = risk_cache
+        self.cost_ledger = cost_ledger
         self.lease_ttl_s = (
             float(getattr(settings, "lease_ttl_s", 30.0)) if settings else 30.0
         )
@@ -276,7 +284,7 @@ class FederationClient:
             self.cluster_name, self.region, self._seq, self.epoch_seen,
             provider=self.provider, cluster=self.cluster,
             risk_cache=self.risk_cache, launch_headroom=launch_headroom,
-            clock=self.clock,
+            clock=self.clock, cost_ledger=self.cost_ledger,
         )
         try:
             self._call(ROUTE_SUMMARY, summary)

@@ -54,6 +54,11 @@ class Operator:
     # through it, interruption feeds it realized regional risk, and the
     # summary tick rides the operator loop at summary_interval_s
     federation: Optional[object] = None
+    # cost ledger (utils/costledger.py), present only when
+    # settings.cost_ledger_enabled and the provider serves a price book:
+    # meters realized spend from watch events, feeds the cost metrics via
+    # the registry refresher, /debug/costs, and the federation summary
+    costledger: Optional[object] = None
     clock: Clock = field(default_factory=Clock)
     # state-observability scrapers (controllers/metricsscraper): periodic
     # cluster-state -> gauge controllers on the operator loop
@@ -237,6 +242,22 @@ class Operator:
             from .cloudprovider.pricing import PricingController
 
             pricing = PricingController(provider.pricing, clock=clock)
+        costledger = None
+        if settings.cost_ledger_enabled and getattr(provider, "pricing", None) is not None:
+            from .utils import metrics as metrics_module
+            from .utils.costledger import CostLedger
+
+            costledger = CostLedger(
+                cluster, provider.pricing, settings=settings, clock=clock
+            ).attach()
+            costledger.register_refresher(metrics_module.REGISTRY)
+            # realized consolidation savings: the deprovisioner reports each
+            # EXECUTED action; exactly-once reclaim losses: the interruption
+            # controller reports next to its risk note (same late-bound hook
+            # shape as the federation link)
+            deprovisioning.costs = costledger
+            if interruption is not None:
+                interruption.costs = costledger
         federation = None
         if settings.federation_enabled:
             from .federation.client import FederationClient
@@ -249,6 +270,7 @@ class Operator:
                 provider=provider,
                 cluster=cluster,
                 risk_cache=risk_cache,
+                cost_ledger=costledger,
             )
             provisioning.federation = federation
             if interruption is not None:
@@ -271,6 +293,7 @@ class Operator:
             garbagecollect=garbagecollect,
             pricing=pricing,
             federation=federation,
+            costledger=costledger,
             clock=clock,
             scrapers=build_scrapers(cluster),
         )
@@ -330,6 +353,13 @@ class Operator:
         ):
             # /debug/federation serves the client's live arbiter-link view
             self.http_server.federation = self.federation.status
+        if (
+            self.http_server is not None
+            and getattr(self.http_server, "costs", None) is None
+            and self.costledger is not None
+        ):
+            # /debug/costs serves the ledger's settled rollups
+            self.http_server.costs = self.costledger.debug_payload
         try:
             self._run_loop(stop, tick)
         finally:

@@ -642,9 +642,9 @@ def replay_capsule(
         "replayed": replayed,
         "recorded": {
             k: recorded.get(k)
-            for k in ("problem_digests", "placements", "unschedulable",
-                      "gang_deferred", "validation_events", "action",
-                      "planned", "decisions", "rebalance_actions")
+            for k in ("problem_digests", "placements", "cost_delta",
+                      "unschedulable", "gang_deferred", "validation_events",
+                      "action", "planned", "decisions", "rebalance_actions")
             if k in recorded
         },
     }
@@ -711,6 +711,17 @@ def replay_capsule(
             else _validation_keys(rec_val)
             == _validation_keys(replayed.get("validation_events"))
         )
+        # the round's ledger delta is a pure function of the launched
+        # offerings and the capsule catalog prices, so it must reproduce
+        # byte-identically — EXCEPT under price overrides, where diverging
+        # is the point (the replayed value answers "what would that round
+        # have cost at counterfactual prices"); pre-ledger capsules lack
+        # the key — skipped, not failed
+        rec_cost = recorded.get("cost_delta")
+        diffs["cost_delta_match"] = (
+            True if rec_cost is None or report.get("counterfactual")
+            else rec_cost == replayed.get("cost_delta")
+        )
         rec_keys = _decision_keys(recorded.get("decisions", []))
         rep_keys = _decision_keys(replayed.get("decisions", []))
         diffs["decisions_match"] = rec_keys == rep_keys
@@ -726,6 +737,7 @@ def replay_capsule(
                 and diffs["unschedulable_match"]
                 and diffs["gang_deferred_match"]
                 and diffs["validation_match"]
+                and diffs["cost_delta_match"]
             )
     elif controller_kind == "rebalance":
         # rebalance rounds compare the full ordered action list — pool,
@@ -866,7 +878,7 @@ def _replay_provisioning(capsule, cluster, provider, solver, settings) -> Dict:
     )
     with script:
         result = controller.reconcile()
-    return provisioning_outputs(result, cluster)
+    return provisioning_outputs(result, cluster, provider.pricing)
 
 
 def _replay_rebalance(capsule, cluster, provider, solver, settings) -> Dict:
@@ -1151,6 +1163,13 @@ def _print_summary(report: Dict) -> None:
         print(f"  validation: recorded={len(rec_val)} events "
               f"({rejected} rejected) "
               f"equal={diffs.get('validation_match')}")
+        rep_cost = rep.get("cost_delta")
+        if rep_cost is not None:
+            rec_cost = rec.get("cost_delta") or {}
+            print(f"  cost_delta: recorded={rec_cost.get('actual_per_hr')}$/hr "
+                  f"replayed={rep_cost.get('actual_per_hr')}$/hr "
+                  f"(ondemand={rep_cost.get('ondemand_per_hr')}$/hr) "
+                  f"equal={diffs.get('cost_delta_match')}")
         print(f"  decisions: equal={diffs.get('decisions_match')}")
     elif report["controller"] == "federation":
         verdict = report.get("replayed", {}).get("verdict", {})

@@ -24,7 +24,11 @@ renders the whole run into a report whose ``violations`` list must be empty:
   in-cluster Machine (the GC/link path's contract across operator crashes);
 * **byte-identical replay** — every anomaly capsule the operator dumped
   along the way replays to a MATCH via the real replay harness
-  (``karpenter_tpu.replay.replay_capsule``), offline.
+  (``karpenter_tpu.replay.replay_capsule``), offline;
+* **ledger conservation** — every ``/debug/costs`` poll is a settle point:
+  the cost ledger's per-consumer attributed spend must equal its metered
+  total within f64 tolerance at EVERY sample, and the windowed burn rate
+  must stay under a sanity budget while the churn generator runs.
 """
 
 from __future__ import annotations
@@ -125,11 +129,16 @@ class InvariantMonitor:
         loop_lag_budget_s: float = 20.0,
         mem_slope_budget_bps: float = 262_144.0,
         sample_interval_s: float = 1.0,
+        cost_burn_budget_per_hr: float = 10_000.0,
     ):
         self.ready_p99_budget_s = ready_p99_budget_s
         self.loop_lag_budget_s = loop_lag_budget_s
         self.mem_slope_budget_bps = mem_slope_budget_bps
         self.sample_interval_s = sample_interval_s
+        # sanity bound, not a spend SLO: the scaled soak fleet is tens of
+        # fake nodes at single-digit $/hr — a burn rate past this means the
+        # ledger double-counts, not that the bill is real
+        self.cost_burn_budget_per_hr = cost_burn_budget_per_hr
         self._lock = threading.Lock()
         self._added: Dict[str, float] = {}     # pod -> add wall time
         self.ready_latencies: List[float] = []
@@ -143,6 +152,12 @@ class InvariantMonitor:
         self.stage_sums: Dict[str, float] = {}
         self.stage_counts: Dict[str, float] = {}
         self.start_times_seen: set = set()
+        # cost-ledger conservation sampling (/debug/costs settle points)
+        self.cost_samples = 0
+        self.cost_total_dollars = 0.0
+        self.cost_burn_max_per_hr = 0.0
+        self.cost_conservation_max_err = 0.0
+        self.cost_conservation_violations: List[str] = []
         self.scrape_failures = 0
         self._cluster = None
         self._stop = threading.Event()
@@ -224,7 +239,41 @@ class InvariantMonitor:
         if rss is not None and start is not None:
             self.mem_samples.append((now, start, rss))
             self.start_times_seen.add(start)
+        self._sample_costs(metrics_url)
         return True
+
+    def _sample_costs(self, metrics_url: str) -> None:
+        """Poll ``/debug/costs`` on the same operator: every poll settles the
+        ledger, so the conservation verdict is asserted at a REAL settle
+        point, not between segment closes. A disabled ledger (or an operator
+        predating it) samples nothing — the soak's verdict then simply
+        carries zero cost samples rather than a false violation."""
+        import json as _json
+
+        base = metrics_url.rsplit("/metrics", 1)[0]
+        try:
+            with urllib.request.urlopen(f"{base}/debug/costs", timeout=2.0) as resp:
+                payload = _json.loads(resp.read().decode())
+        except Exception:
+            return
+        conservation = payload.get("conservation")
+        if conservation is None:
+            return  # ledger disabled
+        self.cost_samples += 1
+        self.cost_total_dollars = max(
+            self.cost_total_dollars, float(payload.get("total_dollars", 0.0))
+        )
+        burn = float(payload.get("windowed", {}).get("burn_per_hr", 0.0))
+        self.cost_burn_max_per_hr = max(self.cost_burn_max_per_hr, burn)
+        err = float(conservation.get("max_abs_error", 0.0))
+        self.cost_conservation_max_err = max(self.cost_conservation_max_err, err)
+        if not conservation.get("ok", True) and len(
+            self.cost_conservation_violations
+        ) < 5:
+            self.cost_conservation_violations.append(
+                f"attributed != metered: max_abs_error={err:.3e} "
+                f"tolerance={conservation.get('tolerance')}"
+            )
 
     def start_sampling(self, metrics_url: str) -> None:
         def loop() -> None:
@@ -339,6 +388,18 @@ class InvariantMonitor:
                 f"{len(orphan_instances)} orphaned cloud instances: "
                 f"{sorted(orphan_instances)[:5]}"
             )
+        if self.cost_conservation_violations:
+            violations.append(
+                f"cost-ledger conservation broke at "
+                f"{len(self.cost_conservation_violations)} settle points: "
+                f"{self.cost_conservation_violations[:3]}"
+            )
+        if self.cost_burn_max_per_hr > self.cost_burn_budget_per_hr:
+            violations.append(
+                f"cost burn rate {self.cost_burn_max_per_hr:.1f}$/hr > "
+                f"sanity budget {self.cost_burn_budget_per_hr:.1f}$/hr "
+                "(ledger double-count, not a real bill)"
+            )
         if replay is not None:
             if replay.get("mismatched"):
                 violations.append(
@@ -378,6 +439,13 @@ class InvariantMonitor:
             },
             "duplicate_tokens": launch_audit.get("duplicate_tokens", {}),
             "orphan_instances": sorted(orphan_instances),
+            "cost": {
+                "samples": self.cost_samples,
+                "total_dollars": round(self.cost_total_dollars, 6),
+                "burn_max_per_hr": round(self.cost_burn_max_per_hr, 6),
+                "conservation_max_abs_error": self.cost_conservation_max_err,
+                "conservation_ok": not self.cost_conservation_violations,
+            },
             "replay": replay,
             "restarts": restarts or {},
             "violations": violations,

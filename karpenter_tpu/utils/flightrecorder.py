@@ -270,11 +270,15 @@ class CapsuleBuilder:
             self.note_anomaly(TRIGGER_FULL_ENCODE)
 
     # -- output capture -----------------------------------------------------
-    def set_outputs_provisioning(self, result, cluster) -> None:
+    def set_outputs_provisioning(self, result, cluster, pricing=None) -> None:
         """Provisioning outputs: per-pod placements (with the chosen offering
         for new nodes — machine names differ across replays, offerings must
-        not), launched node specs, and the unschedulable set."""
-        self._outputs.update(provisioning_outputs(result, cluster))
+        not), launched node specs, the unschedulable set, and — when a price
+        book is supplied — the round's cost delta (a pure function of the
+        launched offerings and the capsule-visible prices, so replay
+        reproduces it byte-identically and ``--override offerings=...=price:``
+        answers what the round would have cost at counterfactual prices)."""
+        self._outputs.update(provisioning_outputs(result, cluster, pricing))
         if result.unschedulable:
             self.note_anomaly(TRIGGER_UNSCHEDULABLE)
 
@@ -368,15 +372,23 @@ def _wire_objects(cache: Dict, kind: str, objs, to_wire, seen: set) -> List[Dict
     return out
 
 
-def provisioning_outputs(result, cluster) -> Dict:
+def provisioning_outputs(result, cluster, pricing=None) -> Dict:
     """Replay-comparable view of a ProvisioningResult: per-pod placements —
     EXISTING-node binds compare by node name (the node is capsule input),
     new-node binds by the chosen offering triple (machine names are fresh
     every process) — plus the launched specs and the unschedulable set.
     Shared by capsule capture and the replay harness so the two sides can
-    never diverge in shape."""
+    never diverge in shape. ``pricing`` (a PricingProvider — live at
+    capture, capsule-catalog-backed on replay) adds the round's cost delta
+    via ``costledger.round_cost_delta``, the ledger's pure per-round spend
+    function."""
     from ..api import labels as wk
 
+    cost_delta = None
+    if pricing is not None:
+        from .costledger import round_cost_delta
+
+        cost_delta = round_cost_delta(result.nodes, pricing)
     new_node_names = {n.meta.name for n in result.nodes}
     nodes_by_name = {n.meta.name: n for n in result.nodes}
     placements: Dict[str, Dict] = {}
@@ -390,6 +402,9 @@ def provisioning_outputs(result, cluster) -> Dict:
         placements[pod] = entry
     return {
         "placements": placements,
+        # None when no price book was supplied (pre-ledger capsules and
+        # callers without a provider) — replay skips the comparison then
+        "cost_delta": cost_delta,
         "unschedulable": sorted(set(result.unschedulable)),
         "gang_deferred": sorted(set(getattr(result, "gang_deferred", []) or [])),
         # validation-firewall evaluations in call order (verdict, backend,

@@ -45,6 +45,16 @@ Debug surface (the pprof-flag analogue, always on and cheap):
 * ``/debug/slo`` — the SLO burn-rate engine (utils/slo.py): per objective,
   the configured threshold/target, per-window (fast/slow) good/bad traffic
   and burn rate, and error budget remaining.
+* ``/debug/costs`` — the cost ledger (utils/costledger.py): settled spend
+  totals, on-demand counterfactual, spot/consolidation savings and
+  interruption-loss streams, windowed burn rate, per-consumer rollups
+  (``?provisioner=``, ``?cell=``, ``?gang=``, ``?window=``) cross-linked to
+  DecisionRecords, and the conservation verdict (attributed == metered).
+  ``{"enabled": false}`` while ``cost_ledger_enabled`` is off.
+
+``GET /debug`` is the index: a JSON route list with one-line descriptions,
+served from the same ``DEBUG_ROUTES`` table
+``hack/check_debug_endpoints.py`` validates — one source of truth, no drift.
 """
 
 from __future__ import annotations
@@ -62,6 +72,42 @@ from .metrics import REGISTRY, Registry
 from .slo import SLO
 from .tracing import TRACER, Tracer
 
+#: The one-source-of-truth debug route table: path -> one-line description.
+#: ``GET /debug`` serves it verbatim, and ``hack/check_debug_endpoints.py``
+#: validates it against both the handler branches (regex over this module's
+#: source) and the runbook (docs/observability.md) — a route cannot ship
+#: without an index entry and a doc section, and a removed route must take
+#: both with it.
+DEBUG_ROUTES = {
+    "/debug/traces": (
+        "retained root span trees, newest first (?trace_id= narrows to one "
+        "distributed trace)"
+    ),
+    "/debug/events": "recent recorder events, newest first (?limit=)",
+    "/debug/decisions": (
+        "scheduling-decision audit log (?pod=, ?node=, ?reconcile_id=, "
+        "?trace_id=, ?kind=, ?limit=)"
+    ),
+    "/debug/flightrecorder": (
+        "reconcile capsule ring; /debug/flightrecorder/<id> fetches one "
+        "capsule as gzip'd JSON for offline replay (?dump=1 writes it)"
+    ),
+    "/debug/cells": (
+        "sharded control plane partition view (?pod= explains one pod's "
+        "cell assignment)"
+    ),
+    "/debug/lifecycle": (
+        "pod-lifecycle stage attribution (?pod= renders one waterfall, "
+        "?limit=)"
+    ),
+    "/debug/federation": "federation client's view of the global arbiter",
+    "/debug/slo": "SLO burn rates and error budget remaining per objective",
+    "/debug/costs": (
+        "cost-ledger rollups: spend, savings/loss streams, burn rate and "
+        "conservation verdict (?provisioner=, ?cell=, ?gang=, ?window=)"
+    ),
+}
+
 
 class OperatorHTTPServer:
     def __init__(
@@ -77,6 +123,7 @@ class OperatorHTTPServer:
         flightrecorder: Optional[FlightRecorder] = None,
         cells: Optional[Callable[[Optional[str]], dict]] = None,
         federation: Optional[Callable[[], dict]] = None,
+        costs: Optional[Callable[..., dict]] = None,
         host: str = "127.0.0.1",
     ):
         self.registry = registry or REGISTRY
@@ -102,6 +149,10 @@ class OperatorHTTPServer:
         # by the operator when settings.federation_enabled (same adoption
         # pattern as `cells`)
         self.federation = federation
+        # cost-ledger rollups: the ledger's debug_payload (kwargs:
+        # provisioner/cell/gang/window), late-bound by the operator when
+        # settings.cost_ledger_enabled (same adoption pattern as `cells`)
+        self.costs = costs
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -240,6 +291,38 @@ class OperatorHTTPServer:
                     self.send_header("Content-Type", "application/json")
                 elif path == "/debug/slo":
                     body = json.dumps(SLO.snapshot(), default=str).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                elif path == "/debug/costs":
+                    q = parse_qs(query)
+
+                    def carg(name):
+                        return q.get(name, [None])[0]
+
+                    fn = outer.costs
+                    if fn is None:
+                        payload = {"enabled": False}
+                    else:
+                        try:
+                            window = float(carg("window") or 0) or None
+                        except ValueError:
+                            window = None
+                        payload = fn(
+                            provisioner=carg("provisioner"), cell=carg("cell"),
+                            gang=carg("gang"), window=window,
+                        )
+                    body = json.dumps(payload, default=str).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                elif path in ("/debug", "/debug/"):
+                    # the index: the DEBUG_ROUTES table verbatim — the same
+                    # table the endpoint drift gate validates
+                    body = json.dumps({
+                        "routes": [
+                            {"path": p, "description": d}
+                            for p, d in DEBUG_ROUTES.items()
+                        ],
+                    }).encode()
                     self.send_response(200)
                     self.send_header("Content-Type", "application/json")
                 elif path == "/debug/events":

@@ -777,6 +777,25 @@ SLO_BUDGET_REMAINING = Gauge(
     registry=REGISTRY,
 )
 
+# -- cost ledger -------------------------------------------------------------
+COST_DOLLARS = Counter(
+    "karpenter_tpu_cost_dollars_total",
+    help="Realized spend metered by the cost ledger: node-seconds times the "
+         "launch-time offering price, integrated continuously from cluster "
+         "watch events, labeled by provisioner and capacity type (bounded "
+         "labels; per-pod/per-gang attribution lives on /debug/costs).",
+    registry=REGISTRY,
+)
+COST_SAVINGS = Counter(
+    "karpenter_tpu_cost_savings_dollars_total",
+    help="Counterfactual streams from the cost ledger, labeled by source: "
+         "'spot' is on-demand sticker minus metered spend on spot capacity, "
+         "'consolidation' is executed-action savings accrued over the "
+         "ledger window, 'interruption_loss' is dollars LOST to reclaims "
+         "(restart tax + re-launch price deltas; monotonic like the rest).",
+    registry=REGISTRY,
+)
+
 # -- event stream ------------------------------------------------------------
 EVENTS_TOTAL = Counter(
     "karpenter_tpu_events_total",

@@ -90,6 +90,17 @@ def test_dry_run_last_stdout_line_is_json_summary(tmp_path):
                 "mesh_super_dispatches"):
         assert key in summary
         assert summary[key] is None
+    # the ISSUE-19 cost-ledger fields ride the summary; the tiny accounting
+    # scenario RUNS in dry-run (no subprocesses), so the EQUALITY verdicts
+    # are concrete — metered == integrated and conservation hold at any
+    # scale (overhead pct is reported but only gated at regression scale)
+    assert summary["cost_integration_equal"] is True
+    assert summary["cost_conservation_ok"] is True
+    assert summary["cost_frac_consistent"] is True
+    assert summary["cost_ledger_dollars"] is not None
+    assert summary["cost_ledger_vs_ondemand_frac"] is not None
+    assert "cost_ledger_overhead_pct" in summary
+    assert "cost_ledger_within_budget" in summary
     # every stdout line is valid JSON on its own (no partial fragments)
     for ln in lines:
         json.loads(ln)
@@ -254,6 +265,29 @@ class TestArtifactWriter:
         assert rt["mesh_super_equal"] is True
         assert rt["mesh_axes"] == "4x2"
         assert rt["mesh_violations"] == 0
+
+    def test_cost_summary_fields_round_trip(self):
+        # ISSUE-19 satellite: the cost-ledger verdicts (metered total equals
+        # the independent integration, partitions conserve, spend fraction
+        # consistency, overhead budget) survive the artifact writer
+        # byte-for-byte
+        summary = json.dumps({
+            "metric": "m", "summary": True,
+            "cost_integration_equal": True,
+            "cost_conservation_ok": True,
+            "cost_ledger_dollars": 0.108536,
+            "cost_ledger_vs_ondemand_frac": 0.2993,
+            "cost_frac_consistent": True,
+            "cost_ledger_overhead_pct": 1.99,
+            "cost_ledger_within_budget": True,
+        })
+        artifact = bench_artifact.build_artifact(19, "cmd", 0, summary + "\n")
+        assert artifact["parsed"] == json.loads(summary)
+        rt = json.loads(json.dumps(artifact, allow_nan=False))["parsed"]
+        assert rt["cost_integration_equal"] is True
+        assert rt["cost_conservation_ok"] is True
+        assert rt["cost_ledger_dollars"] == 0.108536
+        assert rt["cost_ledger_within_budget"] is True
 
     def test_summary_file_preferred_over_stdout(self, tmp_path):
         # ISSUE-18 satellite: when the file channel exists, it WINS — stdout

@@ -1,0 +1,402 @@
+"""ISSUE 19 suite: the cost ledger — continuous spend metering from watch
+events, conservation-checked attribution, counterfactual streams, the
+``/debug/costs`` surface, and byte-identical capsule replay of the
+per-round ledger delta (including the on-demand price counterfactual)."""
+
+from __future__ import annotations
+
+import json
+import random
+import types
+import urllib.request
+
+import pytest
+
+from karpenter_tpu.api import ObjectMeta, Resources
+from karpenter_tpu.api import labels as wk
+from karpenter_tpu.api.objects import Node
+from karpenter_tpu.api.settings import Settings
+from karpenter_tpu.cloudprovider import FakeCloudProvider, generate_catalog
+from karpenter_tpu.controllers.provisioning import ProvisioningController
+from karpenter_tpu.replay import replay_capsule
+from karpenter_tpu.solver.solver import GreedySolver
+from karpenter_tpu.state import Cluster
+from karpenter_tpu.utils import metrics
+from karpenter_tpu.utils.cache import FakeClock
+from karpenter_tpu.utils.costledger import (
+    IDLE,
+    NO_GANG,
+    CostLedger,
+    round_cost_delta,
+)
+from karpenter_tpu.utils.decisions import DECISIONS
+from karpenter_tpu.utils.flightrecorder import FLIGHT
+from karpenter_tpu.utils.httpserver import OperatorHTTPServer
+
+from helpers import make_pod, make_pods, make_provisioner
+
+
+@pytest.fixture(autouse=True)
+def _fresh_rings():
+    DECISIONS.configure(2048)
+    DECISIONS.clear()
+    FLIGHT.configure(32)
+    FLIGHT.clear()
+    yield
+    FLIGHT.clear()
+    DECISIONS.clear()
+
+
+def make_node(name, instance_type, zone, capacity_type,
+              provisioner="default", cpu="8", memory="32Gi"):
+    return Node(
+        meta=ObjectMeta(name=name, labels={
+            wk.INSTANCE_TYPE: instance_type,
+            wk.ZONE: zone,
+            wk.CAPACITY_TYPE: capacity_type,
+            wk.PROVISIONER_NAME: provisioner,
+        }),
+        provider_id=f"fake:///{zone}/i-{name}",
+        capacity=Resources(cpu=cpu, memory=memory),
+        allocatable=Resources(cpu=cpu, memory=memory),
+        ready=True,
+    )
+
+
+def ledger_env(window_s=600.0, n_types=12):
+    clock = FakeClock(0.0)
+    provider = FakeCloudProvider(catalog=generate_catalog(n_types=n_types))
+    cluster = Cluster()
+    ledger = CostLedger(
+        cluster, provider.pricing, clock=clock, window_s=window_s
+    ).attach()
+    return cluster, provider, ledger, clock
+
+
+# ---------------------------------------------------------------------------
+# Conservation property under random interleavings
+# ---------------------------------------------------------------------------
+
+
+class TestConservationProperty:
+    @pytest.mark.parametrize("seed", [0, 1, 7, 2026])
+    def test_random_interleavings_conserve_and_match_offline_integral(
+        self, seed
+    ):
+        """Random launch/bind/unbind/terminate/reclaim/consolidation
+        interleavings under a fake clock: (a) every partition sums to the
+        metered total at every settle point (conservation), and (b) the
+        ledger total equals an INDEPENDENT offline integration of each
+        node's price over its lifespan (piecewise-constant rate, so the
+        trapezoid rule is exact) — metering and integration must agree."""
+        rng = random.Random(seed)
+        cluster, provider, ledger, clock = ledger_env()
+        open_t, price_of = {}, {}
+        offline = 0.0  # closed-span dollars, integrated independently
+        node_i = pod_i = 0
+        live_pods = []
+
+        def launch():
+            nonlocal node_i
+            it = rng.choice(provider.catalog)
+            off = rng.choice(it.offerings)
+            node_i += 1
+            name = f"n{node_i}"
+            cluster.add_node(make_node(name, it.name, off.zone, off.capacity_type))
+            open_t[name] = clock.now()
+            p = provider.pricing.price(it.name, off.zone, off.capacity_type)
+            price_of[name] = float(p) if p is not None else 0.0
+
+        def terminate():
+            nonlocal offline
+            if not open_t:
+                return
+            name = rng.choice(sorted(open_t))
+            for pod in [
+                p for p in cluster.pods.values() if p.node_name == name
+            ]:
+                cluster.delete_pod(pod.meta.name)
+                if pod.meta.name in live_pods:
+                    live_pods.remove(pod.meta.name)
+            cluster.delete_node(name)
+            offline += price_of.pop(name) * (clock.now() - open_t.pop(name)) / 3600.0
+
+        def bind():
+            nonlocal pod_i
+            if not open_t:
+                return
+            pod_i += 1
+            gang = rng.choice([None, "gang-a", "gang-b"])
+            pod = make_pod(
+                name=f"cl-p{pod_i}",
+                cpu=rng.choice(["250m", "1", "4"]),
+                memory=rng.choice(["512Mi", "2Gi"]),
+                labels={wk.POD_GROUP: gang} if gang else None,
+            )
+            cluster.add_pod(pod)
+            cluster.bind_pod(pod.meta.name, rng.choice(sorted(open_t)))
+            live_pods.append(pod.meta.name)
+
+        def unbind():
+            if live_pods:
+                cluster.delete_pod(live_pods.pop(rng.randrange(len(live_pods))))
+
+        for step in range(300):
+            clock.step(rng.uniform(0.0, 45.0))
+            r = rng.random()
+            if r < 0.30:
+                launch()
+            elif r < 0.45:
+                terminate()
+            elif r < 0.75:
+                bind()
+            elif r < 0.90:
+                unbind()
+            elif r < 0.95:
+                ledger.note_reclaim(("t", "z", wk.CAPACITY_TYPE_SPOT))
+            else:
+                ledger.note_consolidation(
+                    types.SimpleNamespace(savings=rng.uniform(0.01, 2.0))
+                )
+            if step % 25 == 0:
+                ledger.settle()
+                verdict = ledger.conservation()
+                assert verdict["ok"], verdict
+
+        while open_t:
+            terminate()
+        clock.step(5.0)
+        t = ledger.settle()
+        verdict = ledger.conservation()
+        assert verdict["ok"], verdict
+        # the independent integral: every span is now closed
+        assert ledger.total_dollars == pytest.approx(
+            offline, rel=1e-9, abs=1e-9
+        )
+        assert ledger.total_dollars > 0.0  # 300 steps cannot be a no-op run
+        # spot counterfactual: on-demand sticker is never below realized
+        assert ledger.ondemand_dollars >= ledger.total_dollars - 1e-9
+        assert ledger.savings_spot >= -1e-9
+
+    def test_dominant_share_attribution_and_exact_idle_remainder(self):
+        cluster, provider, ledger, clock = ledger_env()
+        it = provider.catalog[0]
+        off = it.offerings[0]
+        cluster.add_node(make_node("n1", it.name, off.zone, off.capacity_type,
+                                   cpu="8", memory="32Gi"))
+        # dominant share 0.5 (4/8 cpu beats 8/32 memory)
+        pod = make_pod(name="cl-half", cpu="4", memory="8Gi",
+                       labels={wk.POD_GROUP: "gang-x"})
+        cluster.add_pod(pod)
+        cluster.bind_pod("cl-half", "n1")
+        clock.step(3600.0)
+        ledger.settle()
+        price = float(provider.pricing.price(it.name, off.zone, off.capacity_type))
+        assert ledger.total_dollars == pytest.approx(price)
+        assert ledger.by_gang["gang-x"] == pytest.approx(price * 0.5)
+        # idle is the EXACT remainder, not an independently-computed share
+        assert ledger.by_gang[IDLE] == (
+            ledger.total_dollars - ledger.by_gang["gang-x"]
+        )
+        assert ledger.by_pod["cl-half"]["dollars"] == pytest.approx(price * 0.5)
+        assert ledger.conservation()["ok"]
+
+    def test_oversubscribed_residents_normalize_with_no_idle(self):
+        cluster, provider, ledger, clock = ledger_env()
+        it = provider.catalog[0]
+        off = it.offerings[0]
+        cluster.add_node(make_node("n1", it.name, off.zone, off.capacity_type,
+                                   cpu="4", memory="16Gi"))
+        for i in range(3):  # 3 × 3/4 cpu → Σ shares 2.25, normalized to 1.0
+            p = make_pod(name=f"cl-big{i}", cpu="3", memory="1Gi")
+            cluster.add_pod(p)
+            cluster.bind_pod(p.meta.name, "n1")
+        clock.step(1800.0)
+        ledger.settle()
+        assert ledger.by_gang.get(IDLE, 0.0) == pytest.approx(0.0, abs=1e-12)
+        assert ledger.by_gang[NO_GANG] == pytest.approx(ledger.total_dollars)
+        assert ledger.conservation()["ok"]
+
+    def test_prices_pinned_at_launch_survive_book_refresh(self):
+        cluster, provider, ledger, clock = ledger_env()
+        it = provider.catalog[0]
+        off = it.offerings[0]
+        cluster.add_node(make_node("n1", it.name, off.zone, off.capacity_type))
+        pinned = float(provider.pricing.price(it.name, off.zone, off.capacity_type))
+        # a later repricing must not rewrite the meter opened above
+        ledger.pricing = None
+        clock.step(7200.0)
+        ledger.settle()
+        assert ledger.total_dollars == pytest.approx(pinned * 2.0)
+
+
+# ---------------------------------------------------------------------------
+# Counterfactual streams + metrics + debug surface
+# ---------------------------------------------------------------------------
+
+
+class TestStreamsAndSurface:
+    def test_consolidation_stream_accrues_over_one_window_then_expires(self):
+        cluster, provider, ledger, clock = ledger_env(window_s=600.0)
+        ledger.note_consolidation(types.SimpleNamespace(savings=3.6))
+        clock.step(300.0)
+        ledger.settle()
+        assert ledger.savings_consolidation == pytest.approx(3.6 * 300 / 3600)
+        clock.step(10_000.0)  # far past the horizon: accrual stops at window
+        ledger.settle()
+        assert ledger.savings_consolidation == pytest.approx(3.6 * 600 / 3600)
+        assert ledger.consolidation_actions == 1
+
+    def test_reclaim_and_relaunch_losses(self):
+        clock = FakeClock(0.0)
+        provider = FakeCloudProvider(catalog=generate_catalog(n_types=6))
+        ledger = CostLedger(
+            Cluster(), provider.pricing,
+            settings=Settings(interruption_penalty_cost=2.5),
+            clock=clock, window_s=3600.0,
+        ).attach()
+        ledger.note_reclaim(("it", "z", wk.CAPACITY_TYPE_SPOT))
+        assert ledger.loss_restart_tax == pytest.approx(2.5)
+        ledger.note_relaunch(0.10, 0.25)   # $0.15/hr regression
+        ledger.note_relaunch(0.30, 0.20)   # improvement: no loss stream
+        clock.step(3600.0)
+        ledger.settle()
+        assert ledger.loss_relaunch == pytest.approx(0.15)
+        fed = ledger.federation_fields()
+        assert fed["loss_dollars"] == pytest.approx(2.5 + 0.15)
+
+    def test_metrics_refresher_publishes_bounded_series(self):
+        cluster, provider, ledger, clock = ledger_env()
+        it = provider.catalog[0]
+        spot = next(
+            o for o in it.offerings
+            if o.capacity_type == wk.CAPACITY_TYPE_SPOT
+        )
+        cluster.add_node(make_node("n1", it.name, spot.zone, spot.capacity_type))
+        clock.step(3600.0)
+        ledger.publish_metrics()
+        got = metrics.COST_DOLLARS.value(
+            {"provisioner": "default", "capacity_type": wk.CAPACITY_TYPE_SPOT}
+        )
+        pinned = float(provider.pricing.price(it.name, spot.zone, spot.capacity_type))
+        assert got == pytest.approx(pinned)
+        assert metrics.COST_SAVINGS.value({"source": "spot"}) >= 0.0
+
+    def test_debug_costs_endpoint_and_index(self):
+        cluster, provider, ledger, clock = ledger_env()
+        it = provider.catalog[0]
+        off = it.offerings[0]
+        cluster.add_node(make_node("n1", it.name, off.zone, off.capacity_type))
+        pod = make_pod(name="cl-dbg", cpu="1", labels={wk.POD_GROUP: "g1"})
+        cluster.add_pod(pod)
+        cluster.bind_pod("cl-dbg", "n1")
+        clock.step(1800.0)
+        srv = OperatorHTTPServer(port=0, costs=ledger.debug_payload).start()
+        try:
+            base = f"http://127.0.0.1:{srv.port}"
+            with urllib.request.urlopen(f"{base}/debug/costs") as r:
+                payload = json.loads(r.read())
+            assert payload["total_dollars"] > 0
+            assert payload["conservation"]["ok"] is True
+            assert payload["by_gang"]["g1"]["decisions"] == "/debug/decisions?q=g1"
+            with urllib.request.urlopen(
+                f"{base}/debug/costs?gang=g1&window=900"
+            ) as r:
+                filtered = json.loads(r.read())
+            assert set(filtered["by_gang"]) == {"g1"}
+            assert filtered["windowed"]["window_s"] <= 900
+            # the /debug index advertises every route, costs included
+            with urllib.request.urlopen(f"{base}/debug") as r:
+                index = json.loads(r.read())
+            paths = [e["path"] for e in index["routes"]]
+            assert "/debug/costs" in paths and "/debug/decisions" in paths
+            assert all(e["description"] for e in index["routes"])
+        finally:
+            srv.stop()
+
+    def test_debug_costs_disabled_without_ledger(self):
+        srv = OperatorHTTPServer(port=0).start()
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/debug/costs"
+            ) as r:
+                assert json.loads(r.read()) == {"enabled": False}
+        finally:
+            srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# Capsule replay: the per-round ledger delta is a pure function of inputs
+# ---------------------------------------------------------------------------
+
+
+def _spot_round():
+    cluster = Cluster()
+    provider = FakeCloudProvider(catalog=generate_catalog(n_types=20))
+    controller = ProvisioningController(
+        cluster, provider, solver=GreedySolver(),
+        settings=Settings(
+            batch_idle_duration=0, batch_max_duration=0,
+            spot_enabled=True, interruption_penalty_cost=0.0,
+        ),
+    )
+    cluster.add_provisioner(make_provisioner())
+    for p in make_pods(6, prefix="cl", cpu="500m", memory="1Gi"):
+        cluster.add_pod(p)
+    result = controller.reconcile()
+    assert result.nodes
+    capsule = json.loads(json.dumps(FLIGHT.latest("provisioning"), default=str))
+    return capsule, result, provider
+
+
+class TestLedgerReplay:
+    def test_round_cost_delta_replays_byte_identical(self):
+        capsule, result, provider = _spot_round()
+        recorded = capsule["outputs"]["cost_delta"]
+        # the capsule carries the delta, and it matches a direct computation
+        assert recorded == json.loads(json.dumps(
+            round_cost_delta(result.nodes, provider.pricing)
+        ))
+        report = replay_capsule(capsule, solver="greedy")
+        assert report["diffs"]["cost_delta_match"] is True, report["diffs"]
+        assert report["match"] is True
+        assert json.dumps(report["replayed"]["cost_delta"], sort_keys=True) \
+            == json.dumps(recorded, sort_keys=True)
+
+    def test_spot_round_ondemand_counterfactual_strictly_higher(self):
+        capsule, result, provider = _spot_round()
+        delta = capsule["outputs"]["cost_delta"]
+        spot_dollars = delta["per_capacity_type"].get(wk.CAPACITY_TYPE_SPOT, 0.0)
+        assert spot_dollars > 0.0  # the round genuinely placed spot
+        assert delta["ondemand_per_hr"] > delta["actual_per_hr"]
+        assert delta["savings_per_hr"] == pytest.approx(
+            delta["ondemand_per_hr"] - delta["actual_per_hr"], abs=2e-6
+        )
+
+    def test_price_override_counterfactual_diverges_and_is_flagged(self):
+        """``--override offerings=*/*/spot=price:99`` prices every spot pool
+        out: the replayed round places on-demand, its ledger delta carries no
+        spot savings, and the cost comparison is SKIPPED (counterfactual
+        divergence is the point, not a replay failure)."""
+        capsule, result, provider = _spot_round()
+        recorded = capsule["outputs"]["cost_delta"]
+        report = replay_capsule(
+            capsule,
+            overrides=["offerings=*/*/spot=price:99.0"],
+            solver="greedy",
+        )
+        assert report["counterfactual"] is True
+        replayed = report["replayed"]["cost_delta"]
+        # spot priced out: the counterfactual spends more and saves nothing
+        assert replayed["actual_per_hr"] > recorded["actual_per_hr"]
+        assert replayed["per_capacity_type"].get(wk.CAPACITY_TYPE_SPOT, 0.0) == 0.0
+        assert replayed["savings_per_hr"] == pytest.approx(0.0, abs=1e-6)
+        # the comparison is skipped, not failed
+        assert report["diffs"]["cost_delta_match"] is True
+
+    def test_pre_ledger_capsule_skips_cost_comparison(self):
+        capsule, _, _ = _spot_round()
+        del capsule["outputs"]["cost_delta"]
+        report = replay_capsule(capsule, solver="greedy")
+        assert report["diffs"]["cost_delta_match"] is True
+        assert report["match"] is True

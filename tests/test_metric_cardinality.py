@@ -1,0 +1,65 @@
+"""Tier-1 cardinality gate: every metric label key used anywhere in the
+package must come from the bounded enumerated vocabulary in
+hack/check_metric_cardinality.py — no pod-name/node-name/uid label keys
+(the one documented exemption: metricsscraper fleet gauges)."""
+
+from __future__ import annotations
+
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(ROOT, "hack"))
+
+import check_metric_cardinality  # noqa: E402
+
+
+def test_label_keys_bounded():
+    problems = check_metric_cardinality.check()
+    assert problems == [], "\n".join(problems)
+
+
+def test_vocabularies_disjoint():
+    overlap = (
+        check_metric_cardinality.ALLOWED_LABEL_KEYS
+        & check_metric_cardinality.FORBIDDEN_LABEL_KEYS
+    )
+    assert overlap == set()
+
+
+def test_scanner_is_not_vacuous(tmp_path):
+    # the lint must actually SEE call sites: a forbidden key, an
+    # unenumerated key, and a computed key each produce a finding
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "METRIC.inc({'pod_name': pod.name})\n"
+        "GAUGE.set(1.0, labels={'mystery_key': 'x'})\n"
+        "key = series_key({prefix + 'dynamic': 'y'})\n"
+    )
+    problems = check_metric_cardinality.scan_file(str(bad), "bad.py")
+    messages = [p for _, _, p in problems]
+    assert len(problems) == 3
+    assert any("forbidden label key 'pod_name'" in m for m in messages)
+    assert any("'mystery_key' not in ALLOWED_LABEL_KEYS" in m for m in messages)
+    assert any("computed label key" in m for m in messages)
+
+
+def test_spreads_and_exemption():
+    # ** spreads are skipped (their source literal is checked where built);
+    # node_name passes ONLY under controllers/metricsscraper/
+    src = "METRIC.inc({**labels, 'outcome': 'terminal'})\n"
+    import tempfile
+
+    with tempfile.NamedTemporaryFile("w", suffix=".py", delete=False) as f:
+        f.write(src)
+        path = f.name
+    try:
+        assert check_metric_cardinality.scan_file(path, "utils/resilience.py") == []
+        with open(path, "w") as f:
+            f.write("NODE_CPU.set(0.5, {'node_name': n})\n")
+        exempt_rel = os.path.join("controllers", "metricsscraper", "node.py")
+        assert check_metric_cardinality.scan_file(path, exempt_rel) == []
+        elsewhere = check_metric_cardinality.scan_file(path, "utils/metrics.py")
+        assert len(elsewhere) == 1 and "forbidden" in elsewhere[0][2]
+    finally:
+        os.unlink(path)
