@@ -82,6 +82,17 @@ floor:
   real accelerator platforms — forced host devices share the same CPUs.
   Below 2 devices the arm SKIPs VISIBLY (a stderr NOTE, never a vacuous
   pass).
+* ``profiler_overhead`` + ``perf_sentinel`` (ISSUE 20): the continuous
+  sampling profiler must cost < 5% of round p50 at its default ~19 Hz
+  (with the profiler-off rounds verifiably thread-free — zero overhead
+  when disabled), and the perf-regression sentinel must catch a scripted
+  device-path slowdown (injected dispatch-hang latency, rounds still
+  completing) within K rounds of it starting — naming the ``solve`` phase
+  and a concrete AOT bucket in the trip — auto-dump an anomaly capsule
+  whose collapsed profile contains the dispatch-wait frames, and that
+  capsule must replay byte-identically. Vacuousness-guarded both ways:
+  zero false trips on the clean rounds BEFORE the fault, and the scripted
+  faults must actually have fired with the baseline armed.
 * ``soak`` (ISSUE 11): the scaled chaos soak (sustained churn over the
   real-HTTP stack incl. one operator SIGKILL+restart and one apiserver
   restart) must finish with ZERO invariant violations — which covers the
@@ -215,6 +226,16 @@ def run_checks(full: bool = False) -> list:
     lifecycle = bench.bench_lifecycle_overhead(
         repeats=6, n_pods=2_000 if full else 300
     )
+    # profiler + perf sentinel arms (ISSUE 20): the overhead guard at the
+    # default sample rate, and the scripted-slowdown detection scenario —
+    # 600 pods is the race_min_pods floor, not a scale choice
+    profiler = bench.bench_profiler_overhead(
+        repeats=6, n_pods=2_000 if full else 300
+    )
+    sentinel = bench.bench_perf_sentinel(
+        n_pods=2_000 if full else 600,
+        warm_rounds=4, slow_rounds=12, n_types=20 if full else 8,
+    )
     # meshed superproblem arm (ISSUE 18): needs >= 2 devices — the scenario
     # itself reports a typed skip below that, which the gate surfaces as a
     # stderr NOTE instead of a vacuous pass
@@ -240,6 +261,7 @@ def run_checks(full: bool = False) -> list:
         "cell_fleet": cells_fleet, "gang_topology": gangtopo,
         "device_staging": staging, "device_faults": devfault,
         "lifecycle_overhead": lifecycle,
+        "profiler_overhead": profiler, "perf_sentinel": sentinel,
         "cold_solve": cold, "kernel_race": race,
         "kernel_race_topology": race_topo,
         "kernel_race_topology_50k": race_topo_50k,
@@ -582,6 +604,72 @@ def run_checks(full: bool = False) -> list:
         failures.append(
             "lifecycle_overhead: no dominant stage named — stage "
             "attribution produced no segments"
+        )
+    # -- profiler gate (ISSUE 20) ---------------------------------------------
+    po = profiler.get("prof_overhead_pct")
+    if po is None or po >= 5.0:
+        failures.append(
+            f"profiler_overhead: sampler cost {po}% of round p50 at the "
+            f"default {profiler.get('sample_hz')} Hz >= the 5% budget"
+        )
+    if profiler.get("profiler_off_thread_alive") is not False:
+        failures.append(
+            "profiler_overhead: a sampler thread was alive during the "
+            "profiler-OFF rounds — the zero-overhead-when-disabled "
+            "contract broke (or the off arm never measured it)"
+        )
+    if sentinel.get("detected_within_k") is not True:
+        failures.append(
+            "perf_sentinel: the scripted dispatch slowdown was detected in "
+            f"{sentinel.get('detected_in_rounds')} rounds, not within "
+            f"K={sentinel.get('mad_k')} of it starting"
+        )
+    if sentinel.get("trip_phase") != "solve":
+        failures.append(
+            f"perf_sentinel: trip named phase {sentinel.get('trip_phase')!r} "
+            "— the dispatch-hang slowdown must attribute to 'solve'"
+        )
+    if not sentinel.get("trip_bucket"):
+        failures.append(
+            "perf_sentinel: trip named no AOT bucket — the per-bucket "
+            "attribution half of the DecisionRecord regressed"
+        )
+    if sentinel.get("capsule_dumped") is not True or sentinel.get(
+        "capsule_trigger_ok"
+    ) is not True:
+        failures.append(
+            "perf_sentinel: no anomaly capsule auto-dumped with the "
+            "perf-regression trigger "
+            f"(dumped={sentinel.get('capsule_dumped')}, "
+            f"trigger={sentinel.get('capsule_trigger_ok')})"
+        )
+    if sentinel.get("profile_has_dispatch_path") is not True:
+        failures.append(
+            "perf_sentinel: the capsule's collapsed profile contains no "
+            "dispatch-wait frames (_poll_dispatch/_fetch_bounded) — the "
+            "trip's forensic profile window missed the slow path"
+        )
+    if sentinel.get("capsule_replay_match") is not True:
+        failures.append(
+            "perf_sentinel: the perf-regression capsule did not replay "
+            "byte-identically (the forensic profile fields must ride "
+            "OUTSIDE the replay comparison)"
+        )
+    if sentinel.get("false_trips", 1) != 0:
+        failures.append(
+            f"perf_sentinel: {sentinel.get('false_trips')} trip(s) fired on "
+            "the clean rounds BEFORE the fault — the sentinel false-trips "
+            "on a healthy pipeline"
+        )
+    if (
+        sentinel.get("baseline_armed") is not True
+        or sentinel.get("faults_fired", 0) < 1
+    ):
+        failures.append(
+            "perf_sentinel exercised too little "
+            f"(baseline_armed={sentinel.get('baseline_armed')}, "
+            f"faults_fired={sentinel.get('faults_fired')}) — the scenario "
+            "regressed, the gate is vacuous"
         )
     # -- federation-storm gate (ISSUE 17) -------------------------------------
     if fed.get("fed_unschedulable_p100", 1) != 0:

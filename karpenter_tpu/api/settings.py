@@ -66,11 +66,35 @@ class Settings:
     # open — and on-demand via /debug/flightrecorder/<id>?dump=1. Empty
     # disables automatic dumping (capsules stay fetchable over HTTP).
     flight_recorder_dump_dir: str = ""
-    # runtime-health memory profiling (utils/runtimehealth.py): turns
-    # tracemalloc on and exports the top allocation sites as
-    # karpenter_tpu_tracemalloc_top_bytes — measurable overhead, off by
-    # default; karpenter_tpu_process_memory_bytes is always exported.
-    memory_profiling_enabled: bool = False
+    # continuous profiling (utils/profiling.py + utils/runtimehealth.py):
+    # ONE switch for both diagnosis profilers — the sampling CPU profiler
+    # (background sys._current_frames() walker aggregating collapsed stacks
+    # on /debug/profile) and tracemalloc allocation-site tracking
+    # (karpenter_tpu_tracemalloc_top_bytes). Measurable overhead, off by
+    # default; on-demand /debug/profile?seconds= windows work either way,
+    # and the process carries zero profiling threads while this is off.
+    profiling_enabled: bool = False
+    # sampling rate of the CPU profiler, Hz. Deliberately odd (prime) by
+    # default so the sampler never phase-locks with periodic 10/20/100 Hz
+    # work; the bench profiler_overhead guard budgets < 5% of round p50 at
+    # this default rate.
+    profiling_sample_hz: float = 19.0
+    # rounds of fresh observations a (phase, mode) / AOT-bucket key needs
+    # before its latency baseline (p50/p99 + MAD band) freezes; baselines
+    # persist next to the AOT disk cache so restarts skip re-warming.
+    profiling_baseline_rounds: int = 20
+    # online perf-regression sentinel (utils/profiling.py): compares each
+    # phase's live EWMA against its baseline MAD band every provisioning
+    # round, and on a sustained exit emits
+    # karpenter_tpu_perf_regression_total{phase}, writes a kind=perf
+    # DecisionRecord, opens a profile window and dumps a perf-regression
+    # flight-recorder capsule. Cheap (band math at round cadence), on by
+    # default.
+    perf_sentinel_enabled: bool = True
+    # consecutive out-of-band rounds before the sentinel trips (and
+    # consecutive in-band rounds before a tripped phase re-arms) — the K in
+    # "K rounds of sustained regression", not the MAD multiplier.
+    perf_sentinel_mad_k: int = 3
     # gang scheduling (solver/gang.py + the provisioning gang gate):
     # all-or-nothing pod groups with rank-aware single-zone repacking.
     # A no-op on batches without pod-group keys, so it defaults on.
@@ -326,6 +350,18 @@ class Settings:
         if self.gang_max_wait_rounds < 0:
             raise ValueError(
                 "gangMaxWaitRounds must be >= 0 (0 disables the wait escalation)"
+            )
+        if self.profiling_sample_hz <= 0 or self.profiling_sample_hz > 1000:
+            raise ValueError(
+                "profilingSampleHz must be in (0, 1000] (a kHz sampler is a "
+                "tracer, not a profiler)"
+            )
+        if self.profiling_baseline_rounds < 1:
+            raise ValueError("profilingBaselineRounds must be >= 1")
+        if self.perf_sentinel_mad_k < 1:
+            raise ValueError(
+                "perfSentinelMadK must be >= 1 (consecutive out-of-band "
+                "rounds before a trip)"
             )
         if self.interruption_penalty_cost < 0:
             raise ValueError("interruptionPenaltyCost must be >= 0")

@@ -41,7 +41,7 @@ from ..solver.result import NewNodeSpec, SolveResult
 from ..solver.session import EncodeSession
 from ..solver.solver import GreedySolver, Solver, TPUSolver
 from ..state.cluster import Cluster
-from ..utils import metrics
+from ..utils import metrics, profiling
 from ..utils.decisions import DECISIONS
 from ..utils.lifecycle import LIFECYCLE, track_cluster_for_pruning
 from ..utils.events import Recorder
@@ -895,6 +895,7 @@ class ProvisioningController:
         )
         spent = time.perf_counter() - t0
         self._fw_eval_s += spent
+        profiling.note_phase("validate", "full", spent)
         metrics.SOLVE_PHASE.observe(spent, {"phase": "validate", "mode": "full"})
         return violations
 
@@ -1304,9 +1305,10 @@ class ProvisioningController:
                 daemonsets=daemonsets,
                 session=None if borrowed else router.session(RESIDUE),
             )
+            arb_s = time.perf_counter() - t_arb
+            profiling.note_phase("arbitrate", "sharded", arb_s)
             metrics.SOLVE_PHASE.observe(
-                time.perf_counter() - t_arb,
-                {"phase": "arbitrate", "mode": "sharded"},
+                arb_s, {"phase": "arbitrate", "mode": "sharded"}
             )
 
         # -- serial merge (deterministic: cell order, then residue) ---------
@@ -1359,6 +1361,7 @@ class ProvisioningController:
                 else (session.last_mode, session.last_full_reason)
             )
             if i not in reused:
+                profiling.note_phase("cell", session.last_mode, solve_s)
                 metrics.SOLVE_PHASE.observe(
                     solve_s, {"phase": "cell", "mode": session.last_mode}
                 )

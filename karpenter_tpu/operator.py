@@ -201,12 +201,26 @@ class Operator:
         from .utils import runtimehealth
 
         runtimehealth.install(
-            memory_profiling=settings.memory_profiling_enabled,
+            memory_profiling=settings.profiling_enabled,
             cell_bytes=(
                 provisioning.cell_memory_bytes
                 if settings.cell_sharding_enabled
                 else None
             ),
+        )
+        # continuous profiler + perf-regression sentinel: phase/bucket
+        # baselines persist next to the AOT disk cache; the continuous
+        # sampler starts only under the (costly) profiling switch, while
+        # the sentinel's round-cadence band math defaults on
+        from .utils import profiling
+
+        profiling.configure(
+            profiling_enabled=settings.profiling_enabled,
+            sample_hz=settings.profiling_sample_hz,
+            baseline_rounds=settings.profiling_baseline_rounds,
+            sentinel_enabled=settings.perf_sentinel_enabled,
+            mad_k=settings.perf_sentinel_mad_k,
+            baseline_dir=settings.aot_cache_dir or None,
         )
         termination = TerminationController(cluster, provider, recorder=recorder, clock=clock)
         deprovisioning = DeprovisioningController(
@@ -311,6 +325,9 @@ class Operator:
         self.drift.reconcile()
         self.deprovisioning.reconcile()
         self.provisioning.reconcile()
+        from .utils import profiling
+
+        profiling.sentinel_tick()
         self.termination.reconcile()
         self.garbagecollect.reconcile()
         for scraper in self.scrapers:
@@ -422,7 +439,13 @@ class Operator:
 
             FLIGHT.flush_dumps()
 
+        def _stop_profiler():
+            from .utils.profiling import PROFILER
+
+            PROFILER.stop()
+
         try:
+            step("stop-profiler", _stop_profiler)
             if self.interruption is not None:
                 step("join-interruption-workers",
                      lambda: self.interruption.close(wait=True))
@@ -457,6 +480,11 @@ class Operator:
                 retry_due = bool(self.cluster.pending_pods())
             if self.provisioning.batcher.ready() or retry_due:
                 self.provisioning.reconcile()
+                # round boundary for the perf sentinel: evaluate phase
+                # EWMAs against their baseline bands once per reconcile
+                from .utils import profiling
+
+                profiling.sentinel_tick()
                 if not state["frozen"]:
                     # freeze AFTER the first reconcile built the long-lived
                     # state (pods, nodes, encoder caches) so gen-2 GC scans

@@ -28,7 +28,14 @@ renders the whole run into a report whose ``violations`` list must be empty:
 * **ledger conservation** — every ``/debug/costs`` poll is a settle point:
   the cost ledger's per-consumer attributed spend must equal its metered
   total within f64 tolerance at EVERY sample, and the windowed burn rate
-  must stay under a sanity budget while the churn generator runs.
+  must stay under a sanity budget while the churn generator runs;
+* **perf-sentinel discipline** — ``karpenter_tpu_perf_regression_total``
+  and ``/debug/perf`` are scraped throughout; a run that declares
+  ``perf_trips_expected=False`` (a clean calibrated soak) must end with
+  ZERO sentinel trips, and one declaring ``perf_trips_expected=True`` (an
+  injected ``dispatch-hang`` slowdown) must end with at least one — and
+  with warmed baselines, so the positive assertion can never pass
+  vacuously on a sentinel that never armed.
 """
 
 from __future__ import annotations
@@ -158,6 +165,13 @@ class InvariantMonitor:
         self.cost_burn_max_per_hr = 0.0
         self.cost_conservation_max_err = 0.0
         self.cost_conservation_violations: List[str] = []
+        # perf-regression sentinel (utils/profiling.py): max trip count per
+        # phase label across scrapes, plus /debug/perf arming telemetry so
+        # the expected-trip assertion cannot pass against a sentinel that
+        # never warmed a baseline
+        self.perf_trips: Dict[str, float] = {}
+        self.perf_samples = 0
+        self.perf_phases_armed_max = 0
         self.scrape_failures = 0
         self._cluster = None
         self._stop = threading.Event()
@@ -236,10 +250,16 @@ class InvariantMonitor:
                 self.stage_counts[stage] = max(
                     self.stage_counts.get(stage, 0.0), value
                 )
+            elif name == "karpenter_tpu_perf_regression_total":
+                phase = labels.get("phase", "")
+                self.perf_trips[phase] = max(
+                    self.perf_trips.get(phase, 0.0), value
+                )
         if rss is not None and start is not None:
             self.mem_samples.append((now, start, rss))
             self.start_times_seen.add(start)
         self._sample_costs(metrics_url)
+        self._sample_perf(metrics_url)
         return True
 
     def _sample_costs(self, metrics_url: str) -> None:
@@ -274,6 +294,29 @@ class InvariantMonitor:
                 f"attributed != metered: max_abs_error={err:.3e} "
                 f"tolerance={conservation.get('tolerance')}"
             )
+
+    def _sample_perf(self, metrics_url: str) -> None:
+        """Poll ``/debug/perf``: how many phase/bucket baselines are armed.
+        The expected-trip soak assertion requires at least one armed
+        baseline — otherwise "the fault tripped the sentinel" would be
+        vacuously checkable against a sentinel that never warmed."""
+        import json as _json
+
+        base = metrics_url.rsplit("/metrics", 1)[0]
+        try:
+            with urllib.request.urlopen(f"{base}/debug/perf", timeout=2.0) as resp:
+                payload = _json.loads(resp.read().decode())
+        except Exception:
+            return
+        if not payload.get("enabled"):
+            return
+        self.perf_samples += 1
+        armed = sum(
+            1
+            for doc in payload.get("phases", {}).values()
+            if doc.get("baseline")
+        )
+        self.perf_phases_armed_max = max(self.perf_phases_armed_max, armed)
 
     def start_sampling(self, metrics_url: str) -> None:
         def loop() -> None:
@@ -342,6 +385,7 @@ class InvariantMonitor:
         events_total: int = 0,
         duration_s: float = 0.0,
         restarts: Optional[Dict] = None,
+        perf_trips_expected: Optional[bool] = None,
     ) -> Dict:
         slope, segments = memory_slope_bps(self.mem_samples)
         p50 = _percentile(self.ready_latencies, 0.50)
@@ -400,6 +444,25 @@ class InvariantMonitor:
                 f"sanity budget {self.cost_burn_budget_per_hr:.1f}$/hr "
                 "(ledger double-count, not a real bill)"
             )
+        perf_trips_total = sum(self.perf_trips.values())
+        if perf_trips_expected is False and perf_trips_total > 0:
+            violations.append(
+                f"perf sentinel false-tripped on a clean run: "
+                f"{ {k: int(v) for k, v in sorted(self.perf_trips.items())} }"
+            )
+        elif perf_trips_expected is True:
+            # non-vacuous: the positive case must show the sentinel both
+            # ARMED (warmed baselines observed on /debug/perf) and TRIPPED
+            if self.perf_phases_armed_max == 0:
+                violations.append(
+                    "perf sentinel never armed a baseline — the injected "
+                    "slowdown assertion is vacuous"
+                )
+            if perf_trips_total == 0:
+                violations.append(
+                    "injected dispatch-hang slowdown did not trip the perf "
+                    "sentinel"
+                )
         if replay is not None:
             if replay.get("mismatched"):
                 violations.append(
@@ -445,6 +508,13 @@ class InvariantMonitor:
                 "burn_max_per_hr": round(self.cost_burn_max_per_hr, 6),
                 "conservation_max_abs_error": self.cost_conservation_max_err,
                 "conservation_ok": not self.cost_conservation_violations,
+            },
+            "perf": {
+                "trips": {k: int(v) for k, v in sorted(self.perf_trips.items())},
+                "trips_total": int(perf_trips_total),
+                "trips_expected": perf_trips_expected,
+                "samples": self.perf_samples,
+                "phases_armed_max": self.perf_phases_armed_max,
             },
             "replay": replay,
             "restarts": restarts or {},

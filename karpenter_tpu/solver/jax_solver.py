@@ -1116,6 +1116,11 @@ class AOTCache:
 
     # -- measured dispatch latency -----------------------------------------
     def note_dispatch(self, key: BucketKey, seconds: float, donate: bool = False, mesh=None) -> None:
+        # per-bucket baseline feed for the perf sentinel — one call covers
+        # every dispatch site (flat, sweep-clone, fleet ready/miss)
+        from ..utils import profiling
+
+        profiling.note_bucket_dispatch(key.label(), seconds)
         ck = self._ckey(key, donate, mesh)
         with self._lock:
             entry = self._entries.get(ck)

@@ -44,7 +44,7 @@ import numpy as np
 from ..api.objects import Pod, Provisioner
 from ..api.taints import tolerates_all
 from ..cloudprovider.types import InstanceType
-from ..utils import metrics
+from ..utils import metrics, profiling
 from .encode import (
     ENCODE_LOCK,
     _group_members,
@@ -298,9 +298,10 @@ class EncodeSession:
             # on /metrics rather than only in bench runs
             problem.__dict__["_encode_mode"] = self.last_mode
             self._note_shape(problem)
+            encode_s = time.perf_counter() - t0
+            profiling.note_phase("encode", self.last_mode, encode_s)
             metrics.SOLVE_PHASE.observe(
-                time.perf_counter() - t0,
-                {"phase": "encode", "mode": self.last_mode},
+                encode_s, {"phase": "encode", "mode": self.last_mode}
             )
             LIFECYCLE.mark_many(pod_names, "encode_done")
             return problem

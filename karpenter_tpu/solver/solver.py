@@ -26,7 +26,7 @@ import numpy as np
 
 from ..api.objects import Pod, Provisioner
 from ..cloudprovider.types import InstanceType
-from ..utils import metrics
+from ..utils import metrics, profiling
 from .encode import EncodedProblem, ExistingNode, LaunchOption, encode
 from .greedy import GreedyPacker
 from .jax_solver import (
@@ -55,9 +55,11 @@ def _observe_phase(problem: EncodedProblem, phase: str, seconds: float) -> None:
     """Solver phase histogram sample, labeled with the round's encode mode
     (stamped by EncodeSession / solve_pods; plain full encodes default) —
     karpenter_tpu_solve_phase_seconds{phase,mode}."""
+    mode = problem.__dict__.get("_encode_mode", "full")
+    profiling.note_phase(phase, mode, seconds)
     metrics.SOLVE_PHASE.observe(
         seconds,
-        {"phase": phase, "mode": problem.__dict__.get("_encode_mode", "full")},
+        {"phase": phase, "mode": mode},
     )
 
 
@@ -997,9 +999,10 @@ class _FleetBuffer:
                     # from its shards) — karpenter_tpu_solve_phase_seconds
                     # {phase=gather} is the meshed tier's visibility into
                     # that collective cost
+                    gather_s = time.perf_counter() - t0
+                    profiling.note_phase("gather", "sharded", gather_s)
                     metrics.SOLVE_PHASE.observe(
-                        time.perf_counter() - t0,
-                        {"phase": "gather", "mode": "sharded"},
+                        gather_s, {"phase": "gather", "mode": "sharded"}
                     )
             return self._host
 
@@ -1258,9 +1261,10 @@ def _stage_fleet_chunk(chunk, key, fleet_key, B, mesh, exe, cleared) -> bool:
             staged["orders"], staged["alphas"], staged["looks"],
             staged["rsvs"], staged["swaps"],
         )
+        stage_s = time.perf_counter() - t_stage
+        profiling.note_phase("stage", "sharded", stage_s)
         metrics.SOLVE_PHASE.observe(
-            time.perf_counter() - t_stage,
-            {"phase": "stage", "mode": "sharded"},
+            stage_s, {"phase": "stage", "mode": "sharded"}
         )
     else:
         t_stage = time.perf_counter()
@@ -1322,9 +1326,10 @@ def _stage_fleet_chunk(chunk, key, fleet_key, B, mesh, exe, cleared) -> bool:
                 staged["orders"], staged["alphas"], staged["looks"],
                 staged["rsvs"], staged["swaps"],
             )
+        stage_s = time.perf_counter() - t_stage
+        profiling.note_phase("stage", "sharded", stage_s)
         metrics.SOLVE_PHASE.observe(
-            time.perf_counter() - t_stage,
-            {"phase": "stage", "mode": "sharded"},
+            stage_s, {"phase": "stage", "mode": "sharded"}
         )
     t_dispatch = time.perf_counter()
     buf = exe(inputs_d, orders_d, alphas_d, looks_d, rsvs_d, swaps_d)

@@ -60,6 +60,7 @@ TRIGGER_FULL_ENCODE = "full-encode-fallback"
 TRIGGER_BREAKER = "breaker-open"
 TRIGGER_GANG_DEFERRED = "gang-deferred"
 TRIGGER_VALIDATION = "validation-rejected"
+TRIGGER_PERF_REGRESSION = "perf-regression"
 
 #: full-encode reasons that are NORMAL operation, not an anomaly: the first
 #: encode of a session, the periodic backstop, and a disabled delta path

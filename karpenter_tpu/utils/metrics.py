@@ -712,7 +712,26 @@ PROCESS_MEMORY = Gauge(
 TRACEMALLOC_TOP = Gauge(
     "karpenter_tpu_tracemalloc_top_bytes",
     help="Top allocation sites by live bytes (file:lineno), exported only "
-         "when settings.memory_profiling_enabled turns tracemalloc on.",
+         "when settings.profiling_enabled turns tracemalloc on.",
+    registry=REGISTRY,
+)
+
+# -- continuous profiler + perf-regression sentinel (utils/profiling.py) -----
+PERF_REGRESSION = Counter(
+    "karpenter_tpu_perf_regression_total",
+    help="Perf-sentinel trips, labeled by the regressing solve phase: the "
+         "phase's live EWMA stayed outside its baseline MAD band for "
+         "settings.perf_sentinel_mad_k consecutive rounds. Each trip also "
+         "writes a DecisionRecord (kind=perf), opens an on-demand profile "
+         "window and dumps a perf-regression flight-recorder capsule — "
+         "start at /debug/perf, then /debug/profile.",
+    registry=REGISTRY,
+)
+PROFILER_SAMPLES = Gauge(
+    "karpenter_tpu_profiler_samples_total",
+    help="Stack samples aggregated by the sampling profiler since process "
+         "start (0 when the profiler never ran — the zero-overhead-when-"
+         "disabled invariant is observable). Refreshed pre-scrape.",
     registry=REGISTRY,
 )
 PROCESS_START_TIME = Gauge(

@@ -1,0 +1,692 @@
+"""ISSUE 20 suite: the continuous profiler + perf-regression sentinel.
+
+Three layers under test, mirroring utils/profiling.py:
+
+* :class:`SamplingProfiler` — bounded LRU collapsed-stack table (evicted
+  counts stay lossless under ``<evicted>``), depth truncation, idempotent
+  start/stop, self-stopping windows, thread-role tagging, speedscope export.
+* :class:`PhaseBaselineStore` — freeze math + JSON persistence round-trip,
+  corrupt files degrade to empty (baselines are advisory).
+* :class:`PerfSentinel` — the FakeClock-driven state machine: warm → armed,
+  trip at exactly K consecutive out-of-band rounds (not K-1), streak reset
+  on an in-band round, idle rounds frozen, re-arm + second trip, bucket
+  attribution (band-ratio winner plus the right-censoring fallback), trip
+  emission (DecisionRecord + karpenter_tpu_perf_regression_total), and the
+  deferred anomaly capsule whose extra forensic outputs still replay
+  byte-identically.
+
+The live-HTTP class drives ``/debug/profile`` and ``/debug/perf`` through a
+real OperatorHTTPServer, same as the flight-recorder suite does.
+"""
+
+from __future__ import annotations
+
+import gzip
+import json
+import os
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from karpenter_tpu.api.settings import Settings
+from karpenter_tpu.cloudprovider import FakeCloudProvider, generate_catalog
+from karpenter_tpu.controllers.provisioning import ProvisioningController
+from karpenter_tpu.replay import replay_capsule
+from karpenter_tpu.solver.solver import GreedySolver
+from karpenter_tpu.state import Cluster
+from karpenter_tpu.utils import metrics, profiling
+from karpenter_tpu.utils.cache import FakeClock
+from karpenter_tpu.utils.decisions import DECISIONS
+from karpenter_tpu.utils.flightrecorder import (
+    FLIGHT,
+    TRIGGER_PERF_REGRESSION,
+    FlightRecorder,
+)
+from karpenter_tpu.utils.httpserver import OperatorHTTPServer
+from karpenter_tpu.utils.profiling import (
+    PerfSentinel,
+    PhaseBaselineStore,
+    SamplingProfiler,
+    _band_hi,
+    _KeyState,
+    thread_role,
+)
+
+from helpers import make_pods, make_provisioner
+
+
+@pytest.fixture(autouse=True)
+def _fresh_perf_state():
+    DECISIONS.configure(2048)
+    DECISIONS.clear()
+    FLIGHT.configure(32)
+    FLIGHT.clear()
+    profiling.PROFILER.stop()
+    profiling.PROFILER.reset()
+    profiling.SENTINEL.reset()
+    yield
+    profiling.PROFILER.stop()
+    profiling.PROFILER.reset()
+    profiling.SENTINEL.reset()
+    profiling.SENTINEL.configure(
+        enabled=False, sentinel_enabled=False, mad_k=3,
+        baseline_rounds=20, baseline_path=None,
+    )
+    FLIGHT.configure(32)
+    FLIGHT.clear()
+    DECISIONS.clear()
+
+
+def _get(port, path):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}", timeout=5) as r:
+        return r.status, r.read().decode()
+
+
+# ---------------------------------------------------------------------------
+# Thread-role tagging
+# ---------------------------------------------------------------------------
+
+
+class TestThreadRole:
+    def test_known_roles(self):
+        assert thread_role("MainThread") == "reconcile"
+        assert thread_role("watch-0") == "watch-applier"
+        assert thread_role("cluster-apply-2") == "watch-applier"
+        assert thread_role("hostpool-worker-3") == "hostpool"
+        assert thread_role("aot-precompile") == "background"
+
+    def test_unknown_threads_keep_their_name(self):
+        # nothing hides under an "other" bucket
+        assert thread_role("grpc-poller-7") == "grpc-poller-7"
+
+
+# ---------------------------------------------------------------------------
+# SamplingProfiler
+# ---------------------------------------------------------------------------
+
+
+class TestSamplingProfiler:
+    def test_bounded_lru_eviction_keeps_totals_lossless(self):
+        p = SamplingProfiler(max_stacks=8)
+        for i in range(100):
+            p._ingest([f"reconcile;mod.f{i}"])
+        assert len(p._stacks) <= 8
+        assert p.samples == 100
+        assert p.evicted_stacks == 100 - len(p._stacks)
+        kept = sum(p._stacks.values())
+        assert kept + p.evicted_samples == p.samples
+        assert p.collapsed().splitlines()[-1] == f"<evicted> {p.evicted_samples}"
+
+    def test_hot_stack_survives_eviction_pressure(self):
+        p = SamplingProfiler(max_stacks=4)
+        for i in range(50):
+            p._ingest(["reconcile;solver.solve"])  # the hot key, re-touched
+            p._ingest([f"background;mod.cold{i}"])
+        assert "reconcile;solver.solve" in p._stacks
+        assert p._stacks["reconcile;solver.solve"] == 50
+
+    def test_start_is_idempotent_and_stop_tears_down(self):
+        p = SamplingProfiler()
+        try:
+            assert p.start(hz=200) is True
+            assert p.running
+            assert p.start() is False  # no second thread
+            assert sum(
+                1 for t in threading.enumerate() if t.name == "perf-profiler"
+            ) == 1
+        finally:
+            p.stop()
+        assert not p.running
+        p.stop()  # idempotent
+
+    def test_disabled_profiler_has_no_thread_and_no_samples(self):
+        p = SamplingProfiler()
+        snap = p.snapshot()
+        assert snap["running"] is False
+        assert snap["samples"] == 0
+        assert p.collapsed() == ""
+
+    def test_window_self_stops_and_collects(self):
+        p = SamplingProfiler()
+        try:
+            assert p.start_window(0.15, hz=250) is True
+            deadline = time.monotonic() + 5.0
+            while p.running and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert not p.running  # self-stopped at the deadline
+            # the test's MainThread was blocked right here — it is sampled
+            assert p.samples > 0
+            assert ";" in p.collapsed()
+        finally:
+            p.stop()
+
+    def test_window_is_noop_under_continuous_sampling(self):
+        p = SamplingProfiler()
+        try:
+            p.start(hz=250)
+            assert p.start_window(10.0) is False  # continuous subsumes it
+            assert p.snapshot()["continuous"] is True
+        finally:
+            p.stop()
+
+    def test_depth_truncation_marks_runaway_recursion(self):
+        evt = threading.Event()
+
+        def rec(n):
+            if n:
+                return rec(n - 1)
+            evt.wait(10)
+
+        t = threading.Thread(target=rec, args=(200,), name="deep-rec", daemon=True)
+        t.start()
+        p = SamplingProfiler(max_depth=16)
+        try:
+            p.start(hz=250)
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                if any("deep-rec;" in k for k in list(p._stacks)):
+                    break
+                time.sleep(0.02)
+        finally:
+            p.stop()
+            evt.set()
+            t.join(timeout=5)
+        deep = [k for k in p._stacks if k.startswith("deep-rec;")]
+        assert deep, "the recursing thread was never sampled"
+        for key in deep:
+            frames = key.split(";")
+            assert "<truncated>" in frames
+            # role + <truncated> + at most max_depth real frames
+            assert len(frames) <= 16 + 2
+
+    def test_live_thread_role_tagging(self):
+        evt = threading.Event()
+        t = threading.Thread(
+            target=evt.wait, args=(10,), name="hostpool-worker-9", daemon=True
+        )
+        t.start()
+        p = SamplingProfiler()
+        try:
+            p.start(hz=250)
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                if any(k.startswith("hostpool;") for k in list(p._stacks)):
+                    break
+                time.sleep(0.02)
+        finally:
+            p.stop()
+            evt.set()
+            t.join(timeout=5)
+        assert any(k.startswith("hostpool;") for k in p._stacks)
+
+    def test_speedscope_document_matches_table(self):
+        p = SamplingProfiler()
+        p._ingest(["reconcile;a.f;b.g"] * 3 + ["hostpool;c.h"] * 2)
+        doc = p.speedscope()
+        assert doc["$schema"].startswith("https://www.speedscope.app/")
+        prof = doc["profiles"][0]
+        assert prof["type"] == "sampled"
+        assert sum(prof["weights"]) == p.samples == prof["endValue"]
+        names = [f["name"] for f in doc["shared"]["frames"]]
+        for sample in prof["samples"]:
+            assert all(0 <= i < len(names) for i in sample)
+        assert "reconcile" in names and "hostpool" in names
+
+    def test_reset_clears_table_but_not_running_state(self):
+        p = SamplingProfiler()
+        p._ingest(["reconcile;a.f"])
+        p.reset()
+        assert p.samples == 0
+        assert p.collapsed() == ""
+
+
+# ---------------------------------------------------------------------------
+# PhaseBaselineStore
+# ---------------------------------------------------------------------------
+
+
+class TestPhaseBaselineStore:
+    def test_freeze_and_persistence_round_trip(self, tmp_path):
+        store = PhaseBaselineStore()
+        store.configure(str(tmp_path / "phase_baselines.json"), 5)
+        st = _KeyState()
+        st.warmup.extend([0.010, 0.011, 0.009, 0.012, 0.010])
+        store.freeze("solve|full", st)
+        assert st.baseline is not None
+        assert st.baseline["p50"] == pytest.approx(0.010)
+        assert st.baseline["n"] == 5
+        assert not st.warmup  # reservoir released after freeze
+        assert store.save({"solve|full": st}) is not None
+        loaded = store.load()
+        assert loaded["solve|full"]["p50"] == pytest.approx(0.010)
+        assert {"p50", "p99", "mad"} <= set(loaded["solve|full"])
+
+    def test_corrupt_or_missing_file_degrades_to_empty(self, tmp_path):
+        store = PhaseBaselineStore()
+        store.configure(str(tmp_path / "phase_baselines.json"), 5)
+        assert store.load() == {}  # missing
+        (tmp_path / "phase_baselines.json").write_text("{not json")
+        assert store.load() == {}  # corrupt
+        store.configure(None, 5)
+        assert store.load() == {}  # unconfigured
+        assert store.save({}) is None
+
+    def test_band_floor_protects_micro_phases(self):
+        # near-zero MAD must not make the band hair-trigger
+        band = _band_hi({"p50": 1e-5, "p99": 1e-5, "mad": 0.0})
+        assert band >= 1e-5 + 2e-4
+
+
+# ---------------------------------------------------------------------------
+# PerfSentinel state machine (FakeClock — no real time anywhere)
+# ---------------------------------------------------------------------------
+
+
+def _sentinel(tmp_path, mad_k=3, baseline_rounds=5, window=0.0):
+    fake = FakeClock(start=100.0)
+    s = PerfSentinel(SamplingProfiler(), PhaseBaselineStore())
+    s.configure(
+        enabled=True,
+        sentinel_enabled=True,
+        mad_k=mad_k,
+        baseline_rounds=baseline_rounds,
+        baseline_path=str(tmp_path / "phase_baselines.json"),
+        profile_window_s=window,
+        clock=fake.now,
+    )
+    return s, fake
+
+
+def _warm(s, rounds=5, value=0.010, bucket=None):
+    """Feed `rounds` clean rounds so solve|full (and optionally a bucket)
+    freezes its baseline and arms."""
+    for i in range(rounds):
+        s.note_phase("solve", "full", value + 0.0001 * (i % 3))
+        if bucket:
+            s.note_bucket(bucket, value / 10)
+        assert s.tick() == []
+
+
+def _force(s, value, key="solve|full"):
+    """Pin the key's EWMA directly so band-evaluation tests are decoupled
+    from EWMA blend-in lag (the lag itself is covered by the real-value
+    trip test below)."""
+    st = s._states[key]
+    st.ewma = value
+    st.fresh = True
+
+
+class TestPerfSentinelStateMachine:
+    def test_warmup_arms_without_tripping(self, tmp_path):
+        s, _ = _sentinel(tmp_path, baseline_rounds=5)
+        _warm(s)
+        doc = s.snapshot()["phases"]["solve|full"]
+        assert doc["state"] == "armed"
+        assert doc["baseline"]["n"] == 5
+        assert s.trips_total == 0
+
+    def test_trips_at_exactly_k_not_before(self, tmp_path):
+        s, _ = _sentinel(tmp_path, mad_k=3)
+        _warm(s)
+        for _ in range(2):  # rounds 1..K-1 out of band: armed but silent
+            _force(s, 1.0)
+            assert s.tick() == []
+        _force(s, 1.0)
+        fired = s.tick()  # round K
+        assert len(fired) == 1
+        assert fired[0]["phase"] == "solve"
+        assert fired[0]["mode"] == "full"
+        assert fired[0]["observed_ewma_s"] == pytest.approx(1.0)
+        assert s.snapshot()["phases"]["solve|full"]["state"] == "tripped"
+
+    def test_in_band_round_resets_the_streak(self, tmp_path):
+        s, _ = _sentinel(tmp_path, mad_k=3)
+        _warm(s)
+        _force(s, 1.0); s.tick()
+        _force(s, 1.0); s.tick()
+        _force(s, 0.010); assert s.tick() == []  # back in band: streak reset
+        _force(s, 1.0); assert s.tick() == []
+        _force(s, 1.0); assert s.tick() == []
+        _force(s, 1.0)
+        assert len(s.tick()) == 1  # needed a fresh K-run after the reset
+
+    def test_idle_rounds_do_not_advance_streaks(self, tmp_path):
+        s, _ = _sentinel(tmp_path, mad_k=3)
+        _warm(s)
+        _force(s, 1.0); s.tick()
+        _force(s, 1.0); s.tick()
+        assert s.tick() == []  # idle round: nothing fresh
+        assert s.tick() == []
+        assert s.snapshot()["phases"]["solve|full"]["out_streak"] == 2
+        _force(s, 1.0)
+        assert len(s.tick()) == 1  # the streak survived the idle gap
+
+    def test_one_regression_is_one_trip_until_rearm(self, tmp_path):
+        s, _ = _sentinel(tmp_path, mad_k=2)
+        _warm(s)
+        for _ in range(2):
+            _force(s, 1.0); s.tick()
+        assert s.trips_total == 1
+        for _ in range(4):  # still slow: NO trip-per-round spam
+            _force(s, 1.0)
+            assert s.tick() == []
+        assert s.trips_total == 1
+        for _ in range(2):  # K in-band rounds re-arm
+            _force(s, 0.010); s.tick()
+        assert s.snapshot()["phases"]["solve|full"]["state"] == "armed"
+        for _ in range(2):  # a second regression is a second trip
+            _force(s, 1.0); s.tick()
+        assert s.trips_total == 2
+
+    def test_real_values_trip_through_ewma(self, tmp_path):
+        # no _force: a decisively slow phase (>> 1/EWMA_NEW x baseline)
+        # must trip within K rounds through the real blend
+        s, _ = _sentinel(tmp_path, mad_k=3)
+        _warm(s)
+        fired = []
+        for _ in range(3):
+            s.note_phase("solve", "full", 1.0)
+            fired = s.tick()
+        assert len(fired) == 1
+
+    def test_bucket_attribution_picks_worst_band_ratio(self, tmp_path):
+        s, _ = _sentinel(tmp_path, mad_k=2)
+        for _ in range(5):
+            s.note_phase("solve", "full", 0.010)
+            s.note_bucket("g8o64e1s32", 0.001)
+            s.note_bucket("g2o16e1s8", 0.001)
+            s.tick()
+        for _ in range(2):
+            s.note_phase("solve", "full", 1.0)
+            s.note_bucket("g8o64e1s32", 0.5)   # the regressed bucket
+            s.note_bucket("g2o16e1s8", 0.001)  # still nominal
+            fired = s.tick()
+        assert fired[0]["bucket"] == "g8o64e1s32"
+        assert fired[0]["bucket_band_ratio"] > 1.0
+
+    def test_bucket_fallback_when_baselines_never_froze(self, tmp_path):
+        # the race path right-censors fast dispatches: buckets may have
+        # observations but no frozen baseline — attribution falls back to
+        # the slowest recently-observed bucket with ratio 0.0
+        s, _ = _sentinel(tmp_path, mad_k=2)
+        _warm(s)
+        for _ in range(2):
+            s.note_phase("solve", "full", 1.0)
+            s.note_bucket("g8o64e1s32", 0.4)
+            s.note_bucket("g2o16e1s8", 0.002)
+            fired = s.tick()
+        assert fired[0]["bucket"] == "g8o64e1s32"
+        assert fired[0]["bucket_band_ratio"] == 0.0
+
+    def test_baselines_survive_a_restart(self, tmp_path):
+        s1, _ = _sentinel(tmp_path, baseline_rounds=5)
+        _warm(s1)
+        # a brand-new sentinel (restarted operator) loads the frozen
+        # baseline from disk and starts armed — no re-learning window
+        s2, _ = _sentinel(tmp_path)
+        doc = s2.snapshot()["phases"]["solve|full"]
+        assert doc["state"] == "armed"
+        assert doc["baseline"]["p50"] == pytest.approx(0.010, abs=1e-3)
+
+    def test_disabled_taps_record_nothing(self, tmp_path):
+        s, _ = _sentinel(tmp_path)
+        s.configure(
+            enabled=False, sentinel_enabled=False, mad_k=3,
+            baseline_rounds=5, baseline_path=None,
+        )
+        # the module-level taps gate on SENTINEL.enabled before any lock
+        assert s.tick() == []
+        snap = s.snapshot()
+        assert snap["rounds"] == 0
+
+
+class TestTripEmission:
+    def test_trip_writes_decision_and_metric(self, tmp_path):
+        s, _ = _sentinel(tmp_path, mad_k=2)
+        before = metrics.PERF_REGRESSION.value({"phase": "solve"})
+        _warm(s, bucket="g8o64e1s32")
+        for _ in range(2):
+            s.note_phase("solve", "full", 1.0)
+            s.note_bucket("g8o64e1s32", 0.5)
+            s.tick()
+        assert metrics.PERF_REGRESSION.value({"phase": "solve"}) == before + 1
+        recs = DECISIONS.query(kind="perf")
+        assert recs, "the trip must leave an audit record"
+        # the regressed bucket key trips independently (phase "bucket");
+        # pick the solve-phase record
+        rec = next(r for r in recs if r.details.get("phase") == "solve")
+        assert rec.outcome == "regression"
+        assert "solve" in rec.reason and "exceeded baseline band" in rec.reason
+        assert rec.details["bucket"] == "g8o64e1s32"
+        assert rec.details["observed_ewma_s"] > rec.details["band_hi_s"]
+        assert rec.details["baseline_p50_s"] == pytest.approx(0.010, abs=1e-3)
+
+    def test_trip_opens_profile_window(self, tmp_path):
+        s, fake = _sentinel(tmp_path, mad_k=2, window=1.5)
+        _warm(s)
+        for _ in range(2):
+            _force(s, 1.0)
+            s.tick()
+        try:
+            assert s.profiler.running  # forensic window opened by the trip
+            assert s.profiler.windows == 1
+        finally:
+            s.profiler.stop()
+
+
+class TestCapsuleAssembly:
+    def _base_capsule(self):
+        return {
+            "id": "prov-abc123",
+            "controller": "provisioning",
+            "inputs": {"objects": {"pods": []}},
+            "outputs": {"placements": []},
+            "anomalies": [],
+        }
+
+    def test_same_tick_capsule_with_window_zero(self, tmp_path):
+        FLIGHT.configure(8, dump_dir=str(tmp_path))
+        FLIGHT.commit_external(self._base_capsule())
+        s, _ = _sentinel(tmp_path, mad_k=2, window=0.0)
+        _warm(s)
+        fired = []
+        for _ in range(2):
+            _force(s, 1.0)
+            fired = s.tick()
+        # window 0: the capsule assembles on the SAME tick as the trip
+        trip = fired[0]
+        assert trip["capsule"] == "prov-abc123.perf1"
+        capsule = FLIGHT.get(trip["capsule"])
+        assert capsule is not None
+        assert TRIGGER_PERF_REGRESSION in capsule["anomalies"]
+        assert capsule["outputs"]["perf_regression"]["phase"] == "solve"
+        assert isinstance(capsule["outputs"]["profile"], list)
+        # the anomaly auto-dumped to disk as a gzip capsule
+        path = FlightRecorder._dump_path(trip["capsule"], str(tmp_path))
+        assert os.path.exists(path)
+        with gzip.open(path, "rt") as fh:
+            dumped = json.load(fh)
+        assert dumped["id"] == trip["capsule"]
+
+    def test_deferred_capsule_waits_for_the_window(self, tmp_path):
+        FLIGHT.configure(8, dump_dir=str(tmp_path))
+        FLIGHT.commit_external(self._base_capsule())
+        s, fake = _sentinel(tmp_path, mad_k=2, window=2.0)
+        _warm(s)
+        fired = []
+        for _ in range(2):
+            _force(s, 1.0)
+            fired = s.tick()
+        try:
+            assert "capsule" not in fired[0]  # window still open
+            fake.step(2.5)
+            _force(s, 1.0)
+            s.tick()  # a later round past the due time flushes it
+            assert fired[0]["capsule"] == "prov-abc123.perf1"
+        finally:
+            s.profiler.stop()
+
+    def test_empty_recorder_degrades_gracefully(self, tmp_path):
+        FLIGHT.clear()
+        s, _ = _sentinel(tmp_path, mad_k=2, window=0.0)
+        _warm(s)
+        fired = []
+        for _ in range(2):
+            _force(s, 1.0)
+            fired = s.tick()
+        assert len(fired) == 1
+        assert "capsule" not in fired[0]  # no base capsule: trip ring only
+
+    def test_perf_capsule_replays_byte_identically(self, tmp_path):
+        """The acceptance contract: the extra profile/perf_regression
+        outputs ride the same forensic exclusion as aot_solves — replay of
+        a perf capsule from a REAL round still byte-matches."""
+        FLIGHT.configure(8, dump_dir=str(tmp_path))
+        cluster = Cluster()
+        provider = FakeCloudProvider(catalog=generate_catalog(n_types=20))
+        controller = ProvisioningController(
+            cluster, provider, solver=GreedySolver(),
+            settings=Settings(batch_idle_duration=0, batch_max_duration=0),
+        )
+        cluster.add_provisioner(make_provisioner())
+        for p in make_pods(6, prefix="perf", cpu="500m", memory="1Gi"):
+            cluster.add_pod(p)
+        controller.reconcile()
+        assert FLIGHT.latest("provisioning") is not None
+        s, _ = _sentinel(tmp_path, mad_k=2, window=0.0)
+        _warm(s)
+        fired = []
+        for _ in range(2):
+            _force(s, 1.0)
+            fired = s.tick()
+        capsule = FLIGHT.get(fired[0]["capsule"])
+        assert capsule["outputs"]["profile"] is not None
+        report = replay_capsule(
+            json.loads(json.dumps(capsule, default=str)), solver="greedy"
+        )
+        assert report["match"], report
+
+
+# ---------------------------------------------------------------------------
+# Module wiring: configure() + the hot-path taps
+# ---------------------------------------------------------------------------
+
+
+class TestModuleWiring:
+    def test_taps_are_noops_while_disabled(self, tmp_path):
+        profiling.SENTINEL.configure(
+            enabled=False, sentinel_enabled=False, mad_k=3,
+            baseline_rounds=5, baseline_path=None,
+        )
+        profiling.note_phase("solve", "full", 0.5)
+        profiling.note_bucket_dispatch("g8o64", 0.5)
+        assert profiling.sentinel_tick() == []
+        assert profiling.SENTINEL.snapshot()["phases"] == {}
+
+    def test_configure_wires_globals_and_starts_sampler(self, tmp_path):
+        profiling.configure(
+            profiling_enabled=True,
+            sample_hz=250.0,
+            baseline_rounds=7,
+            sentinel_enabled=True,
+            mad_k=4,
+            baseline_dir=str(tmp_path),
+            profile_window_s=0.5,
+        )
+        try:
+            assert profiling.PROFILER.running
+            snap = profiling.SENTINEL.snapshot()
+            assert snap["enabled"] is True
+            assert snap["mad_k"] == 4
+            assert snap["baseline_rounds"] == 7
+            assert snap["baseline_path"] == str(
+                tmp_path / profiling.BASELINE_FILENAME
+            )
+        finally:
+            profiling.PROFILER.stop()
+
+    def test_profiling_enabled_alone_still_learns_baselines(self, tmp_path):
+        # sentinel off + profiler on: taps stay live (enabled is the OR)
+        profiling.configure(
+            profiling_enabled=True,
+            sentinel_enabled=False,
+            baseline_dir=str(tmp_path),
+        )
+        try:
+            assert profiling.SENTINEL.enabled is True
+            assert profiling.SENTINEL.sentinel_enabled is False
+        finally:
+            profiling.PROFILER.stop()
+
+
+# ---------------------------------------------------------------------------
+# Settings validation
+# ---------------------------------------------------------------------------
+
+
+class TestSettingsValidation:
+    def test_sample_hz_bounds(self):
+        with pytest.raises(ValueError, match="profilingSampleHz"):
+            Settings(profiling_sample_hz=0).validate()
+        with pytest.raises(ValueError, match="profilingSampleHz"):
+            Settings(profiling_sample_hz=2000).validate()
+        Settings(profiling_sample_hz=97.0).validate()
+
+    def test_baseline_rounds_floor(self):
+        with pytest.raises(ValueError, match="profilingBaselineRounds"):
+            Settings(profiling_baseline_rounds=0).validate()
+
+    def test_mad_k_floor(self):
+        with pytest.raises(ValueError, match="perfSentinelMadK"):
+            Settings(perf_sentinel_mad_k=0).validate()
+
+
+# ---------------------------------------------------------------------------
+# Live HTTP surface
+# ---------------------------------------------------------------------------
+
+
+class TestDebugEndpoints:
+    def test_profile_window_and_formats(self):
+        srv = OperatorHTTPServer(port=0).start()
+        try:
+            status, body = _get(
+                srv.port, "/debug/profile?seconds=0.3&reset=1"
+            )
+            assert status == 200
+            assert ";" in body  # collapsed stacks from the live process
+            status, body = _get(
+                srv.port, "/debug/profile?format=speedscope"
+            )
+            assert status == 200
+            doc = json.loads(body)
+            assert doc["$schema"].startswith("https://www.speedscope.app/")
+        finally:
+            srv.stop()
+            profiling.PROFILER.stop()
+
+    def test_profile_start_status_stop_lifecycle(self):
+        srv = OperatorHTTPServer(port=0).start()
+        try:
+            status, body = _get(srv.port, "/debug/profile?start=1")
+            assert status == 200
+            assert json.loads(body)["running"] is True
+            status, body = _get(srv.port, "/debug/profile?status=1")
+            assert json.loads(body)["running"] is True
+            status, body = _get(srv.port, "/debug/profile?stop=1")
+            assert json.loads(body)["running"] is False
+        finally:
+            srv.stop()
+            profiling.PROFILER.stop()
+
+    def test_perf_snapshot_endpoint(self):
+        srv = OperatorHTTPServer(port=0).start()
+        try:
+            status, body = _get(srv.port, "/debug/perf")
+            assert status == 200
+            doc = json.loads(body)
+            assert {"enabled", "phases", "buckets", "trips"} <= set(doc)
+        finally:
+            srv.stop()
